@@ -1,0 +1,38 @@
+"""sheep_tpu — a TPU-native distributed graph partitioner.
+
+A from-scratch rebuild of the capabilities of the reference partitioner
+``chan150/sheep`` (SHEEP: Margo & Seltzer, "A Scalable Distributed Graph
+Partitioner", PVLDB 8(12), 2015), designed TPU-first:
+
+- the streaming elimination-tree build is expressed as an associative
+  reduction over edge chunks (``lax.scan`` + scatter-min fixpoint), not a
+  sequential union-find loop;
+- multi-device scaling uses ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives (psum / all_gather / ppermute) over ICI/DCN, not MPI;
+- the CPU reference path is native C++ (``sheep_tpu/core/csrc``) exposed via
+  ctypes, mirroring the reference's all-native core.
+
+Reference provenance: the reference mount was empty this round (see
+SURVEY.md §0); component parity targets come from SURVEY.md §2.
+"""
+
+__version__ = "0.1.0"
+
+from sheep_tpu.types import ElimTree, PartitionResult  # noqa: F401
+from sheep_tpu.backends.base import get_backend, list_backends  # noqa: F401
+
+
+def partition(path, k, backend=None, **opts):
+    """One-call API: partition the graph stored at *path* into *k* parts.
+
+    ``backend=None`` auto-selects the best registered backend
+    (tpu > cpu > pure).
+    """
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    if backend is None:
+        avail = list_backends()
+        backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
+    be = get_backend(backend)
+    with EdgeStream.open(path) as es:
+        return be.partition(es, k, **opts)

@@ -1,0 +1,16 @@
+from sheep_tpu.backends.base import Partitioner, get_backend, list_backends, register  # noqa: F401
+
+# Import concrete backends for registration side effects. Each import is
+# guarded: a backend that cannot initialize in this environment (e.g. the
+# native .so not built yet) simply stays unregistered.
+from sheep_tpu.backends import pure_backend  # noqa: F401
+
+try:
+    from sheep_tpu.backends import cpu_backend  # noqa: F401
+except Exception:  # pragma: no cover - native lib absent
+    pass
+
+try:
+    from sheep_tpu.backends import tpu_backend  # noqa: F401
+except Exception:  # pragma: no cover - jax absent/broken
+    pass

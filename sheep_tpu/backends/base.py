@@ -1,0 +1,48 @@
+"""Partitioner strategy plugin system (SURVEY.md §2 #10).
+
+The reference selects an execution backend with ``--backend=...``
+[NORTH-STAR]; this registry is the rebuild's equivalent. Backends register
+themselves at import time; ``get_backend`` instantiates by name.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Type
+
+from sheep_tpu.types import PartitionResult
+
+_REGISTRY: Dict[str, Type["Partitioner"]] = {}
+
+
+class Partitioner(abc.ABC):
+    """A partition strategy/backend: graph stream + k -> PartitionResult."""
+
+    name: str = "?"
+
+    @abc.abstractmethod
+    def partition(self, stream, k: int, **opts) -> PartitionResult:
+        """Partition the graph in *stream* into *k* parts."""
+
+    # backends advertise capabilities the CLI/driver can query
+    supports_streaming: bool = True
+    supports_multidevice: bool = False
+
+
+def register(cls: Type[Partitioner]) -> Type[Partitioner]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def list_backends() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_backend(name: str, **kw) -> Partitioner:
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {name!r}; available: {', '.join(list_backends())}"
+        ) from None
+    return cls(**kw)

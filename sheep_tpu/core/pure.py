@@ -1,0 +1,261 @@
+"""Pure numpy reference implementation of the SHEEP pipeline.
+
+This is the executable spec: the C++ CPU core (SURVEY.md §2 #11) and the
+JAX TPU backend are both equivalence-tested against it. Algorithm per the
+SHEEP paper (PVLDB 8(12) 2015) as reconstructed in SURVEY.md §3:
+
+degree sort -> union-find elimination-tree build (Liu's algorithm) ->
+associative partial-tree merge -> greedy tree split -> edge-cut scoring.
+
+Key identity this whole framework is built on (makes the algorithm
+map-reduce-able and hence TPU-shardable): with a fixed global elimination
+order, ``T(G1 ∪ G2) = T(T(G1) ∪ T(G2))`` — the elimination tree of a union
+of edge sets equals the elimination tree of the union of the partial trees'
+edges. Liu's vertex loop is equivalently Kruskal's union-find over edges
+keyed by the *later* endpoint's position, with the later endpoint becoming
+the merged component's root; ``parent[r] = v`` records each link.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sheep_tpu.types import ElimTree, PartitionResult
+
+
+# --------------------------------------------------------------------------
+# degrees + elimination order (SURVEY.md §2 #3)
+# --------------------------------------------------------------------------
+
+def degrees(edges: np.ndarray, n: int) -> np.ndarray:
+    """Endpoint-count degrees (self-loops count twice, multi-edges count)."""
+    return np.bincount(np.asarray(edges).ravel(), minlength=n).astype(np.int64)
+
+
+def elimination_order(deg: np.ndarray) -> np.ndarray:
+    """pos[v] = rank of v ordered by (degree asc, id asc).
+
+    Ties broken by id so the order is a pure function of the degree table —
+    every shard/backend derives the identical global order, which is what
+    makes partial trees mergeable.
+    """
+    n = len(deg)
+    order = np.lexsort((np.arange(n), deg))  # vertex ids in elimination order
+    pos = np.empty(n, dtype=np.int64)
+    pos[order] = np.arange(n)
+    return pos
+
+
+# --------------------------------------------------------------------------
+# elimination-tree build (SURVEY.md §2 #4, #5) — Liu's algorithm
+# --------------------------------------------------------------------------
+
+def build_elim_tree(edges: np.ndarray, pos: np.ndarray, parent: Optional[np.ndarray] = None) -> ElimTree:
+    """Build (or extend) an elimination forest from an edge multiset.
+
+    Kruskal formulation: process edges in ascending key = pos of the later
+    endpoint; link the earlier endpoint's current component root under the
+    later endpoint. Union-find with path compression; the *tree* parent
+    array records the link structure and is returned.
+
+    Passing a previous ``parent`` continues the stream: the prior forest's
+    edges are prepended, which by the merge identity gives the tree of the
+    union of everything seen so far.
+    """
+    n = len(pos)
+    e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if parent is not None:
+        prev = np.nonzero(parent >= 0)[0]
+        e = np.concatenate([np.stack([prev, parent[prev]], axis=1), e], axis=0)
+
+    # orient each edge (lo, hi) by position; drop self-loops
+    swap = pos[e[:, 0]] > pos[e[:, 1]]
+    lo = np.where(swap, e[:, 1], e[:, 0])
+    hi = np.where(swap, e[:, 0], e[:, 1])
+    keep = lo != hi
+    lo, hi = lo[keep], hi[keep]
+    order = np.argsort(pos[hi], kind="stable")
+    lo, hi = lo[order], hi[order]
+
+    tree_parent = np.full(n, -1, dtype=np.int64)
+    dsu = np.arange(n, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while dsu[root] != root:
+            root = dsu[root]
+        while dsu[x] != root:  # path compression
+            dsu[x], x = root, dsu[x]
+        return root
+
+    for u, v in zip(lo.tolist(), hi.tolist()):
+        # Processing edges in ascending pos[hi]: v cannot yet have been
+        # linked (links only happen at strictly later keys), so v is its own
+        # component root; u ~ v already iff find(u) == v.
+        r = find(u)
+        if r != v:
+            tree_parent[r] = v
+            dsu[r] = v
+    return ElimTree(parent=tree_parent, pos=pos, n=n)
+
+
+def merge_trees(a: ElimTree, b: ElimTree) -> ElimTree:
+    """Associative, commutative merge of partial forests (SURVEY.md §2 #6):
+    T(A ∪ B) via rebuilding over the union of the trees' O(V) edge sets."""
+    assert a.n == b.n and np.array_equal(a.pos, b.pos)
+    return build_elim_tree(np.concatenate([a.edges(), b.edges()]), a.pos)
+
+
+# --------------------------------------------------------------------------
+# tree split (SURVEY.md §2 #7)
+# --------------------------------------------------------------------------
+
+def tree_split(
+    tree: ElimTree,
+    k: int,
+    weights: Optional[np.ndarray] = None,
+    alpha: float = 1.0,
+) -> np.ndarray:
+    """Greedy k-way split of the elimination forest.
+
+    Bottom-up bag packing: walk vertices in ascending elimination order
+    (children strictly precede parents since pos[parent] > pos[child]),
+    accumulating each vertex's un-assigned subtree weight ``rem``. When a
+    vertex's accumulation reaches the bag capacity (``alpha * total/k``),
+    its un-cut child subtrees are first-fit-packed (descending) into bags of
+    at most capacity; each full bag goes to the currently least-loaded part
+    (LPT-style). Sibling subtrees in one bag are connected only through the
+    (uncut) parent, so bagging costs the same tree edges a plain subtree cut
+    would. Residue below capacity propagates upward; root residue joins the
+    least-loaded part. Invariant: every propagated ``rem`` < capacity, so no
+    bag except a single heavy vertex can exceed capacity. O(V log V).
+    """
+    n, parent, pos = tree.n, tree.parent, tree.pos
+    if weights is None:
+        weights = np.ones(n, dtype=np.int64)
+    w = weights.astype(np.float64)
+    total = float(w.sum())
+    cap = max(alpha * total / k, 1.0)
+
+    order = np.argsort(pos, kind="stable")  # ascending elimination order
+    rem = w.copy()  # un-assigned weight accumulated at each vertex
+    uncut_kids: list = [[] for _ in range(n)]  # children whose rem propagated
+    cut_part = np.full(n, -1, dtype=np.int32)
+    loads = [(0.0, p) for p in range(k)]
+    heapq.heapify(loads)
+
+    def flush(bag_vertices, bag_weight):
+        load, p = heapq.heappop(loads)
+        for x in bag_vertices:
+            cut_part[x] = p
+        heapq.heappush(loads, (load + bag_weight, p))
+
+    for v in order.tolist():
+        kids = uncut_kids[v]
+        tot = w[v] + sum(rem[c] for c in kids)
+        is_root = parent[v] < 0
+        if tot < cap and not is_root:
+            rem[v] = tot
+            uncut_kids[int(parent[v])].append(v)
+            continue
+        # pack child subtrees (each rem < cap by invariant) into bags
+        kids.sort(key=lambda c: -rem[c])
+        bag: list = []
+        bagw = 0.0
+        for c in kids:
+            if bag and bagw + rem[c] > cap:
+                flush(bag, bagw)
+                bag, bagw = [], 0.0
+            bag.append(c)
+            bagw += rem[c]
+        if is_root or bagw + w[v] >= cap:
+            # cut v itself together with the last bag
+            flush(bag + [v], bagw + w[v])
+        else:
+            # last bag stays attached to v and propagates upward
+            rem[v] = bagw + w[v]
+            uncut_kids[int(parent[v])].append(v)
+
+    # top-down labeling: nearest cut ancestor owns the vertex
+    assignment = np.full(n, -1, dtype=np.int32)
+    for v in order[::-1].tolist():
+        if cut_part[v] >= 0:
+            assignment[v] = cut_part[v]
+        else:
+            assignment[v] = assignment[parent[v]]
+    return assignment
+
+
+# --------------------------------------------------------------------------
+# scoring (SURVEY.md §2 #8, §3.4)
+# --------------------------------------------------------------------------
+
+def cut_pairs(edges: np.ndarray, assignment: np.ndarray, k: int) -> np.ndarray:
+    """Encoded (vertex * k + foreign_part) pairs for every cut edge.
+
+    Communication volume = number of *distinct* such pairs; streaming
+    callers concatenate per-chunk pair arrays and unique at the end.
+    """
+    e = np.asarray(edges).reshape(-1, 2)
+    pu = assignment[e[:, 0]]
+    pv = assignment[e[:, 1]]
+    m = (pu != pv) & (e[:, 0] != e[:, 1])
+    return np.concatenate([e[m, 0] * np.int64(k) + pv[m], e[m, 1] * np.int64(k) + pu[m]])
+
+
+def part_balance(assignment: np.ndarray, k: int, weights: Optional[np.ndarray] = None) -> float:
+    """max part load / ideal load (1.0 = perfect)."""
+    if weights is None:
+        weights = np.ones(len(assignment), dtype=np.int64)
+    loads = np.bincount(assignment, weights=weights, minlength=k)
+    return float(loads.max() / (weights.sum() / k)) if weights.sum() else 1.0
+
+
+def edge_cut_score(
+    edges: np.ndarray,
+    assignment: np.ndarray,
+    k: int,
+    weights: Optional[np.ndarray] = None,
+    comm_volume: bool = True,
+) -> Tuple[int, int, float, Optional[int]]:
+    """One streaming pass: (edge_cut, total_edges, balance, comm_volume)."""
+    e = np.asarray(edges).reshape(-1, 2)
+    nonloop = e[:, 0] != e[:, 1]
+    pu = assignment[e[:, 0]]
+    pv = assignment[e[:, 1]]
+    cut = int(np.count_nonzero((pu != pv) & nonloop))
+    total = int(nonloop.sum())
+    balance = part_balance(assignment, k, weights)
+    cv = int(len(np.unique(cut_pairs(e, assignment, k)))) if comm_volume else None
+    return cut, total, balance, cv
+
+
+# --------------------------------------------------------------------------
+# full pipeline (reference semantics for backends)
+# --------------------------------------------------------------------------
+
+def partition_arrays(
+    edges: np.ndarray, k: int, n: Optional[int] = None, weights: str = "unit"
+) -> PartitionResult:
+    e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if n is None:
+        n = int(e.max()) + 1 if len(e) else 0
+    deg = degrees(e, n)
+    pos = elimination_order(deg)
+    tree = build_elim_tree(e, pos)
+    w = deg if weights == "degree" else None
+    assignment = tree_split(tree, k, w)
+    cut, total, balance, cv = edge_cut_score(e, assignment, k, w)
+    return PartitionResult(
+        assignment=assignment,
+        k=k,
+        edge_cut=cut,
+        total_edges=total,
+        cut_ratio=cut / max(total, 1),
+        balance=balance,
+        comm_volume=cv,
+        backend="pure",
+    )

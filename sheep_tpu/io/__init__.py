@@ -1,0 +1,2 @@
+from sheep_tpu.io.edgestream import EdgeStream  # noqa: F401
+from sheep_tpu.io import formats, generators  # noqa: F401

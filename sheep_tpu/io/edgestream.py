@@ -1,0 +1,158 @@
+"""EdgeStream — chunked, shardable edge-list ingestion (SURVEY.md §2 #1).
+
+The trillion-edge contract [NORTH-STAR]: never materialize the full graph.
+Edges are read in fixed-size chunks; chunks are sharded across workers by
+round-robin on chunk index, so every worker touches a disjoint byte range
+and the union of shards is exactly the file. Device memory stays
+O(V + chunk), not O(E) — the edge stream is this workload's "long sequence"
+(SURVEY.md §5), scaled by chunking + sharding rather than ring attention.
+
+Binary files shard by byte offset (seek is free); text files stream
+line-blocks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from sheep_tpu.io import formats
+
+DEFAULT_CHUNK_EDGES = 1 << 22  # 4M edges/chunk = 64 MB of u64 pairs
+
+
+class EdgeStream:
+    """A re-openable stream of (chunk_size, 2) int64 edge arrays."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        fmt: Optional[str] = None,
+        edges: Optional[np.ndarray] = None,
+        n_vertices: Optional[int] = None,
+    ):
+        if (path is None) == (edges is None):
+            raise ValueError("exactly one of path / edges required")
+        self.path = path
+        self._edges = None if edges is None else np.asarray(edges, dtype=np.int64)
+        self.fmt = fmt or (formats.detect_format(path) if path else "memory")
+        self._n_vertices = n_vertices
+        self._n_edges: Optional[int] = None
+        if self._edges is not None:
+            self._n_edges = len(self._edges)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def open(cls, path: str, fmt: Optional[str] = None, n_vertices: Optional[int] = None) -> "EdgeStream":
+        return cls(path=path, fmt=fmt, n_vertices=n_vertices)
+
+    @classmethod
+    def from_array(cls, edges: np.ndarray, n_vertices: Optional[int] = None) -> "EdgeStream":
+        return cls(edges=edges, n_vertices=n_vertices)
+
+    # -- context manager (no persistent fd held between passes) ------------
+    def __enter__(self) -> "EdgeStream":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def num_edges(self) -> int:
+        if self._n_edges is None:
+            if self.fmt == "bin32":
+                self._n_edges = os.path.getsize(self.path) // 8
+            elif self.fmt == "bin64":
+                self._n_edges = os.path.getsize(self.path) // 16
+            else:  # text: one counting pass
+                n = 0
+                for chunk in self.chunks():
+                    n += len(chunk)
+                self._n_edges = n
+        return self._n_edges
+
+    @property
+    def num_vertices(self) -> int:
+        """max vertex id + 1; computed by a streaming pass if not provided."""
+        if self._n_vertices is None:
+            m = -1
+            for chunk in self.chunks():
+                if len(chunk):
+                    m = max(m, int(chunk.max()))
+            self._n_vertices = m + 1
+        return self._n_vertices
+
+    # -- streaming ---------------------------------------------------------
+    def chunks(
+        self,
+        chunk_edges: int = DEFAULT_CHUNK_EDGES,
+        shard: int = 0,
+        num_shards: int = 1,
+        start_chunk: int = 0,
+    ) -> Iterator[np.ndarray]:
+        """Yield (<=chunk_edges, 2) int64 arrays.
+
+        ``shard``/``num_shards`` round-robins chunks across workers;
+        ``start_chunk`` skips already-processed *global* chunk indices
+        (checkpoint/resume support, SURVEY.md §5).
+        """
+        if not (0 <= shard < num_shards):
+            raise ValueError(f"bad shard {shard}/{num_shards}")
+        if self._edges is not None:
+            yield from self._chunks_memory(chunk_edges, shard, num_shards, start_chunk)
+        elif self.fmt in ("bin32", "bin64"):
+            yield from self._chunks_binary(chunk_edges, shard, num_shards, start_chunk)
+        else:
+            yield from self._chunks_text(chunk_edges, shard, num_shards, start_chunk)
+
+    def _owns(self, idx: int, shard: int, num_shards: int, start_chunk: int) -> bool:
+        return idx >= start_chunk and idx % num_shards == shard
+
+    def _chunks_memory(self, chunk_edges, shard, num_shards, start_chunk):
+        e = self._edges
+        for idx, off in enumerate(range(0, len(e), chunk_edges)):
+            if self._owns(idx, shard, num_shards, start_chunk):
+                yield e[off : off + chunk_edges]
+
+    def _chunks_binary(self, chunk_edges, shard, num_shards, start_chunk):
+        dtype = np.dtype("<u4") if self.fmt == "bin32" else np.dtype("<u8")
+        pair_bytes = 2 * dtype.itemsize
+        total = self.num_edges
+        with open(self.path, "rb") as f:
+            for idx, off in enumerate(range(0, total, chunk_edges)):
+                if not self._owns(idx, shard, num_shards, start_chunk):
+                    continue
+                count = min(chunk_edges, total - off)
+                f.seek(off * pair_bytes)
+                flat = np.fromfile(f, dtype=dtype, count=2 * count)
+                yield flat.reshape(-1, 2).astype(np.int64, copy=False)
+
+    def _chunks_text(self, chunk_edges, shard, num_shards, start_chunk):
+        buf: list = []
+        idx = 0
+        with open(self.path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", "%")):
+                    continue
+                a, b = line.split()[:2]
+                buf.append((int(a), int(b)))
+                if len(buf) == chunk_edges:
+                    if self._owns(idx, shard, num_shards, start_chunk):
+                        yield np.asarray(buf, dtype=np.int64)
+                    buf = []
+                    idx += 1
+        if buf and self._owns(idx, shard, num_shards, start_chunk):
+            yield np.asarray(buf, dtype=np.int64)
+
+    def read_all(self) -> np.ndarray:
+        """Materialize (tests / small graphs only)."""
+        if self._edges is not None:
+            return self._edges
+        out = list(self.chunks())
+        if not out:
+            return np.zeros((0, 2), dtype=np.int64)
+        return np.concatenate(out, axis=0)

@@ -1,0 +1,111 @@
+"""On-disk graph formats (SURVEY.md §2 #2).
+
+The reference keeps formats byte-stable across backends [NORTH-STAR]; we
+define the standard interchange formats a SNAP-era partitioner consumes:
+
+- **text edge list** (``.edges``/``.txt``/``.el``): one ``u v`` pair per
+  line, whitespace separated, ``#`` comment lines ignored (SNAP style).
+- **binary edge list**: raw little-endian pairs, no header;
+  ``.bin32`` = uint32 pairs, ``.bin64`` = uint64 pairs. Offsets are stable,
+  so byte ranges shard trivially across workers/hosts.
+- **partition map**: ``.parts`` text (one part id per line, line i = vertex
+  i) or ``.pbin`` raw little-endian int32 array.
+
+All readers/writers round-trip byte-identically (golden tests in
+``tests/test_formats.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+TEXT_EXTS = (".edges", ".txt", ".el", ".snap")
+BIN32_EXTS = (".bin32", ".bin")
+BIN64_EXTS = (".bin64",)
+
+
+def detect_format(path: str) -> str:
+    ext = os.path.splitext(path)[1].lower()
+    if ext in TEXT_EXTS:
+        return "text"
+    if ext in BIN32_EXTS:
+        return "bin32"
+    if ext in BIN64_EXTS:
+        return "bin64"
+    raise ValueError(f"unknown graph format for {path!r} (ext {ext!r})")
+
+
+def read_text_edges(path: str) -> np.ndarray:
+    """Read a SNAP-style text edge list into an (E, 2) int64 array."""
+    rows = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "%")):
+                continue
+            parts = line.split()
+            rows.append((int(parts[0]), int(parts[1])))
+    if not rows:
+        return np.zeros((0, 2), dtype=np.int64)
+    return np.asarray(rows, dtype=np.int64)
+
+
+def write_text_edges(path: str, edges: np.ndarray) -> None:
+    with open(path, "w") as f:
+        for u, v in np.asarray(edges, dtype=np.int64):
+            f.write(f"{u} {v}\n")
+
+
+def read_binary_edges(path: str, dtype) -> np.ndarray:
+    flat = np.fromfile(path, dtype=dtype)
+    if flat.size % 2:
+        raise ValueError(f"{path}: odd number of ints, not an edge list")
+    return flat.reshape(-1, 2).astype(np.int64, copy=False)
+
+
+def write_binary_edges(path: str, edges: np.ndarray, dtype) -> None:
+    arr = np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), dtype=dtype)
+    arr.tofile(path)
+
+
+def read_edges(path: str, fmt: str | None = None) -> np.ndarray:
+    """Materialize the full edge list (small graphs / tests only — the
+    streaming path is :class:`sheep_tpu.io.edgestream.EdgeStream`)."""
+    fmt = fmt or detect_format(path)
+    if fmt == "text":
+        return read_text_edges(path)
+    if fmt == "bin32":
+        return read_binary_edges(path, np.dtype("<u4"))
+    if fmt == "bin64":
+        return read_binary_edges(path, np.dtype("<u8"))
+    raise ValueError(f"unknown format {fmt!r}")
+
+
+def write_edges(path: str, edges: np.ndarray, fmt: str | None = None) -> None:
+    fmt = fmt or detect_format(path)
+    if fmt == "text":
+        write_text_edges(path, edges)
+    elif fmt == "bin32":
+        write_binary_edges(path, edges, np.dtype("<u4"))
+    elif fmt == "bin64":
+        write_binary_edges(path, edges, np.dtype("<u8"))
+    else:
+        raise ValueError(f"unknown format {fmt!r}")
+
+
+def write_partition(path: str, assignment: np.ndarray) -> None:
+    if path.endswith(".pbin"):
+        np.ascontiguousarray(assignment, dtype=np.dtype("<i4")).tofile(path)
+    else:
+        with open(path, "w") as f:
+            for p in assignment:
+                f.write(f"{int(p)}\n")
+
+
+def read_partition(path: str) -> np.ndarray:
+    if path.endswith(".pbin"):
+        return np.fromfile(path, dtype=np.dtype("<i4")).astype(np.int32)
+    with open(path) as f:
+        return np.array([int(x) for x in f.read().split()], dtype=np.int32)

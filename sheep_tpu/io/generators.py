@@ -1,0 +1,116 @@
+"""Graph generators for tests, golden fixtures and scale benchmarks.
+
+SURVEY.md §4: the Zachary karate club is the first driver eval config and
+the golden-test fixture; RMAT is both eval config 5 (scale-30 synthetic)
+and the soak-test generator. All generators are deterministic under a seed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Zachary karate club, 34 vertices / 78 undirected edges (0-indexed).
+# Standard public edge list (W. W. Zachary, 1977; same set shipped by
+# networkx as karate_club_graph).
+_KARATE = [
+    (0, 1), (0, 2), (0, 3), (0, 4), (0, 5), (0, 6), (0, 7), (0, 8), (0, 10),
+    (0, 11), (0, 12), (0, 13), (0, 17), (0, 19), (0, 21), (0, 31), (1, 2),
+    (1, 3), (1, 7), (1, 13), (1, 17), (1, 19), (1, 21), (1, 30), (2, 3),
+    (2, 7), (2, 8), (2, 9), (2, 13), (2, 27), (2, 28), (2, 32), (3, 7),
+    (3, 12), (3, 13), (4, 6), (4, 10), (5, 6), (5, 10), (5, 16), (6, 16),
+    (8, 30), (8, 32), (8, 33), (9, 33), (13, 33), (14, 32), (14, 33),
+    (15, 32), (15, 33), (18, 32), (18, 33), (19, 33), (20, 32), (20, 33),
+    (22, 32), (22, 33), (23, 25), (23, 27), (23, 29), (23, 32), (23, 33),
+    (24, 25), (24, 27), (24, 31), (25, 31), (26, 29), (26, 33), (27, 33),
+    (28, 31), (28, 33), (29, 32), (29, 33), (30, 32), (30, 33), (31, 32),
+    (31, 33), (32, 33),
+]
+
+
+def karate_club() -> np.ndarray:
+    """34 v / 78 e — driver eval config 1 (BASELINE.json)."""
+    return np.asarray(_KARATE, dtype=np.int64)
+
+
+def path_graph(n: int) -> np.ndarray:
+    v = np.arange(n - 1, dtype=np.int64)
+    return np.stack([v, v + 1], axis=1)
+
+
+def star_graph(n: int) -> np.ndarray:
+    v = np.arange(1, n, dtype=np.int64)
+    return np.stack([np.zeros_like(v), v], axis=1)
+
+
+def grid_graph(rows: int, cols: int) -> np.ndarray:
+    idx = np.arange(rows * cols).reshape(rows, cols)
+    horiz = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    vert = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
+    return np.concatenate([horiz, vert]).astype(np.int64)
+
+
+def random_graph(n: int, m: int, seed: int = 0, self_loops: bool = False) -> np.ndarray:
+    """Erdos-Renyi-ish multigraph: m uniform random edges."""
+    rng = np.random.default_rng(seed)
+    e = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+    if not self_loops:
+        loops = e[:, 0] == e[:, 1]
+        e[loops, 1] = (e[loops, 1] + 1) % n
+    return e
+
+
+def _rmat_batch(scale: int, cnt: int, rng, a: float, b: float, c: float) -> np.ndarray:
+    d = 1.0 - a - b - c
+    u = np.zeros(cnt, dtype=np.int64)
+    v = np.zeros(cnt, dtype=np.int64)
+    for bit in range(scale):
+        r1 = rng.random(cnt)
+        r2 = rng.random(cnt)
+        # recursive quadrant choice: u bit then v bit conditioned on it
+        ubit = (r1 > (a + b)).astype(np.int64)
+        pv = np.where(ubit == 0, b / (a + b), d / (c + d))
+        vbit = (r2 < pv).astype(np.int64)
+        u |= ubit << bit
+        v |= vbit << bit
+    return np.stack([u, v], axis=1)
+
+
+def rmat(
+    scale: int,
+    edge_factor: int = 16,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    batch: int = 1 << 20,
+) -> np.ndarray:
+    """R-MAT generator (Chakrabarti et al. 2004), Graph500 parameters.
+
+    2**scale vertices, edge_factor * 2**scale edges. Materializes the full
+    (m, 2) output — for graphs that do not fit in RAM (e.g. driver eval
+    config 5, scale=30) use :func:`rmat_stream` instead.
+    """
+    m = edge_factor << scale
+    rng = np.random.default_rng(seed)
+    out = np.empty((m, 2), dtype=np.int64)
+    for off in range(0, m, batch):
+        cnt = min(batch, m - off)
+        out[off : off + cnt] = _rmat_batch(scale, cnt, rng, a, b, c)
+    return out
+
+
+def rmat_stream(
+    scale: int,
+    edge_factor: int = 16,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+    chunk: int = 1 << 22,
+):
+    """Yield RMAT edges chunk-by-chunk without materializing the graph."""
+    m = edge_factor << scale
+    for i, off in enumerate(range(0, m, chunk)):
+        cnt = min(chunk, m - off)
+        rng = np.random.default_rng(np.random.SeedSequence([seed, i]))
+        yield _rmat_batch(scale, cnt, rng, a, b, c)

@@ -1,0 +1,75 @@
+"""Shared result/value types for sheep_tpu.
+
+SURVEY.md §2 #7-8: the partition pipeline produces an elimination tree, a
+vertex->part assignment, and cut/balance scores. These containers are the
+common currency between backends (cpu C++ core, tpu JAX path) so the
+cross-backend equivalence tests (SURVEY.md §4.3) can compare like with like.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ElimTree:
+    """An elimination forest over a fixed global vertex order.
+
+    ``parent[v]`` is the tree parent of vertex ``v`` (-1 for roots).
+    ``pos[v]`` is the global elimination position of ``v`` (ascending degree,
+    ties by id). Invariant: ``pos[parent[v]] > pos[v]`` for every non-root —
+    parents are always eliminated later.
+    """
+
+    parent: np.ndarray  # int64[V], -1 for roots
+    pos: np.ndarray  # int64[V]
+    n: int
+
+    def validate(self) -> None:
+        p = self.parent
+        nonroot = p >= 0
+        assert p.shape == (self.n,)
+        assert np.all(p[nonroot] < self.n)
+        # parents strictly later in the elimination order => acyclic
+        assert np.all(self.pos[p[nonroot]] > self.pos[np.nonzero(nonroot)[0]]), (
+            "elimination tree has a parent earlier in the order (cycle risk)"
+        )
+
+    def edges(self) -> np.ndarray:
+        """Tree edges as an (m, 2) array — the mergeable O(V) summary of the
+        graph's connectivity process (SURVEY.md §2 #6)."""
+        v = np.nonzero(self.parent >= 0)[0]
+        return np.stack([v, self.parent[v]], axis=1)
+
+
+@dataclasses.dataclass
+class PartitionResult:
+    assignment: np.ndarray  # int32[V] vertex -> part
+    k: int
+    edge_cut: int  # edges with endpoints in different parts
+    total_edges: int
+    cut_ratio: float  # edge_cut / total_edges
+    balance: float  # max part load / ideal load
+    comm_volume: Optional[int] = None  # distinct (vertex, foreign part) pairs
+    phase_times: Dict[str, float] = dataclasses.field(default_factory=dict)
+    backend: str = ""
+
+    def validate(self, n: int) -> None:
+        a = self.assignment
+        assert a.shape == (n,)
+        assert a.min() >= 0 and a.max() < self.k, "vertex assigned out of range"
+
+    def summary(self) -> Dict:
+        return {
+            "k": self.k,
+            "edge_cut": int(self.edge_cut),
+            "total_edges": int(self.total_edges),
+            "cut_ratio": float(self.cut_ratio),
+            "balance": float(self.balance),
+            "comm_volume": None if self.comm_volume is None else int(self.comm_volume),
+            "backend": self.backend,
+            "phase_times": {k: round(v, 6) for k, v in self.phase_times.items()},
+        }

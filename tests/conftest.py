@@ -1,0 +1,13 @@
+"""Test env: force an 8-device virtual CPU mesh before jax import.
+
+SURVEY.md §4.4 — the standard JAX trick for testing multi-chip sharding
+without a TPU slice. Must run before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,67 @@
+"""EdgeStream chunking/sharding invariants (SURVEY.md §2 #1)."""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import formats, generators
+from sheep_tpu.io.edgestream import EdgeStream
+
+
+@pytest.fixture(params=[".edges", ".bin32", ".bin64"])
+def stream(request, tmp_path):
+    e = generators.random_graph(100, 997, seed=3)
+    p = str(tmp_path / f"g{request.param}")
+    formats.write_edges(p, e)
+    return EdgeStream.open(p), e
+
+
+def test_metadata(stream):
+    es, e = stream
+    assert es.num_edges == len(e)
+    assert es.num_vertices == int(e.max()) + 1
+
+
+def test_chunks_cover_exactly(stream):
+    es, e = stream
+    got = np.concatenate(list(es.chunks(chunk_edges=64)))
+    np.testing.assert_array_equal(got, e)
+
+
+def test_shards_partition_the_stream(stream):
+    """Union of shards == file, disjoint, any num_shards."""
+    es, e = stream
+    for s in (2, 3, 8):
+        parts = [list(es.chunks(chunk_edges=50, shard=i, num_shards=s)) for i in range(s)]
+        sizes = sum(len(c) for p in parts for c in p)
+        assert sizes == len(e)
+        # round-robin interleave reconstructs the exact stream
+        allchunks = [c for p in parts for c in p]
+        order = []
+        idx = [0] * s
+        n_chunks = len(allchunks)
+        rebuilt = []
+        per_shard = [p[:] for p in parts]
+        i = 0
+        while len(rebuilt) < n_chunks:
+            sh = i % s
+            if per_shard[sh]:
+                rebuilt.append(per_shard[sh].pop(0))
+            i += 1
+        np.testing.assert_array_equal(np.concatenate(rebuilt), e)
+
+
+def test_start_chunk_resume(stream):
+    es, e = stream
+    first = list(es.chunks(chunk_edges=100))
+    resumed = list(es.chunks(chunk_edges=100, start_chunk=3))
+    np.testing.assert_array_equal(
+        np.concatenate(resumed), np.concatenate(first[3:])
+    )
+
+
+def test_memory_stream():
+    e = generators.karate_club()
+    es = EdgeStream.from_array(e)
+    assert es.num_edges == 78
+    assert es.num_vertices == 34
+    np.testing.assert_array_equal(es.read_all(), e)

@@ -1,0 +1,40 @@
+"""Golden/round-trip tests for on-disk formats (SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import formats, generators
+
+
+@pytest.mark.parametrize("ext,fmt", [(".edges", "text"), (".bin32", "bin32"), (".bin64", "bin64")])
+def test_roundtrip(tmp_path, ext, fmt):
+    e = generators.karate_club()
+    p = str(tmp_path / f"g{ext}")
+    formats.write_edges(p, e)
+    assert formats.detect_format(p) == fmt
+    back = formats.read_edges(p)
+    np.testing.assert_array_equal(back, e)
+
+
+def test_text_comments_and_blanks(tmp_path):
+    p = str(tmp_path / "g.edges")
+    with open(p, "w") as f:
+        f.write("# SNAP-style comment\n\n0 1\n% matrix-market comment\n1 2\n")
+    e = formats.read_edges(p)
+    np.testing.assert_array_equal(e, [[0, 1], [1, 2]])
+
+
+def test_binary_bytes_stable(tmp_path):
+    """bin32 layout is contractual: raw LE uint32 pairs, no header."""
+    p = str(tmp_path / "g.bin32")
+    formats.write_edges(p, np.array([[1, 2], [3, 4]]))
+    raw = open(p, "rb").read()
+    assert raw == np.array([1, 2, 3, 4], dtype="<u4").tobytes()
+
+
+def test_partition_roundtrip(tmp_path):
+    a = np.array([0, 1, 1, 0, 2], dtype=np.int32)
+    for name in ("p.parts", "p.pbin"):
+        p = str(tmp_path / name)
+        formats.write_partition(p, a)
+        np.testing.assert_array_equal(formats.read_partition(p), a)
