@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Headline benchmark: edges/sec partitioned, TPU backend vs CPU baseline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is the TPU/CPU edges-per-second ratio — the north-star
+target is >=10x (BASELINE.md). Graph: RMAT (Graph500 params), k=64,
+matching the driver's streaming eval shape. Scale via SHEEP_BENCH_SCALE
+(default 22 -> 4.2M vertices, 67M edges).
+
+Secondary metrics (cut ratio parity vs CPU, per-phase times) go to stderr
+so the stdout contract stays one line.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    scale = int(os.environ.get("SHEEP_BENCH_SCALE", "22"))
+    edge_factor = int(os.environ.get("SHEEP_BENCH_EDGE_FACTOR", "16"))
+    k = int(os.environ.get("SHEEP_BENCH_K", "64"))
+
+    from sheep_tpu.io import generators
+    from sheep_tpu.io.edgestream import EdgeStream
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    t0 = time.perf_counter()
+    edges = generators.rmat(scale, edge_factor, seed=42)
+    n = 1 << scale
+    es = EdgeStream.from_array(edges, n_vertices=n)
+    m = len(edges)
+    log(f"graph: RMAT-{scale} ef={edge_factor}  V={n:,} E={m:,}  "
+        f"(gen {time.perf_counter() - t0:.1f}s)  k={k}")
+
+    # --- CPU single-socket baseline (the denominator) ---------------------
+    cpu = get_backend("cpu", chunk_edges=1 << 24)
+    t0 = time.perf_counter()
+    res_cpu = cpu.partition(es, k, comm_volume=False)
+    cpu_s = time.perf_counter() - t0
+    cpu_eps = m / cpu_s
+    log(f"cpu: {cpu_s:.2f}s = {cpu_eps / 1e6:.2f} Me/s  "
+        f"cut_ratio={res_cpu.cut_ratio:.4f} balance={res_cpu.balance:.3f} "
+        f"phases={ {p: round(s, 2) for p, s in res_cpu.phase_times.items()} }")
+
+    # --- TPU backend ------------------------------------------------------
+    if "tpu" not in list_backends():
+        log("tpu backend unavailable; reporting cpu vs itself")
+        print(json.dumps({
+            "metric": f"edges/sec partitioned (RMAT-{scale}, k={k})",
+            "value": round(cpu_eps, 1), "unit": "edges/sec", "vs_baseline": 1.0,
+        }))
+        return
+
+    tpu = get_backend("tpu", chunk_edges=min(1 << 24, m))
+    t0 = time.perf_counter()
+    res_warm = tpu.partition(es, k, comm_volume=False)  # compile warm-up
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res_tpu = tpu.partition(es, k, comm_volume=False)
+    tpu_s = time.perf_counter() - t0
+    tpu_eps = m / tpu_s
+    log(f"tpu: {tpu_s:.2f}s = {tpu_eps / 1e6:.2f} Me/s (warm-up {warm_s:.1f}s)  "
+        f"cut_ratio={res_tpu.cut_ratio:.4f} balance={res_tpu.balance:.3f} "
+        f"phases={ {p: round(s, 2) for p, s in res_tpu.phase_times.items()} }")
+    reg = (res_tpu.cut_ratio - res_cpu.cut_ratio) / max(res_cpu.cut_ratio, 1e-9)
+    log(f"edge-cut regression vs cpu: {100 * reg:+.2f}% (target <= +2%)")
+
+    print(json.dumps({
+        "metric": f"edges/sec partitioned (RMAT-{scale}, k={k}, TPU vs 1-socket CPU)",
+        "value": round(tpu_eps, 1),
+        "unit": "edges/sec",
+        "vs_baseline": round(tpu_eps / cpu_eps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,13 +26,38 @@ def partition(path, k, backend=None, **opts):
     """One-call API: partition the graph stored at *path* into *k* parts.
 
     ``backend=None`` auto-selects the best registered backend
-    (tpu > cpu > pure).
+    (tpu > cpu > pure). Constructor options of the chosen backend (e.g.
+    ``chunk_edges``, ``alpha``, ``climb_steps``) and partition options
+    (e.g. ``weights``, ``comm_volume``) are both accepted; unknown options
+    raise TypeError rather than being silently dropped.
     """
+    import inspect
+
     from sheep_tpu.io.edgestream import EdgeStream
 
     if backend is None:
         avail = list_backends()
         backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
-    be = get_backend(backend)
+
+    from sheep_tpu.backends.base import _REGISTRY
+
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(
+            f"unknown backend {backend!r}; available: {', '.join(list_backends())}"
+        )
+    def named_params(fn, skip):
+        sig = inspect.signature(fn)
+        return {name for name, p in sig.parameters.items()
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)} - skip
+
+    ctor_params = named_params(cls.__init__, {"self"})
+    part_params = named_params(cls.partition, {"self", "stream", "k"})
+    unknown = set(opts) - ctor_params - part_params
+    if unknown:
+        raise TypeError(f"unknown option(s) for backend {backend!r}: {sorted(unknown)}")
+    ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
+    part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
+    be = cls(**ctor_opts)
     with EdgeStream.open(path) as es:
-        return be.partition(es, k, **opts)
+        return be.partition(es, k, **part_opts)

@@ -1,0 +1,74 @@
+"""Native C++ CPU backend, registered as ``cpu`` (SURVEY.md §2 #11).
+
+The single-socket reference path: this is the denominator of the 10x
+edges/sec north-star target and the edge-cut baseline for the <=2%
+regression bound. Streams chunk-by-chunk through the C ABI in
+sheep_tpu/core/csrc; O(V + chunk) memory.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from sheep_tpu.backends.base import Partitioner, register
+from sheep_tpu.core import native, pure
+from sheep_tpu.types import PartitionResult
+
+if not native.available():  # pragma: no cover - toolchain missing
+    raise ImportError("native sheep_core library unavailable")
+
+
+@register
+class CpuBackend(Partitioner):
+    name = "cpu"
+
+    def __init__(self, chunk_edges: int = 1 << 22, alpha: float = 1.0):
+        self.chunk_edges = chunk_edges
+        self.alpha = alpha
+
+    def partition(self, stream, k: int, weights: str = "unit",
+                  comm_volume: bool = True, **opts) -> PartitionResult:
+        t = {}
+        t0 = time.perf_counter()
+        n = stream.num_vertices
+        deg = np.zeros(n, dtype=np.int64)
+        for chunk in stream.chunks(self.chunk_edges):
+            native.degrees(chunk, n, out=deg)
+        t["degrees"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        pos = native.elim_order(deg)
+        t["sort"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        parent = np.full(n, -1, dtype=np.int64)
+        for chunk in stream.chunks(self.chunk_edges):
+            native.build_elim_tree(chunk, pos, parent=parent)
+        t["build"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        w = deg.astype(np.float64) if weights == "degree" else None
+        assignment = native.tree_split(parent, pos, k, weights=w, alpha=self.alpha)
+        t["split"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cut = total = 0
+        cv_parts = []
+        for chunk in stream.chunks(self.chunk_edges):
+            c, tt = native.score_chunk(chunk, assignment, n)
+            cut += c
+            total += tt
+            if comm_volume:
+                cv_parts.append(native.cut_pairs(chunk, assignment, n, k))
+        cv = (int(len(np.unique(np.concatenate(cv_parts)))) if cv_parts else 0) if comm_volume else None
+        balance = pure.part_balance(assignment, k, deg if weights == "degree" else None)
+        t["score"] = time.perf_counter() - t0
+
+        return PartitionResult(
+            assignment=assignment, k=k, edge_cut=cut, total_edges=total,
+            cut_ratio=cut / max(total, 1), balance=balance,
+            comm_volume=cv if comm_volume else None,
+            phase_times=t, backend=self.name,
+        )

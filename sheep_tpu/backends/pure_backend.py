@@ -24,7 +24,8 @@ class PureBackend(Partitioner):
     def __init__(self, chunk_edges: int = 1 << 22):
         self.chunk_edges = chunk_edges
 
-    def partition(self, stream, k: int, weights: str = "unit", **opts) -> PartitionResult:
+    def partition(self, stream, k: int, weights: str = "unit",
+                  comm_volume: bool = True, **opts) -> PartitionResult:
         t = {}
         t0 = time.perf_counter()
         n = stream.num_vertices
@@ -58,8 +59,10 @@ class PureBackend(Partitioner):
             c, tt, _, _ = pure.edge_cut_score(chunk, assignment, k, comm_volume=False)
             cut += c
             total += tt
-            cv_pairs.append(pure.cut_pairs(chunk, assignment, k))
-        cv = int(len(np.unique(np.concatenate(cv_pairs)))) if cv_pairs else 0
+            if comm_volume:
+                cv_pairs.append(pure.cut_pairs(chunk, assignment, k))
+        cv = (int(len(np.unique(np.concatenate(cv_pairs)))) if cv_pairs else 0) \
+            if comm_volume else None
         balance = pure.part_balance(assignment, k, w)
         t["score"] = time.perf_counter() - t0
 

@@ -1,0 +1,143 @@
+"""JAX/TPU backend, registered as ``tpu`` (SURVEY.md §2, north star).
+
+Single-device streaming pipeline (the sharded multi-device path lives in
+``sheep_tpu/parallel``):
+
+  pass 1  degrees        scatter-add per chunk           (device)
+  sort    elim order     one int64 key sort              (device)
+  pass 2  tree build     constraint-rewrite fixpoint     (device, O(V+C) mem)
+  split   tree split     two linear passes over O(V)     (host)
+  pass 3  scoring        gathered counters               (device)
+
+All chunk steps are jitted with static shapes (last chunk padded with the
+sentinel vertex n), so the whole stream reuses one compiled program per
+phase — no recompilation across chunks (SURVEY.md §7 hard part #3).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheep_tpu.backends.base import Partitioner, register
+from sheep_tpu.ops import degrees as degrees_ops
+from sheep_tpu.ops import elim as elim_ops
+from sheep_tpu.ops import order as order_ops
+from sheep_tpu.ops import score as score_ops
+from sheep_tpu.ops import split as split_ops
+from sheep_tpu.types import PartitionResult
+
+
+def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
+    """Pad a (c, 2) chunk to (size, 2) int32 with the sentinel vertex n.
+
+    The sentinel is inert in every op: degree slot n is dropped, oriented
+    edges (n, n) are inactive, scoring treats n as invalid.
+    """
+    c = np.asarray(chunk, dtype=np.int64)
+    if np.any(c >= np.iinfo(np.int32).max):
+        raise NotImplementedError("vertex ids >= 2^31 not supported yet")
+    out = np.full((size, 2), n, dtype=np.int32)
+    out[: len(c)] = c
+    return out
+
+
+@register
+class TpuBackend(Partitioner):
+    name = "tpu"
+    supports_multidevice = False  # single-device; see sheep_tpu/parallel
+
+    def __init__(self, chunk_edges: int = 1 << 22, climb_steps: int = 4,
+                 alpha: float = 1.0):
+        self.chunk_edges = chunk_edges
+        self.climb_steps = climb_steps
+        self.alpha = alpha
+
+    def partition(self, stream, k: int, weights: str = "unit",
+                  comm_volume: bool = True, **opts) -> PartitionResult:
+        t = {}
+        cs = self.chunk_edges
+        t0 = time.perf_counter()
+        n = stream.num_vertices
+        # Device accumulation is int32; flush to a host int64 accumulator
+        # before a vertex could possibly see 2^31 endpoints, so trillion-edge
+        # streams cannot overflow (cross-chunk totals live host-side).
+        flush_every = max(1, (2**31 - 1) // max(2 * cs, 1))
+        deg_host = np.zeros(n, dtype=np.int64)
+        deg = degrees_ops.init_degrees(n)
+        since_flush = 0
+        for chunk in stream.chunks(cs):
+            deg = degrees_ops.degree_chunk(deg, pad_chunk(chunk, cs, n), n)
+            since_flush += 1
+            if since_flush >= flush_every:
+                deg_host += np.asarray(deg[:n], dtype=np.int64)
+                deg = degrees_ops.init_degrees(n)
+                since_flush = 0
+        deg_host += np.asarray(deg[:n], dtype=np.int64)
+        t["degrees"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        # positions are int32 ranks; degree values only matter ordinally, so
+        # clip the int64 totals into int32 for the device sort via rankdata
+        deg_rank = deg_host if deg_host.size == 0 or deg_host.max() < 2**31 \
+            else np.argsort(np.argsort(deg_host, kind="stable"), kind="stable")
+        deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
+        pos, order = order_ops.elimination_order(deg_dev, n)
+        pos.block_until_ready()
+        t["sort"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        minp = jnp.full(n + 1, n, dtype=jnp.int32)
+        total_rounds = 0
+        for chunk in stream.chunks(cs):
+            minp, rounds = elim_ops.build_chunk_step(
+                minp, pad_chunk(chunk, cs, n), pos, order, n,
+                climb_steps=self.climb_steps)
+            total_rounds += int(rounds)
+        minp.block_until_ready()
+        t["build"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        parent = elim_ops.minp_to_parent(minp, order, n)
+        pos_host = np.asarray(pos[:n])
+        w = deg_host.astype(np.float64) if weights == "degree" else None
+        assign_host = split_ops.tree_split_host(parent, pos_host, k, weights=w,
+                                                alpha=self.alpha)
+        assign = jnp.concatenate(
+            [jnp.asarray(assign_host, dtype=jnp.int32),
+             jnp.zeros(1, dtype=jnp.int32)])
+        t["split"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cut = total = 0
+        cv_chunks = []
+        for chunk in stream.chunks(cs):
+            padded = pad_chunk(chunk, cs, n)
+            c, tt = score_ops.score_chunk(padded, assign, n)
+            cut += int(c)
+            total += int(tt)
+            if comm_volume:
+                rows = np.asarray(score_ops.cut_pairs(padded, assign, n))
+                rows = rows[rows[:, 0] < n]
+                cv_chunks.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
+        cv = None
+        if comm_volume:
+            allk = np.concatenate(cv_chunks) if cv_chunks else np.zeros(0, np.int64)
+            cv = int(len(np.unique(allk)))
+        from sheep_tpu.core import pure
+
+        balance = pure.part_balance(assign_host, k,
+                                    deg_host if weights == "degree" else None)
+        t["score"] = time.perf_counter() - t0
+        t["fixpoint_rounds"] = float(total_rounds)
+
+        return PartitionResult(
+            assignment=assign_host, k=k, edge_cut=cut, total_edges=total,
+            cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
+            phase_times=t, backend=self.name,
+        )

@@ -1,0 +1,120 @@
+"""CLI driver (SURVEY.md §2 #12, §3.1).
+
+The reference's entry point, rebuilt:
+
+    python -m sheep_tpu.cli --input g.edges --k 64 --backend tpu \
+        --output parts.bin
+
+Prints per-phase timing and scores (edge cut, cut ratio, balance, comm
+volume) as human-readable lines plus one machine-readable JSON line, and
+writes the vertex->part map. ``--backend`` selects the execution strategy
+via the Partitioner plugin registry [NORTH-STAR].
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sheep",
+        description="TPU-native distributed graph partitioner "
+                    "(SHEEP elimination-tree algorithm)",
+    )
+    p.add_argument("--input", help="edge list (.edges/.txt text, .bin32/.bin64 binary)")
+    p.add_argument("--k", type=int, help="number of parts")
+    p.add_argument("--backend", default=None,
+                   help="execution backend (default: best available; see --list-backends)")
+    p.add_argument("--output", default=None,
+                   help="partition map output (.parts text or .pbin binary)")
+    p.add_argument("--weights", choices=["unit", "degree"], default="unit",
+                   help="vertex weights for balance (default unit)")
+    p.add_argument("--alpha", type=float, default=1.0,
+                   help="bag capacity factor for the tree split (default 1.0)")
+    p.add_argument("--chunk-edges", type=int, default=None,
+                   help="edges per streamed chunk (default backend-specific)")
+    p.add_argument("--no-comm-volume", action="store_true",
+                   help="skip communication-volume computation (saves a pass of memory)")
+    p.add_argument("--num-vertices", type=int, default=None,
+                   help="vertex count if known (skips a counting pass)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax profiler trace (tpu backend) to this dir")
+    p.add_argument("--json", action="store_true", help="print only the JSON result line")
+    p.add_argument("--list-backends", action="store_true", help="list backends and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from sheep_tpu import list_backends
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io.edgestream import EdgeStream
+    from sheep_tpu.io.formats import write_partition
+
+    if args.list_backends:
+        print(" ".join(list_backends()))
+        return 0
+    if args.input is None or args.k is None:
+        build_parser().error("--input and --k are required")
+
+    backend = args.backend
+    if backend is None:
+        avail = list_backends()
+        backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
+
+    ctor = {"alpha": args.alpha}
+    if args.chunk_edges:
+        ctor["chunk_edges"] = args.chunk_edges
+    try:
+        be = get_backend(backend, **ctor)
+    except TypeError:
+        be = get_backend(backend, **({"chunk_edges": args.chunk_edges} if args.chunk_edges else {}))
+
+    t0 = time.perf_counter()
+    with EdgeStream.open(args.input, n_vertices=args.num_vertices) as es:
+        profile = None
+        if args.profile_dir:
+            import jax
+
+            profile = jax.profiler.trace(args.profile_dir)
+            profile.__enter__()
+        try:
+            res = be.partition(es, args.k, weights=args.weights,
+                               comm_volume=not args.no_comm_volume)
+        finally:
+            if profile is not None:
+                profile.__exit__(None, None, None)
+        wall = time.perf_counter() - t0
+        n = es.num_vertices
+        m = res.total_edges
+
+    if args.output:
+        write_partition(args.output, res.assignment)
+
+    summary = res.summary()
+    summary["wall_seconds"] = round(wall, 4)
+    summary["edges_per_sec"] = round(m / wall, 1) if wall > 0 else None
+    summary["n_vertices"] = n
+    if not args.json:
+        print(f"graph: {args.input}  V={n:,}  E={m:,}")
+        print(f"backend: {res.backend}  k={res.k}")
+        for phase, secs in res.phase_times.items():
+            print(f"  {phase:>16}: {secs:.3f}s")
+        print(f"edge cut:    {res.edge_cut:,}  ({100 * res.cut_ratio:.2f}%)")
+        print(f"balance:     {res.balance:.4f}")
+        if res.comm_volume is not None:
+            print(f"comm volume: {res.comm_volume:,}")
+        print(f"wall: {wall:.2f}s  ({summary['edges_per_sec']:,.0f} edges/s)")
+        if args.output:
+            print(f"partition map written to {args.output}")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

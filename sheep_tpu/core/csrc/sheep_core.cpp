@@ -1,0 +1,329 @@
+// sheep_core — native single-socket CPU reference implementation.
+//
+// This is the rebuild of the reference's all-native C++ core
+// (SURVEY.md §2 #11: the CPU reference path is the correctness and
+// performance baseline the TPU backend is measured against). Exposed as a
+// plain C ABI (loaded from Python via ctypes — no pybind11 in this
+// environment); all buffers are caller-allocated numpy arrays.
+//
+// Algorithm notes
+// ---------------
+// The elimination-tree build uses an *incremental insertion* formulation
+// rather than Liu's sorted vertex loop: maintaining the invariant that
+// parent chains strictly increase in elimination position, inserting edge
+// (u, v) with pos[u] < pos[v] walks up u's chain; if it meets a parent
+// later than v, that parent edge is displaced and re-inserted as a new
+// constraint. At fixpoint the forest is the elimination tree of every edge
+// inserted so far, independent of insertion order — this is what makes the
+// build streamable (chunks arrive in file order) and mergeable (inserting
+// tree B's edges into tree A == T(A ∪ B)), per the SHEEP paper's
+// partial-tree merge property (SURVEY.md §2 #6).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <numeric>
+#include <queue>
+#include <vector>
+
+using i64 = int64_t;
+using i32 = int32_t;
+
+extern "C" {
+
+// ---------------------------------------------------------------- degrees
+
+// deg[v] += occurrences of v as an endpoint (self-loops count twice).
+// Caller zero-initializes deg for the first chunk.
+void sheep_degrees(const i64* edges, i64 m, i64 n, i64* deg) {
+  for (i64 i = 0; i < 2 * m; ++i) {
+    i64 v = edges[i];
+    if (v >= 0 && v < n) deg[v]++;
+  }
+}
+
+// ---------------------------------------------------------- elim ordering
+
+// pos[v] = rank of v under (degree asc, id asc) — the global elimination
+// order every backend shares (SURVEY.md §2 #3).
+void sheep_elim_order(const i64* deg, i64 n, i64* pos) {
+  std::vector<i64> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](i64 a, i64 b) {
+    if (deg[a] != deg[b]) return deg[a] < deg[b];
+    return a < b;
+  });
+  for (i64 r = 0; r < n; ++r) pos[order[r]] = r;
+}
+
+// ------------------------------------------------------- elim tree build
+
+// Insert one connectivity constraint "u ~ v from time pos[v] on"
+// (pos[u] < pos[v] required). Climbs are amortized short because the
+// low-degree-first order keeps elimination trees shallow on real graphs.
+static inline void insert_edge(i64 u, i64 v, const i64* pos, i64* parent) {
+  while (true) {
+    if (u == v) return;
+    i64 p = parent[u];
+    if (p < 0) {            // u was a root: v becomes its parent
+      parent[u] = v;
+      return;
+    }
+    if (p == v) return;     // constraint already present
+    if (pos[p] < pos[v]) {  // u~p strictly earlier: constraint reduces to (p, v)
+      u = p;
+    } else {                // p later than v: v displaces p, re-insert (v, p)
+      parent[u] = v;
+      u = v;
+      v = p;
+    }
+  }
+}
+
+// Build/extend the elimination forest from an edge chunk.
+//
+// Liu's sorted union-find pass over (carried tree edges ∪ chunk edges):
+// counting-sort constraints by key = pos of the later endpoint, then for
+// each in ascending key order link find(lo) under the key vertex. Path
+// compression + the shallow low-degree-first trees make the DSU pass
+// effectively linear; cost per chunk is O(V + C), so callers should use
+// large chunks (the Python backend defaults to multi-million-edge chunks).
+//
+// The incremental insert_edge path above stays for small tree merges,
+// where the O(V) sort setup would dominate.
+void sheep_build_elim_tree(const i64* edges, i64 m, const i64* pos, i64 n,
+                           i64* parent) {
+  // order[p] = vertex at position p
+  std::vector<i64> order(n);
+  for (i64 v = 0; v < n; ++v) order[pos[v]] = v;
+
+  // constraints: (key, lo). Tree edges contribute (pos[parent[v]], v).
+  // Counting sort by key.
+  std::vector<i64> counts(n + 1, 0);
+  auto key_of = [&](i64 a, i64 b) { return std::max(pos[a], pos[b]); };
+  for (i64 v = 0; v < n; ++v)
+    if (parent[v] >= 0) counts[pos[parent[v]]]++;
+  for (i64 i = 0; i < m; ++i) {
+    i64 a = edges[2 * i], b = edges[2 * i + 1];
+    if (a == b || a < 0 || b < 0 || a >= n || b >= n) continue;
+    counts[key_of(a, b)]++;
+  }
+  i64 total = 0;
+  for (i64 p = 0; p <= n; ++p) {
+    i64 c = counts[p];
+    counts[p] = total;
+    total += c;
+  }
+  std::vector<i64> keys(total), los(total);
+  auto place = [&](i64 lo, i64 k) {
+    i64 at = counts[k]++;
+    keys[at] = k;
+    los[at] = lo;
+  };
+  for (i64 v = 0; v < n; ++v)
+    if (parent[v] >= 0) place(v, pos[parent[v]]);
+  for (i64 i = 0; i < m; ++i) {
+    i64 a = edges[2 * i], b = edges[2 * i + 1];
+    if (a == b || a < 0 || b < 0 || a >= n || b >= n) continue;
+    if (pos[a] > pos[b]) std::swap(a, b);
+    place(a, pos[b]);
+  }
+
+  // Liu's pass: fresh DSU; root of a merged component = its latest vertex.
+  std::vector<i64> dsu(n);
+  std::iota(dsu.begin(), dsu.end(), 0);
+  auto find = [&](i64 x) {
+    i64 root = x;
+    while (dsu[root] != root) root = dsu[root];
+    while (dsu[x] != root) {
+      i64 nx = dsu[x];
+      dsu[x] = root;
+      x = nx;
+    }
+    return root;
+  };
+  for (i64 i = 0; i < total; ++i) {
+    i64 hi = order[keys[i]];
+    i64 r = find(los[i]);
+    if (r != hi) {
+      parent[r] = hi;
+      dsu[r] = hi;
+    }
+  }
+}
+
+// Merge partial forest `other` into `parent` (associative, commutative):
+// T(A ∪ B) by inserting B's tree edges into A.
+void sheep_merge_trees(i64* parent, const i64* other, const i64* pos, i64 n) {
+  for (i64 v = 0; v < n; ++v) {
+    if (other[v] >= 0) insert_edge(v, other[v], pos, parent);
+  }
+}
+
+// ------------------------------------------------------------ tree split
+
+// Greedy bag-packing split — the same semantics as the Python reference
+// (sheep_tpu/core/pure.py tree_split): walk vertices in ascending
+// elimination order accumulating un-assigned subtree weight; at capacity,
+// first-fit-pack child subtrees (descending) into <=cap bags handed to the
+// least-loaded part. See that docstring for the invariants.
+void sheep_tree_split(const i64* parent, const i64* pos, const double* w,
+                      i64 n, i64 k, double alpha, i32* assign) {
+  std::vector<i64> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(),
+            [&](i64 a, i64 b) { return pos[a] < pos[b]; });
+
+  double total = 0;
+  for (i64 v = 0; v < n; ++v) total += w[v];
+  double cap = std::max(alpha * total / double(k), 1.0);
+
+  std::vector<double> rem(n);
+  for (i64 v = 0; v < n; ++v) rem[v] = w[v];
+  std::vector<std::vector<i64>> uncut_kids(n);
+  std::vector<i32> cut_part(n, -1);
+
+  // least-loaded part heap: (load, part), min by load then part id
+  using Entry = std::pair<double, i64>;
+  std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> loads;
+  for (i64 p = 0; p < k; ++p) loads.push({0.0, p});
+
+  auto flush = [&](const std::vector<i64>& bag, i64 extra, double bagw) {
+    Entry e = loads.top();
+    loads.pop();
+    for (i64 x : bag) cut_part[x] = (i32)e.second;
+    if (extra >= 0) cut_part[extra] = (i32)e.second;
+    loads.push({e.first + bagw, e.second});
+  };
+
+  std::vector<i64> bag;
+  for (i64 idx = 0; idx < n; ++idx) {
+    i64 v = order[idx];
+    auto& kids = uncut_kids[v];
+    double tot = w[v];
+    for (i64 c : kids) tot += rem[c];
+    bool is_root = parent[v] < 0;
+    if (tot < cap && !is_root) {
+      rem[v] = tot;
+      uncut_kids[parent[v]].push_back(v);
+      std::vector<i64>().swap(kids);
+      continue;
+    }
+    std::sort(kids.begin(), kids.end(),
+              [&](i64 a, i64 b) { return rem[a] > rem[b]; });
+    bag.clear();
+    double bagw = 0.0;
+    for (i64 c : kids) {
+      if (!bag.empty() && bagw + rem[c] > cap) {
+        flush(bag, -1, bagw);
+        bag.clear();
+        bagw = 0.0;
+      }
+      bag.push_back(c);
+      bagw += rem[c];
+    }
+    if (is_root || bagw + w[v] >= cap) {
+      flush(bag, v, bagw + w[v]);
+    } else {
+      rem[v] = bagw + w[v];
+      uncut_kids[parent[v]].push_back(v);
+    }
+    std::vector<i64>().swap(kids);
+  }
+
+  // top-down labeling: nearest cut ancestor owns the vertex
+  for (i64 idx = n - 1; idx >= 0; --idx) {
+    i64 v = order[idx];
+    assign[v] = cut_part[v] >= 0 ? cut_part[v]
+                                 : (parent[v] >= 0 ? assign[parent[v]] : 0);
+  }
+}
+
+// --------------------------------------------------------------- scoring
+
+// One pass over a chunk: cut/total counters accumulate (caller zeroes
+// before the first chunk), per-part loads accumulate into loads[k].
+void sheep_score_chunk(const i64* edges, i64 m, const i32* assign, i64 n,
+                       i64* cut, i64* total) {
+  i64 c = 0, t = 0;
+  for (i64 i = 0; i < m; ++i) {
+    i64 a = edges[2 * i], b = edges[2 * i + 1];
+    if (a == b || a < 0 || b < 0 || a >= n || b >= n) continue;
+    t++;
+    if (assign[a] != assign[b]) c++;
+  }
+  *cut += c;
+  *total += t;
+}
+
+// Write encoded (vertex * k + foreign_part) pairs for cut edges in the
+// chunk into out (caller provides 2*m capacity); returns count written.
+// Comm volume = unique count across all chunks (done host-side).
+i64 sheep_cut_pairs(const i64* edges, i64 m, const i32* assign, i64 n, i64 k,
+                    i64* out) {
+  i64 w = 0;
+  for (i64 i = 0; i < m; ++i) {
+    i64 a = edges[2 * i], b = edges[2 * i + 1];
+    if (a == b || a < 0 || b < 0 || a >= n || b >= n) continue;
+    i32 pa = assign[a], pb = assign[b];
+    if (pa != pb) {
+      out[w++] = a * k + pb;
+      out[w++] = b * k + pa;
+    }
+  }
+  return w;
+}
+
+// ----------------------------------------------------- text edge parsing
+
+// Fast SNAP-style text parser: consumes complete "u v" lines from buf,
+// skipping '#'/'%' comments and blanks. Returns edges written; *consumed =
+// bytes of buf fully processed (caller re-feeds the tail + next block).
+i64 sheep_parse_text(const char* buf, i64 len, i64* out, i64 max_edges,
+                     i64* consumed) {
+  i64 w = 0;
+  i64 i = 0;
+  *consumed = 0;
+  while (i < len && w < max_edges) {
+    i64 line_start = i;
+    // find end of line
+    i64 j = i;
+    while (j < len && buf[j] != '\n') j++;
+    if (j == len) break;  // incomplete line: leave for next block
+    // parse the line
+    i64 p = i;
+    while (p < j && (buf[p] == ' ' || buf[p] == '\t' || buf[p] == '\r')) p++;
+    if (p < j && buf[p] != '#' && buf[p] != '%') {
+      i64 u = 0, v = 0;
+      bool ok = false;
+      while (p < j && buf[p] >= '0' && buf[p] <= '9') {
+        u = u * 10 + (buf[p] - '0');
+        p++;
+        ok = true;
+      }
+      while (p < j && (buf[p] == ' ' || buf[p] == '\t')) p++;
+      bool ok2 = false;
+      while (p < j && buf[p] >= '0' && buf[p] <= '9') {
+        v = v * 10 + (buf[p] - '0');
+        p++;
+        ok2 = true;
+      }
+      if (ok && ok2) {
+        out[2 * w] = u;
+        out[2 * w + 1] = v;
+        w++;
+      }
+    }
+    i = j + 1;
+    *consumed = i;
+    (void)line_start;
+  }
+  return w;
+}
+
+// ------------------------------------------------------------- utilities
+
+i64 sheep_core_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,160 @@
+"""ctypes loader for the native CPU core (sheep_tpu/core/csrc).
+
+pybind11 is not available in this environment, so the C++ core exposes a
+plain C ABI over caller-allocated numpy buffers. The library is built
+lazily with make on first use; failure to build leaves the ``cpu`` backend
+unregistered (callers fall back to ``pure``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_SO = os.path.join(_CSRC, "libsheep_core.so")
+_lib: Optional[ctypes.CDLL] = None
+
+_i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+
+
+def _build() -> None:
+    src = os.path.join(_CSRC, "sheep_core.cpp")
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
+        return
+    subprocess.run(
+        ["make", "-C", _CSRC],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def load() -> ctypes.CDLL:
+    """Build if needed and load the native library (cached)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    _build()
+    lib = ctypes.CDLL(_SO)
+
+    lib.sheep_core_abi_version.restype = ctypes.c_int64
+    if lib.sheep_core_abi_version() != 1:
+        raise RuntimeError("libsheep_core ABI mismatch; run make clean")
+
+    c_i64 = ctypes.c_int64
+    lib.sheep_degrees.argtypes = [_i64p, c_i64, c_i64, _i64p]
+    lib.sheep_elim_order.argtypes = [_i64p, c_i64, _i64p]
+    lib.sheep_build_elim_tree.argtypes = [_i64p, c_i64, _i64p, c_i64, _i64p]
+    lib.sheep_merge_trees.argtypes = [_i64p, _i64p, _i64p, c_i64]
+    lib.sheep_tree_split.argtypes = [_i64p, _i64p, _f64p, c_i64, c_i64, ctypes.c_double, _i32p]
+    lib.sheep_score_chunk.argtypes = [_i64p, c_i64, _i32p, c_i64,
+                                      ctypes.POINTER(c_i64), ctypes.POINTER(c_i64)]
+    lib.sheep_cut_pairs.argtypes = [_i64p, c_i64, _i32p, c_i64, c_i64, _i64p]
+    lib.sheep_cut_pairs.restype = c_i64
+    lib.sheep_parse_text.argtypes = [ctypes.c_char_p, c_i64, _i64p, c_i64,
+                                     ctypes.POINTER(c_i64)]
+    lib.sheep_parse_text.restype = c_i64
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------- wrappers
+
+def _edges64(edges: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), dtype=np.int64)
+
+
+def degrees(edges: np.ndarray, n: int, out: Optional[np.ndarray] = None) -> np.ndarray:
+    lib = load()
+    e = _edges64(edges)
+    if out is None:
+        out = np.zeros(n, dtype=np.int64)
+    lib.sheep_degrees(e, len(e), n, out)
+    return out
+
+
+def elim_order(deg: np.ndarray) -> np.ndarray:
+    lib = load()
+    d = np.ascontiguousarray(deg, dtype=np.int64)
+    pos = np.empty(len(d), dtype=np.int64)
+    lib.sheep_elim_order(d, len(d), pos)
+    return pos
+
+
+def build_elim_tree(edges: np.ndarray, pos: np.ndarray,
+                    parent: Optional[np.ndarray] = None) -> np.ndarray:
+    lib = load()
+    e = _edges64(edges)
+    p = np.ascontiguousarray(pos, dtype=np.int64)
+    if parent is None:
+        parent = np.full(len(p), -1, dtype=np.int64)
+    else:
+        parent = np.ascontiguousarray(parent, dtype=np.int64)
+    lib.sheep_build_elim_tree(e, len(e), p, len(p), parent)
+    return parent
+
+
+def merge_trees(parent: np.ndarray, other: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    lib = load()
+    parent = np.ascontiguousarray(parent, dtype=np.int64)
+    lib.sheep_merge_trees(parent, np.ascontiguousarray(other, dtype=np.int64),
+                          np.ascontiguousarray(pos, dtype=np.int64), len(parent))
+    return parent
+
+
+def tree_split(parent: np.ndarray, pos: np.ndarray, k: int,
+               weights: Optional[np.ndarray] = None, alpha: float = 1.0) -> np.ndarray:
+    lib = load()
+    n = len(parent)
+    w = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
+    assign = np.empty(n, dtype=np.int32)
+    lib.sheep_tree_split(
+        np.ascontiguousarray(parent, dtype=np.int64),
+        np.ascontiguousarray(pos, dtype=np.int64),
+        np.ascontiguousarray(w, dtype=np.float64), n, k, alpha, assign)
+    return assign
+
+
+def score_chunk(edges: np.ndarray, assign: np.ndarray, n: int):
+    lib = load()
+    e = _edges64(edges)
+    cut = ctypes.c_int64(0)
+    total = ctypes.c_int64(0)
+    lib.sheep_score_chunk(e, len(e), np.ascontiguousarray(assign, dtype=np.int32),
+                          n, ctypes.byref(cut), ctypes.byref(total))
+    return cut.value, total.value
+
+
+def cut_pairs(edges: np.ndarray, assign: np.ndarray, n: int, k: int) -> np.ndarray:
+    lib = load()
+    e = _edges64(edges)
+    out = np.empty(2 * len(e), dtype=np.int64)
+    cnt = lib.sheep_cut_pairs(e, len(e), np.ascontiguousarray(assign, dtype=np.int32),
+                              n, k, out)
+    return out[:cnt]
+
+
+def parse_text(data: bytes, max_edges: Optional[int] = None):
+    """Parse complete 'u v' lines from a byte block -> (edges, bytes_consumed)."""
+    lib = load()
+    cap = max_edges if max_edges is not None else len(data) // 3 + 1
+    out = np.empty((cap, 2), dtype=np.int64)
+    consumed = ctypes.c_int64(0)
+    cnt = lib.sheep_parse_text(data, len(data), out.reshape(-1), cap,
+                               ctypes.byref(consumed))
+    return out[:cnt].copy(), consumed.value

@@ -131,15 +131,62 @@ class EdgeStream:
                 yield flat.reshape(-1, 2).astype(np.int64, copy=False)
 
     def _chunks_text(self, chunk_edges, shard, num_shards, start_chunk):
+        try:
+            from sheep_tpu.core import native
+
+            if native.available():
+                yield from self._chunks_text_native(
+                    native, chunk_edges, shard, num_shards, start_chunk)
+                return
+        except Exception:
+            pass
+        yield from self._chunks_text_python(chunk_edges, shard, num_shards, start_chunk)
+
+    def _chunks_text_native(self, native, chunk_edges, shard, num_shards, start_chunk):
+        """Block-wise parse via the C parser (~10x the Python loop). Malformed
+        lines are skipped — the same policy as the Python path."""
+        pend: list = []
+        pend_n = 0
+        idx = 0
+        tail = b""
+        with open(self.path, "rb") as f:
+            while True:
+                block = f.read(1 << 24)
+                data = tail + block
+                if not data:
+                    break
+                if block:
+                    edges, consumed = native.parse_text(data)
+                    tail = data[consumed:]
+                else:  # final partial line (no trailing newline)
+                    edges, _ = native.parse_text(data + b"\n")
+                    tail = b""
+                pend.append(edges)
+                pend_n += len(edges)
+                while pend_n >= chunk_edges:
+                    cat = np.concatenate(pend)
+                    if self._owns(idx, shard, num_shards, start_chunk):
+                        yield cat[:chunk_edges]
+                    pend = [cat[chunk_edges:]]
+                    pend_n = len(pend[0])
+                    idx += 1
+                if not block:
+                    break
+        rest = np.concatenate(pend) if pend else np.zeros((0, 2), np.int64)
+        if len(rest) and self._owns(idx, shard, num_shards, start_chunk):
+            yield rest
+
+    def _chunks_text_python(self, chunk_edges, shard, num_shards, start_chunk):
+        from sheep_tpu.io.formats import parse_text_line
+
         buf: list = []
         idx = 0
         with open(self.path, "r") as f:
             for line in f:
-                line = line.strip()
-                if not line or line.startswith(("#", "%")):
+                pair = parse_text_line(line)
+                if pair is None:
                     continue
-                a, b = line.split()[:2]
-                buf.append((int(a), int(b)))
+                buf.append(pair)
                 if len(buf) == chunk_edges:
                     if self._owns(idx, shard, num_shards, start_chunk):
                         yield np.asarray(buf, dtype=np.int64)

@@ -37,16 +37,32 @@ def detect_format(path: str) -> str:
     raise ValueError(f"unknown graph format for {path!r} (ext {ext!r})")
 
 
+def parse_text_line(line: str):
+    """Parse one edge-list line -> (u, v) or None.
+
+    Policy (matches the native parser sheep_parse_text): comments
+    (#/%), blanks, and malformed lines are skipped, extra columns ignored.
+    """
+    line = line.strip()
+    if not line or line.startswith(("#", "%")):
+        return None
+    parts = line.split()
+    if len(parts) < 2:
+        return None
+    try:
+        return int(parts[0]), int(parts[1])
+    except ValueError:
+        return None
+
+
 def read_text_edges(path: str) -> np.ndarray:
     """Read a SNAP-style text edge list into an (E, 2) int64 array."""
     rows = []
     with open(path, "r") as f:
         for line in f:
-            line = line.strip()
-            if not line or line.startswith(("#", "%")):
-                continue
-            parts = line.split()
-            rows.append((int(parts[0]), int(parts[1])))
+            pair = parse_text_line(line)
+            if pair is not None:
+                rows.append(pair)
     if not rows:
         return np.zeros((0, 2), dtype=np.int64)
     return np.asarray(rows, dtype=np.int64)
@@ -66,8 +82,14 @@ def read_binary_edges(path: str, dtype) -> np.ndarray:
 
 
 def write_binary_edges(path: str, edges: np.ndarray, dtype) -> None:
-    arr = np.ascontiguousarray(np.asarray(edges).reshape(-1, 2), dtype=dtype)
-    arr.tofile(path)
+    e = np.asarray(edges).reshape(-1, 2)
+    info = np.iinfo(dtype)
+    if len(e) and (e.min() < info.min or e.max() > info.max):
+        raise ValueError(
+            f"vertex id out of range for {dtype}: "
+            f"[{e.min()}, {e.max()}] vs [{info.min}, {info.max}]"
+        )
+    np.ascontiguousarray(e, dtype=dtype).tofile(path)
 
 
 def read_edges(path: str, fmt: str | None = None) -> np.ndarray:

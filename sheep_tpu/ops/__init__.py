@@ -1,0 +1,1 @@
+from sheep_tpu.ops import degrees, elim, order, score, split  # noqa: F401

@@ -1,0 +1,26 @@
+"""Degree accumulation on device (SURVEY.md §2 #3).
+
+Endpoint-count degrees via scatter-add — XLA lowers ``.at[].add`` to an
+efficient sorted segment update on TPU. Padding convention: edges padded
+with endpoint == n land in an extra slot that is dropped by the caller.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("n",))
+def degree_chunk(deg: jax.Array, edges: jax.Array, n: int) -> jax.Array:
+    """Accumulate endpoint counts of one (C, 2) chunk into deg (int32[n+1]).
+
+    Slot n absorbs padding; self-loops count twice (matches the CPU core).
+    """
+    idx = jnp.clip(edges.reshape(-1), 0, n)
+    return deg.at[idx].add(1, mode="drop")
+
+
+def init_degrees(n: int) -> jax.Array:
+    return jnp.zeros(n + 1, dtype=jnp.int32)

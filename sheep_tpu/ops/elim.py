@@ -1,0 +1,178 @@
+"""Elimination-tree build as a data-parallel fixpoint (SURVEY.md §2 #4-6).
+
+This is the TPU answer to the reference's sequential union-find hot loop
+(SURVEY.md §7 hard part #1). Instead of pointer-chasing per edge, the build
+is a *constraint-rewriting fixpoint* over the whole edge set:
+
+    invariant  pos[lo] < pos[hi] for every active edge (lo, hi)
+    round:
+      minp[x]  = min over active edges at lo=x of pos[hi]   (scatter-min)
+      m[x]     = order[minp[x]]   (x's current best parent candidate)
+      rewrite  every non-min edge (x, v) -> (m[x], v)       (gather)
+    at fixpoint every active edge is its lo's min edge, and
+    parent[x] = m[x] is exactly the elimination tree.
+
+Soundness of the rewrite: the min edge (x, m[x]) always stays in the set,
+and given u~m[x] from time pos[m[x]] < pos[v], the constraint "u~v from
+time pos[v]" is equivalent to "m[x]~v from time pos[v]". The fixpoint is
+therefore the unique elimination forest of the inserted edge multiset,
+regardless of edge order — the same argument that makes the C++ core's
+incremental insertion (core/csrc/sheep_core.cpp) correct, vectorized.
+
+Every operation is a flat gather / scatter-min over static shapes: no
+data-dependent shapes, no host round-trips; the loop is a
+``lax.while_loop`` whose trip count is the fill-path depth (shallow for
+low-degree-first orders on real graphs). ``climb_steps`` gather-only
+sub-steps per round let an edge jump several tree levels per scatter,
+cutting round count on deep trees.
+
+Sentinel encoding: index ``n`` means "none"; ``pos[n] = n`` acts as +inf,
+``order[n] = n``. Inactive/padding edges are (n, n).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NO_PARENT = -1
+
+
+@partial(jax.jit, static_argnames=("n",))
+def orient_edges(edges: jax.Array, pos: jax.Array, n: int):
+    """(C,2) int32 edges -> (lo, hi) with pos[lo] < pos[hi]; self-loops and
+    out-of-range/padding endpoints become inactive (n, n)."""
+    e = edges.astype(jnp.int32)
+    u = jnp.clip(e[:, 0], 0, n)
+    v = jnp.clip(e[:, 1], 0, n)
+    pu, pv = pos[u], pos[v]
+    lo = jnp.where(pu <= pv, u, v)
+    hi = jnp.where(pu <= pv, v, u)
+    bad = (lo == hi) | (pos[lo] == pos[hi])  # self-loop or both-sentinel
+    lo = jnp.where(bad, n, lo)
+    hi = jnp.where(bad, n, hi)
+    return lo, hi
+
+
+@partial(jax.jit, static_argnames=("n", "climb_steps", "max_rounds"))
+def elim_fixpoint(
+    lo: jax.Array,
+    hi: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    climb_steps: int = 4,
+    max_rounds: int = 1 << 20,
+):
+    """Run the rewrite fixpoint; returns (minp int32[n+1], rounds int32).
+
+    minp[x] = elimination position of x's parent (n = root/no parent).
+    """
+    inf = jnp.int32(n)
+
+    def scatter_min(lo_, poshi_):
+        return jnp.full(n + 1, inf, dtype=jnp.int32).at[lo_].min(poshi_, mode="drop")
+
+    def body(state):
+        lo_, hi_, _, rounds = state
+        poshi = pos[hi_]
+        minp = scatter_min(lo_, poshi)
+        mvert = order[minp]
+        # climb: jump lo up its current parent-estimate chain while the
+        # ancestor is still earlier than hi (gather-only, no scatter)
+        new_lo = lo_
+        for _ in range(climb_steps):
+            cand_pos = minp[new_lo]  # pos of new_lo's current best parent
+            can_climb = cand_pos < poshi
+            new_lo = jnp.where(can_climb, mvert[new_lo], new_lo)
+        # edge became its lo's min edge or a self-loop -> deactivate
+        became_loop = new_lo == hi_
+        new_lo = jnp.where(became_loop, n, new_lo)
+        new_hi = jnp.where(became_loop, n, hi_)
+        changed = jnp.any(new_lo != lo_)
+        return new_lo, new_hi, changed, rounds + 1
+
+    def cond(state):
+        _, _, changed, rounds = state
+        return changed & (rounds < max_rounds)
+
+    state = (lo, hi, jnp.bool_(True), jnp.int32(0))
+    lo_f, hi_f, _, rounds = lax.while_loop(cond, body, state)
+    minp = scatter_min(lo_f, pos[hi_f])
+    return minp, rounds
+
+
+def tree_edges_from_parent(parent_pos: jax.Array, order: jax.Array, n: int):
+    """parent_pos (minp) int32[n+1] -> (lo, hi) arrays of the forest edges,
+    inactive slots as (n, n). lo = vertex, hi = its parent."""
+    v = jnp.arange(n + 1, dtype=jnp.int32)
+    has = parent_pos < n
+    lo = jnp.where(has, v, n)
+    hi = jnp.where(has, order[parent_pos], n)
+    return lo, hi
+
+
+@partial(jax.jit, static_argnames=("n", "climb_steps"))
+def build_chunk_step(
+    parent_pos: jax.Array,
+    chunk: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    climb_steps: int = 4,
+):
+    """One streaming step: fold a (C, 2) edge chunk into the carried forest.
+
+    parent_pos is the minp encoding (int32[n+1], n = no parent). By the
+    merge identity T(G1 ∪ G2) = T(T(G1) ∪ T(G2)), folding the chunk into
+    the existing forest's edges yields the forest of all edges seen so far.
+    Device memory is O(V + C) — the edge stream never materializes.
+    """
+    tlo, thi = tree_edges_from_parent(parent_pos, order, n)
+    clo, chi = orient_edges(chunk, pos, n)
+    lo = jnp.concatenate([tlo, clo])
+    hi = jnp.concatenate([thi, chi])
+    minp, rounds = elim_fixpoint(lo, hi, pos, order, n, climb_steps=climb_steps)
+    return minp, rounds
+
+
+@partial(jax.jit, static_argnames=("n", "climb_steps"))
+def merge_forests(
+    a_pos: jax.Array, b_pos: jax.Array, pos: jax.Array, order: jax.Array,
+    n: int, climb_steps: int = 4,
+):
+    """Associative merge of two forests in minp encoding (SURVEY.md §2 #6).
+
+    This is the cross-shard/device reduction: each forest is O(V), so a
+    log2(D) ppermute reduction moves O(V log D) bytes over ICI."""
+    alo, ahi = tree_edges_from_parent(a_pos, order, n)
+    blo, bhi = tree_edges_from_parent(b_pos, order, n)
+    lo = jnp.concatenate([alo, blo])
+    hi = jnp.concatenate([ahi, bhi])
+    minp, _ = elim_fixpoint(lo, hi, pos, order, n, climb_steps=climb_steps)
+    return minp
+
+
+def minp_to_parent(minp, order, n):
+    """minp encoding -> parent array (int64[n], -1 for roots) on host."""
+    import numpy as np
+
+    minp = np.asarray(minp[:n])
+    order = np.asarray(order)
+    parent = np.where(minp < n, order[np.minimum(minp, n)], NO_PARENT)
+    return parent.astype(np.int64)
+
+
+def parent_to_minp(parent, pos, n):
+    """parent array (int[n], -1 roots) -> device minp encoding int32[n+1]."""
+    import numpy as np
+
+    parent = np.asarray(parent)
+    pos = np.asarray(pos)
+    minp = np.full(n + 1, n, dtype=np.int32)
+    has = parent >= 0
+    minp[:n][has] = pos[parent[has]]
+    return jnp.asarray(minp)

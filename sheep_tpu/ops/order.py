@@ -1,0 +1,31 @@
+"""Global elimination order on device (SURVEY.md §2 #3).
+
+Vertices sorted by (degree asc, id asc). The id tie-break makes the order a
+pure function of the global degree table, so every device/host derives the
+identical order — the precondition for partial-tree mergeability.
+
+A single *stable* int32 sort suffices: stable argsort over degrees breaks
+ties by original index, i.e. by id — no 64-bit composite key needed, which
+keeps the op fast on TPU (int64 is emulated there).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def elimination_order(deg: jax.Array, n: int):
+    """deg: int[>=n] -> (pos int32[n+1], order int32[n+1]).
+
+    pos[v] = elimination rank of v; order[p] = vertex at rank p. Both carry
+    a sentinel slot at index n (pos[n] = n, order[n] = n) used by the
+    elimination fixpoint as the "no vertex / +inf position" encoding.
+    """
+    order = jnp.argsort(deg[:n], stable=True).astype(jnp.int32)
+    pos = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    sentinel = jnp.array([n], dtype=jnp.int32)
+    return jnp.concatenate([pos, sentinel]), jnp.concatenate([order, sentinel])

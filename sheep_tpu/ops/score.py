@@ -1,0 +1,48 @@
+"""Edge assignment + scoring on device (SURVEY.md §2 #8, §3.4).
+
+One gathered pass per chunk: part lookups for both endpoints, predicated
+counter reductions. All device arithmetic is int32 (int64 is emulated on
+TPU); per-chunk counters are exact because chunks are < 2^31 edges, and
+cross-chunk accumulation happens in host Python ints / numpy int64.
+Multi-device reductions are a ``psum`` in the sharded pipeline.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def score_chunk(edges: jax.Array, assign: jax.Array, n: int):
+    """(cut, total) int32 counts for one (C, 2) chunk.
+
+    assign is int32[n+1] (sentinel slot ignored). Padding = endpoints
+    outside [0, n)."""
+    e = edges.astype(jnp.int32)
+    u, v = e[:, 0], e[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
+    pu = assign[jnp.clip(u, 0, n)]
+    pv = assign[jnp.clip(v, 0, n)]
+    cut = jnp.sum(valid & (pu != pv), dtype=jnp.int32)
+    total = jnp.sum(valid, dtype=jnp.int32)
+    return cut, total
+
+
+@partial(jax.jit, static_argnames=("n",))
+def cut_pairs(edges: jax.Array, assign: jax.Array, n: int):
+    """(2C, 2) int32 [vertex, foreign_part] rows for cut edges; non-cut and
+    padding rows are the sentinel (n, 0). Comm volume = number of distinct
+    non-sentinel rows across all chunks (uniqued host-side in int64)."""
+    e = edges.astype(jnp.int32)
+    u, v = e[:, 0], e[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
+    pu = assign[jnp.clip(u, 0, n)]
+    pv = assign[jnp.clip(v, 0, n)]
+    is_cut = valid & (pu != pv)
+    sent_v = jnp.int32(n)
+    row_u = jnp.stack([jnp.where(is_cut, u, sent_v), jnp.where(is_cut, pv, 0)], axis=1)
+    row_v = jnp.stack([jnp.where(is_cut, v, sent_v), jnp.where(is_cut, pu, 0)], axis=1)
+    return jnp.concatenate([row_u, row_v])

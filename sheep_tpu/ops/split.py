@@ -1,0 +1,29 @@
+"""Tree split dispatch (SURVEY.md §2 #7).
+
+The split runs over O(V) tree state, not O(E) edges — it is two linear
+passes and never the bottleneck, so the default implementation runs on
+host via the shared reference semantics in ``core/pure.py`` (identical
+code path keeps cross-backend edge-cut equivalence exact). Inputs arrive
+as device arrays; only the O(V) parent/pos tables cross to host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from sheep_tpu.core import pure
+from sheep_tpu.types import ElimTree
+
+
+def tree_split_host(
+    parent: np.ndarray,
+    pos: np.ndarray,
+    k: int,
+    weights: Optional[np.ndarray] = None,
+    alpha: float = 1.0,
+) -> np.ndarray:
+    tree = ElimTree(parent=np.asarray(parent, dtype=np.int64),
+                    pos=np.asarray(pos, dtype=np.int64), n=len(parent))
+    return pure.tree_split(tree, k, weights=weights, alpha=alpha)

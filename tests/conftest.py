@@ -1,13 +1,21 @@
-"""Test env: force an 8-device virtual CPU mesh before jax import.
+"""Test env: force an 8-device virtual CPU mesh (SURVEY.md §4.4).
 
-SURVEY.md §4.4 — the standard JAX trick for testing multi-chip sharding
-without a TPU slice. Must run before anything imports jax.
+jax is pre-imported at interpreter startup in this environment (axon TPU
+platform plugin), so env vars alone are too late — use config.update,
+which works as long as no arrays have been created yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.devices()[0].platform})"

@@ -1,0 +1,78 @@
+"""CLI driver tests (SURVEY.md §2 #12)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import formats, generators
+
+
+@pytest.fixture
+def karate_file(tmp_path):
+    p = str(tmp_path / "karate.edges")
+    formats.write_edges(p, generators.karate_club())
+    return p
+
+
+def run_cli(*argv):
+    from sheep_tpu import cli
+
+    return cli.main(list(argv))
+
+
+def test_end_to_end(karate_file, tmp_path, capsys):
+    out = str(tmp_path / "karate.parts")
+    rc = run_cli("--input", karate_file, "--k", "2", "--backend", "pure",
+                 "--output", out)
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "edge cut" in printed
+    summary = json.loads(printed.strip().splitlines()[-1])
+    assert summary["k"] == 2 and summary["total_edges"] == 78
+    parts = formats.read_partition(out)
+    assert parts.shape == (34,) and set(np.unique(parts)) <= {0, 1}
+
+
+def test_json_only(karate_file, capsys):
+    rc = run_cli("--input", karate_file, "--k", "2", "--backend", "pure", "--json")
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 1
+    s = json.loads(lines[0])
+    assert s["backend"] == "pure" and s["edges_per_sec"] > 0
+
+
+def test_list_backends(capsys):
+    rc = run_cli("--list-backends")
+    assert rc == 0
+    assert "pure" in capsys.readouterr().out
+
+
+def test_subprocess_invocation(karate_file):
+    """The real user surface: python -m sheep_tpu.cli."""
+    r = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", karate_file,
+         "--k", "2", "--backend", "pure", "--json"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])["total_edges"] == 78
+
+
+def test_missing_required_args():
+    with pytest.raises(SystemExit):
+        run_cli("--k", "2")
+
+
+def test_partition_api_rejects_unknown_opts(karate_file):
+    import sheep_tpu
+
+    with pytest.raises(TypeError, match="unknown option"):
+        sheep_tpu.partition(karate_file, 2, backend="pure", bogus=1)
+    # constructor opts route through
+    res = sheep_tpu.partition(karate_file, 2, backend="pure", chunk_edges=10,
+                              comm_volume=False)
+    assert res.comm_volume is None

@@ -1,0 +1,126 @@
+"""Cross-implementation equivalence: native C++ core vs numpy oracle.
+
+SURVEY.md §4.3 — backends must agree on tree structure exactly (same
+elimination order => the elimination tree is unique) and on partition
+quality within tolerance (split tie-breaks may differ).
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core import native, pure
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import EdgeStream
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib not built")
+
+
+def _cases():
+    return {
+        "karate": (generators.karate_club(), 34),
+        "path": (generators.path_graph(60), 60),
+        "star": (generators.star_graph(50), 50),
+        "grid": (generators.grid_graph(9, 8), 72),
+        "random": (generators.random_graph(250, 2000, seed=5), 250),
+        "rmat": (generators.rmat(9, 8, seed=6), 512),
+    }
+
+
+@pytest.fixture(params=list(_cases()))
+def graph(request):
+    return _cases()[request.param]
+
+
+def test_degrees_match(graph):
+    e, n = graph
+    np.testing.assert_array_equal(native.degrees(e, n), pure.degrees(e, n))
+
+
+def test_order_matches(graph):
+    e, n = graph
+    deg = pure.degrees(e, n)
+    np.testing.assert_array_equal(native.elim_order(deg), pure.elimination_order(deg))
+
+
+def test_tree_matches_oracle(graph):
+    """The elimination tree is unique given the order: exact match required,
+    even though C++ uses incremental insertion and numpy uses sorted
+    Kruskal — two independent algorithms."""
+    e, n = graph
+    pos = pure.elimination_order(pure.degrees(e, n))
+    expect = pure.build_elim_tree(e, pos).parent
+    got = native.build_elim_tree(e, pos)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_tree_streaming_order_invariant(graph):
+    """Chunked + shuffled insertion gives the same tree as one-shot."""
+    e, n = graph
+    pos = pure.elimination_order(pure.degrees(e, n))
+    expect = native.build_elim_tree(e, pos)
+    rng = np.random.default_rng(0)
+    shuf = e[rng.permutation(len(e))]
+    parent = None
+    for off in range(0, len(shuf), 23):
+        parent = native.build_elim_tree(shuf[off : off + 23], pos, parent=parent)
+    np.testing.assert_array_equal(parent, expect)
+
+
+def test_merge_matches_whole(graph):
+    e, n = graph
+    pos = pure.elimination_order(pure.degrees(e, n))
+    expect = native.build_elim_tree(e, pos)
+    half = len(e) // 2
+    a = native.build_elim_tree(e[:half], pos)
+    b = native.build_elim_tree(e[half:], pos)
+    merged = native.merge_trees(a.copy(), b, pos)
+    np.testing.assert_array_equal(merged, expect)
+
+
+@pytest.mark.parametrize("k", [2, 8])
+def test_split_quality_close_to_oracle(graph, k):
+    e, n = graph
+    pos = pure.elimination_order(pure.degrees(e, n))
+    parent = native.build_elim_tree(e, pos)
+    a_cpp = native.tree_split(parent, pos, k)
+    assert a_cpp.min() >= 0 and a_cpp.max() < k
+    from sheep_tpu.types import ElimTree
+
+    a_py = pure.tree_split(ElimTree(parent=parent, pos=pos, n=n), k)
+    cut_cpp, tot, bal_cpp, _ = pure.edge_cut_score(e, a_cpp, k, comm_volume=False)
+    cut_py, _, bal_py, _ = pure.edge_cut_score(e, a_py, k, comm_volume=False)
+    # same algorithm, tie-breaks may differ: quality within 10% of each other
+    assert cut_cpp <= max(cut_py * 1.10, cut_py + 3)
+    assert bal_cpp <= max(bal_py * 1.10, 2.0)
+
+
+def test_scoring_matches(graph):
+    e, n = graph
+    k = 4
+    rng = np.random.default_rng(1)
+    assign = rng.integers(0, k, n).astype(np.int32)
+    cut, total = native.score_chunk(e, assign, n)
+    ecut, etotal, _, ecv = pure.edge_cut_score(e, assign, k)
+    assert (cut, total) == (ecut, etotal)
+    pairs = native.cut_pairs(e, assign, n, k)
+    assert len(np.unique(pairs)) == ecv
+
+
+def test_parse_text():
+    data = b"# comment\n1 2\n3\t4\n\n% other\n5 6 extra\n7 8"  # no trailing \n
+    edges, consumed = native.parse_text(data)
+    np.testing.assert_array_equal(edges, [[1, 2], [3, 4], [5, 6]])
+    # "7 8" has no newline: left unconsumed for the next block
+    assert data[consumed:] == b"7 8"
+
+
+def test_cpu_backend_end_to_end():
+    from sheep_tpu.backends.base import get_backend
+
+    e = generators.rmat(10, 8, seed=9)
+    res = get_backend("cpu", chunk_edges=1000).partition(EdgeStream.from_array(e), 8)
+    res.validate(int(e.max()) + 1)
+    ref = get_backend("pure").partition(EdgeStream.from_array(e), 8)
+    assert res.total_edges == ref.total_edges
+    # backend-equivalence bound (north star: <=2% edge-cut regression)
+    assert res.edge_cut <= ref.edge_cut * 1.02 + 3

@@ -1,0 +1,136 @@
+"""TPU-path ops vs the numpy oracle (run on CPU-jax; SURVEY.md §4.3).
+
+The elimination tree is unique given the order, so the device fixpoint
+must reproduce the oracle's parent array exactly on every graph shape —
+including adversarial ones (paths, stars) that stress fixpoint depth.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sheep_tpu.core import pure
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.ops import degrees as degrees_ops
+from sheep_tpu.ops import elim as elim_ops
+from sheep_tpu.ops import order as order_ops
+from sheep_tpu.ops import score as score_ops
+from sheep_tpu.backends.tpu_backend import TpuBackend, pad_chunk
+
+
+def _cases():
+    return {
+        "karate": (generators.karate_club(), 34),
+        "path": (generators.path_graph(64), 64),
+        "star": (generators.star_graph(50), 50),
+        "grid": (generators.grid_graph(8, 8), 64),
+        "random": (generators.random_graph(200, 1600, seed=11), 200),
+        "rmat": (generators.rmat(9, 8, seed=12), 512),
+        "two_components": (
+            np.concatenate([generators.path_graph(30),
+                            30 + generators.grid_graph(5, 6)]), 60),
+    }
+
+
+@pytest.fixture(params=list(_cases()))
+def graph(request):
+    return _cases()[request.param]
+
+
+def _device_order(e, n):
+    deg = degrees_ops.init_degrees(n)
+    deg = degrees_ops.degree_chunk(deg, pad_chunk(e, len(e), n), n)
+    return order_ops.elimination_order(deg, n)
+
+
+def test_degrees_and_order_match_oracle(graph):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    np.testing.assert_array_equal(np.asarray(pos[:n]),
+                                  pure.elimination_order(pure.degrees(e, n)))
+    assert int(pos[n]) == n and int(order[n]) == n
+
+
+@pytest.mark.parametrize("climb_steps", [1, 4])
+def test_fixpoint_tree_matches_oracle(graph, climb_steps):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    minp, rounds = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n),
+        pos, order, n, climb_steps=climb_steps)
+    parent = elim_ops.minp_to_parent(minp, order, n)
+    expect = pure.build_elim_tree(e, pure.elimination_order(pure.degrees(e, n))).parent
+    np.testing.assert_array_equal(parent, expect)
+    assert int(rounds) < n  # converged well before the trivial bound
+
+
+def test_streaming_chunks_match_batch(graph):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    whole, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n), pos, order, n)
+    minp = jnp.full(n + 1, n, dtype=jnp.int32)
+    size = 37
+    for off in range(0, len(e), size):
+        minp, _ = elim_ops.build_chunk_step(
+            minp, pad_chunk(e[off:off + size], size, n), pos, order, n)
+    np.testing.assert_array_equal(np.asarray(minp), np.asarray(whole))
+
+
+def test_merge_forests_matches_whole(graph):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    half = len(e) // 2
+    a, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e[:half], max(half, 1), n),
+        pos, order, n)
+    b, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32),
+        pad_chunk(e[half:], max(len(e) - half, 1), n), pos, order, n)
+    merged = elim_ops.merge_forests(a, b, pos, order, n)
+    whole, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n), pos, order, n)
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(whole))
+
+
+def test_minp_parent_roundtrip(graph):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    minp, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n), pos, order, n)
+    parent = elim_ops.minp_to_parent(minp, order, n)
+    back = elim_ops.parent_to_minp(parent, np.asarray(pos[:n]), n)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(minp))
+
+
+def test_score_ops_match_oracle(graph):
+    e, n = graph
+    k = 4
+    rng = np.random.default_rng(2)
+    assign_np = rng.integers(0, k, n).astype(np.int32)
+    assign = jnp.concatenate([jnp.asarray(assign_np), jnp.zeros(1, jnp.int32)])
+    cut, total = (int(x) for x in
+                  score_ops.score_chunk(pad_chunk(e, len(e) + 5, n), assign, n))
+    ecut, etotal, _, ecv = pure.edge_cut_score(e, assign_np, k)
+    assert (cut, total) == (ecut, etotal)
+    rows = np.asarray(score_ops.cut_pairs(pad_chunk(e, len(e) + 5, n), assign, n))
+    rows = rows[rows[:, 0] < n]
+    got_cv = len(np.unique(rows[:, 0].astype(np.int64) * k + rows[:, 1]))
+    assert got_cv == ecv
+
+
+@pytest.mark.parametrize("k", [2, 8])
+def test_tpu_backend_end_to_end(k):
+    e = generators.rmat(9, 8, seed=13)
+    n = int(e.max()) + 1
+    be = TpuBackend(chunk_edges=1024)
+    res = be.partition(EdgeStream.from_array(e), k)
+    res.validate(n)
+    ref = pure.partition_arrays(e, k)
+    # identical tree + identical split semantics => identical scores
+    assert res.edge_cut == ref.edge_cut
+    assert res.total_edges == ref.total_edges
+    assert res.comm_volume == ref.comm_volume
+    np.testing.assert_array_equal(res.assignment, ref.assignment)
