@@ -122,9 +122,7 @@ class TpuBackend(Partitioner):
             cut += int(c)
             total += int(tt)
             if comm_volume:
-                rows = np.asarray(score_ops.cut_pairs(padded, assign, n))
-                rows = rows[rows[:, 0] < n]
-                cv_chunks.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
+                cv_chunks.append(score_ops.cut_pair_keys_host(padded, assign, n, k))
         cv = None
         if comm_volume:
             allk = np.concatenate(cv_chunks) if cv_chunks else np.zeros(0, np.int64)

@@ -75,6 +75,14 @@ class EdgeStream:
         return self._n_edges
 
     @property
+    def num_edges_cheap(self) -> Optional[int]:
+        """num_edges when it costs O(1) (binary/memory formats or already
+        counted); None when computing it would require a file pass."""
+        if self._n_edges is not None or self.fmt in ("bin32", "bin64"):
+            return self.num_edges
+        return None
+
+    @property
     def num_vertices(self) -> int:
         """max vertex id + 1; computed by a streaming pass if not provided."""
         if self._n_vertices is None:

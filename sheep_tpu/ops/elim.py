@@ -99,7 +99,11 @@ def elim_fixpoint(
         _, _, changed, rounds = state
         return changed & (rounds < max_rounds)
 
-    state = (lo, hi, jnp.bool_(True), jnp.int32(0))
+    # derive the initial carry scalars from `lo` so their sharding/varying
+    # axes match the loop body's outputs (required under shard_map)
+    changed0 = lo[0] == lo[0]  # True, with lo's varying axes
+    rounds0 = (lo[0] * 0).astype(jnp.int32)
+    state = (lo, hi, changed0, rounds0)
     lo_f, hi_f, _, rounds = lax.while_loop(cond, body, state)
     minp = scatter_min(lo_f, pos[hi_f])
     return minp, rounds

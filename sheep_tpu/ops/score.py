@@ -46,3 +46,18 @@ def cut_pairs(edges: jax.Array, assign: jax.Array, n: int):
     row_u = jnp.stack([jnp.where(is_cut, u, sent_v), jnp.where(is_cut, pv, 0)], axis=1)
     row_v = jnp.stack([jnp.where(is_cut, v, sent_v), jnp.where(is_cut, pu, 0)], axis=1)
     return jnp.concatenate([row_u, row_v])
+
+
+def cut_pair_keys_host(chunk, assign, n: int, k: int):
+    """Run cut_pairs on a (C, 2) or (D, C, 2) chunk and return the encoded
+    int64 keys (vertex * k + foreign_part) on host — the shared comm-volume
+    accumulation used by every backend."""
+    import numpy as np
+
+    arr = np.asarray(chunk)
+    rows_all = []
+    for c in arr.reshape(-1, arr.shape[-2], 2) if arr.ndim == 3 else [arr]:
+        rows = np.asarray(cut_pairs(c, assign, n))
+        rows = rows[rows[:, 0] < n]
+        rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
+    return np.concatenate(rows_all) if rows_all else np.zeros(0, np.int64)

@@ -1,0 +1,2 @@
+from sheep_tpu.parallel.mesh import shards_mesh, device_count  # noqa: F401
+from sheep_tpu.parallel import pipeline  # noqa: F401

@@ -1,0 +1,268 @@
+"""Sharded multi-device partition pipeline (SURVEY.md §2 #9, §3.1).
+
+The comm surface mirrors the reference's three MPI crossings exactly
+(SURVEY.md §3.1), as XLA collectives on the ``shards`` mesh axis:
+
+  1. shard scatter     -> host round-robins edge chunks to devices
+                          (EdgeStream chunk index % D), device_put with a
+                          NamedSharding — no collective, just placement
+  2. tree-merge reduce -> butterfly allreduce with *forest merge* as the
+                          combiner: log2(D) ppermute rounds, each device
+                          ships its O(V) parent table over ICI and folds
+                          the incoming forest with the elimination
+                          fixpoint; after the last round every device
+                          holds the global tree (T is associative +
+                          commutative, so the butterfly is valid)
+  3. score all-reduce  -> psum of (cut, total) counters
+
+Degrees use per-device partial counts summed once at the end (one
+all-reduce of an O(V) vector), so the streaming passes are collective-free:
+all cross-device traffic is O(V log D + V), independent of E.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from sheep_tpu.ops import degrees as degrees_ops
+from sheep_tpu.ops import elim as elim_ops
+from sheep_tpu.ops import order as order_ops
+from sheep_tpu.ops import score as score_ops
+from sheep_tpu.parallel.mesh import SHARD_AXIS
+
+
+def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
+                  shard: int = 0, num_shards: int = 1, start_chunk: int = 0):
+    """Group the chunk stream into (D, C, 2) int32 host batches, one chunk
+    per device, padded with the sentinel vertex n. Yields (batch, count)."""
+    from sheep_tpu.backends.tpu_backend import pad_chunk
+
+    batch = np.full((n_devices, chunk_edges, 2), n, dtype=np.int32)
+    filled = 0
+    for chunk in stream.chunks(chunk_edges, shard=shard, num_shards=num_shards,
+                               start_chunk=start_chunk):
+        batch[filled] = pad_chunk(chunk, chunk_edges, n)
+        filled += 1
+        if filled == n_devices:
+            yield batch, filled
+            batch = np.full((n_devices, chunk_edges, 2), n, dtype=np.int32)
+            filled = 0
+    if filled:
+        yield batch, filled
+
+
+class ShardedPipeline:
+    """Compiled sharded pipeline for a fixed (n, chunk_edges, mesh)."""
+
+    def __init__(self, n: int, chunk_edges: int, mesh, climb_steps: int = 4):
+        self.n = n
+        self.cs = chunk_edges
+        self.mesh = mesh
+        self.climb_steps = climb_steps
+        d = mesh.devices.size
+        self.n_devices = d
+        self.rounds = max(1, math.ceil(math.log2(d))) if d > 1 else 0
+
+        self.batch_sharding = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        self.state_sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
+        self.repl_sharding = NamedSharding(mesh, P())
+
+        n_ = self.n
+        climb = self.climb_steps
+
+        @partial(jax.jit,
+                 in_shardings=(self.state_sharding, self.batch_sharding),
+                 out_shardings=self.state_sharding)
+        def deg_step(deg_all, batch):
+            def f(deg_local, chunk_local):
+                return degrees_ops.degree_chunk(
+                    deg_local[0], chunk_local[0], n_)[None]
+            return shard_map(f, mesh=mesh,
+                             in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None, None)),
+                             out_specs=P(SHARD_AXIS, None))(deg_all, batch)
+
+        @partial(jax.jit, out_shardings=self.repl_sharding)
+        def deg_reduce(deg_all):
+            return jnp.sum(deg_all, axis=0, dtype=jnp.int32)
+
+        @partial(jax.jit, out_shardings=(self.repl_sharding, self.repl_sharding))
+        def make_order(deg_total):
+            return order_ops.elimination_order(deg_total, n_)
+
+        @partial(jax.jit,
+                 in_shardings=(self.state_sharding, self.batch_sharding,
+                               self.repl_sharding, self.repl_sharding),
+                 out_shardings=self.state_sharding)
+        def build_step(forest_all, batch, pos, order):
+            def f(forest_local, chunk_local, pos_, order_):
+                minp, _ = elim_ops.build_chunk_step(
+                    forest_local[0], chunk_local[0], pos_, order_, n_,
+                    climb_steps=climb)
+                return minp[None]
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None, None), P(), P()),
+                out_specs=P(SHARD_AXIS, None))(forest_all, batch, pos, order)
+
+        d_ = self.n_devices
+        r_ = self.rounds
+
+        @partial(jax.jit,
+                 in_shardings=(self.state_sharding, self.repl_sharding,
+                               self.repl_sharding),
+                 out_shardings=self.repl_sharding)
+        def merge_all(forest_all, pos, order):
+            """Butterfly allreduce, combiner = forest merge (comm point 2)."""
+            def f(forest_local, pos_, order_):
+                forest = forest_local[0]
+                idx = lax.axis_index(SHARD_AXIS)
+                for r in range(r_):
+                    perm = [(i, i ^ (1 << r)) for i in range(d_)]
+                    perm = [(s, t) for s, t in perm if t < d_]
+                    other = lax.ppermute(forest, SHARD_AXIS, perm)
+                    # devices whose XOR partner is out of range receive
+                    # zeros from ppermute; treat that as the empty forest
+                    # (all-sentinel). Device 0 is the binomial-tree root and
+                    # is complete after ceil(log2 d) rounds for any d.
+                    other = jnp.where((idx ^ (1 << r)) < d_, other, jnp.int32(n_))
+                    forest = elim_ops.merge_forests(
+                        forest, other, pos_, order_, n_, climb_steps=climb)
+                return forest[None]
+            merged = shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None), P(), P()),
+                out_specs=P(SHARD_AXIS, None))(forest_all, pos, order)
+            return merged[0]
+
+        @partial(jax.jit,
+                 in_shardings=(self.batch_sharding, self.repl_sharding),
+                 out_shardings=self.repl_sharding)
+        def score_step(batch, assign):
+            """Per-batch (cut, total) summed over devices (comm point 3)."""
+            def f(chunk_local, assign_):
+                c, t = score_ops.score_chunk(chunk_local[0], assign_, n_)
+                return lax.psum(jnp.stack([c, t])[None], SHARD_AXIS)
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None, None), P()),
+                out_specs=P(SHARD_AXIS, None))(batch, assign)[0]
+
+        self.deg_step = deg_step
+        self.deg_reduce = deg_reduce
+        self.make_order = make_order
+        self.build_step = build_step
+        self.merge_all = merge_all
+        self.score_step = score_step
+
+    # -- state constructors ------------------------------------------------
+    def init_degrees(self):
+        return jax.device_put(
+            np.zeros((self.n_devices, self.n + 1), np.int32), self.state_sharding)
+
+    def init_forest(self):
+        return jax.device_put(
+            np.full((self.n_devices, self.n + 1), self.n, np.int32),
+            self.state_sharding)
+
+    def put_batch(self, batch: np.ndarray):
+        return jax.device_put(batch, self.batch_sharding)
+
+    def put_replicated(self, arr):
+        return jax.device_put(np.asarray(arr), self.repl_sharding)
+
+    # -- full run (single process; multi-host callers drive the steps) -----
+    def run(self, stream, k: int, alpha: float = 1.0,
+            weights: Optional[str] = "unit", comm_volume: bool = False,
+            timings: Optional[dict] = None):
+        """Drive the whole sharded pipeline over the stream.
+
+        This is the single implementation of the streaming loops; backends
+        wrap it and convert the result dict. ``timings`` (if given) is
+        filled with per-phase seconds.
+        """
+        import time
+
+        from sheep_tpu.core import pure
+        from sheep_tpu.ops import score as score_ops
+        from sheep_tpu.ops.split import tree_split_host
+
+        t = timings if timings is not None else {}
+        n, cs, d = self.n, self.cs, self.n_devices
+
+        # pass 1: degrees, int32 on device with int64 host flushes so no
+        # per-vertex endpoint count can reach 2^31 between flushes
+        t0 = time.perf_counter()
+        flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
+        deg_host = np.zeros(n, dtype=np.int64)
+        deg_all = self.init_degrees()
+        since = 0
+        for batch, _ in chunk_batches(stream, cs, d, n):
+            deg_all = self.deg_step(deg_all, self.put_batch(batch))
+            since += 1
+            if since >= flush_every:
+                deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+                deg_all = self.init_degrees()
+                since = 0
+        deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+        # positions are ordinal: rank-compress if totals exceed int32
+        if deg_host.size and deg_host.max() >= 2**31:
+            deg_rank = np.argsort(np.argsort(deg_host, kind="stable"),
+                                  kind="stable")
+        else:
+            deg_rank = deg_host
+        deg_total = self.put_replicated(
+            np.concatenate([deg_rank, [0]]).astype(np.int32))
+        pos, order = self.make_order(deg_total)
+        pos.block_until_ready()
+        t["degrees+sort"] = time.perf_counter() - t0
+
+        # pass 2: per-device forests, then butterfly merge (comm point 2)
+        t0 = time.perf_counter()
+        forest_all = self.init_forest()
+        for batch, _ in chunk_batches(stream, cs, d, n):
+            forest_all = self.build_step(forest_all, self.put_batch(batch), pos, order)
+        merged = self.merge_all(forest_all, pos, order)
+        merged.block_until_ready()
+        t["build+merge"] = time.perf_counter() - t0
+
+        # split on host over O(V) state
+        t0 = time.perf_counter()
+        parent = elim_ops.minp_to_parent(merged, order, n)
+        pos_host = np.asarray(pos[:n])
+        w = deg_host.astype(np.float64) if weights == "degree" else None
+        assign_host = tree_split_host(parent, pos_host, k, weights=w, alpha=alpha)
+        assign = self.put_replicated(
+            np.concatenate([assign_host.astype(np.int32), np.zeros(1, np.int32)]))
+        t["split"] = time.perf_counter() - t0
+
+        # pass 3: scoring (comm point 3)
+        t0 = time.perf_counter()
+        cut = total = 0
+        cv_chunks = []
+        for batch, _ in chunk_batches(stream, cs, d, n):
+            dev_batch = self.put_batch(batch)
+            c, tt = np.asarray(self.score_step(dev_batch, assign))
+            cut += int(c)
+            total += int(tt)
+            if comm_volume:
+                cv_chunks.append(score_ops.cut_pair_keys_host(batch, assign, n, k))
+        cv = (int(len(np.unique(np.concatenate(cv_chunks)))) if cv_chunks else 0) \
+            if comm_volume else None
+        balance = pure.part_balance(assign_host, k,
+                                    deg_host if weights == "degree" else None)
+        t["score"] = time.perf_counter() - t0
+        return {
+            "assignment": assign_host, "parent": parent, "pos": pos_host,
+            "degrees": deg_host, "edge_cut": cut, "total_edges": total,
+            "balance": balance, "comm_volume": cv, "k": k,
+        }
