@@ -29,13 +29,33 @@ class CpuBackend(Partitioner):
         self.alpha = alpha
 
     def partition(self, stream, k: int, weights: str = "unit",
-                  comm_volume: bool = True, **opts) -> PartitionResult:
+                  comm_volume: bool = True, checkpointer=None,
+                  resume: bool = False, **opts) -> PartitionResult:
+        from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils.fault import maybe_fail
+
         t = {}
         t0 = time.perf_counter()
         n = stream.num_vertices
-        deg = np.zeros(n, dtype=np.int64)
-        for chunk in stream.chunks(self.chunk_edges):
-            native.degrees(chunk, n, out=deg)
+        meta = ckpt.stream_meta(stream, k, self.chunk_edges, weights=weights,
+                                alpha=self.alpha, comm_volume=comm_volume,
+                                state_format="parent")
+        state = ckpt.resume_state(checkpointer, meta, resume)
+        from_phase = ckpt.phase_index(state.phase) if state else 0
+
+        if state:
+            deg = state.arrays["deg"].copy()
+        else:
+            deg = np.zeros(n, dtype=np.int64)
+        if from_phase == 0:
+            start = state.chunk_idx if state else 0
+            idx = start
+            for chunk in stream.chunks(self.chunk_edges, start_chunk=start):
+                native.degrees(chunk, n, out=deg)
+                idx += 1
+                maybe_fail("degrees", idx - start)
+                if checkpointer is not None and checkpointer.due(idx - start):
+                    checkpointer.save("degrees", idx, {"deg": deg}, meta)
         t["degrees"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -43,9 +63,23 @@ class CpuBackend(Partitioner):
         t["sort"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        parent = np.full(n, -1, dtype=np.int64)
-        for chunk in stream.chunks(self.chunk_edges):
-            native.build_elim_tree(chunk, pos, parent=parent)
+        if state and from_phase >= 2:
+            parent = state.arrays["parent"].copy()
+        else:
+            if state and state.phase == "build":
+                parent = state.arrays["parent"].copy()
+                start = state.chunk_idx
+            else:
+                parent = np.full(n, -1, dtype=np.int64)
+                start = 0
+            idx = start
+            for chunk in stream.chunks(self.chunk_edges, start_chunk=start):
+                native.build_elim_tree(chunk, pos, parent=parent)
+                idx += 1
+                maybe_fail("build", idx - start)
+                if checkpointer is not None and checkpointer.due(idx - start):
+                    checkpointer.save("build", idx,
+                                      {"deg": deg, "parent": parent}, meta)
         t["build"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -56,12 +90,31 @@ class CpuBackend(Partitioner):
         t0 = time.perf_counter()
         cut = total = 0
         cv_parts = []
-        for chunk in stream.chunks(self.chunk_edges):
+        start = 0
+        if state and state.phase == "score":
+            start = state.chunk_idx
+            cut = int(state.arrays["cut"])
+            total = int(state.arrays["total"])
+            if comm_volume:
+                cv_parts.append(state.arrays["cv_keys"])
+        idx = start
+        for chunk in stream.chunks(self.chunk_edges, start_chunk=start):
             c, tt = native.score_chunk(chunk, assignment, n)
             cut += c
             total += tt
             if comm_volume:
                 cv_parts.append(native.cut_pairs(chunk, assignment, n, k))
+            idx += 1
+            maybe_fail("score", idx - start)
+            if checkpointer is not None and checkpointer.due(idx - start):
+                keys = (np.unique(np.concatenate(cv_parts))
+                        if cv_parts else np.zeros(0, np.int64))
+                cv_parts = [keys] if comm_volume else []
+                checkpointer.save(
+                    "score", idx,
+                    {"deg": deg, "parent": parent,
+                     "cut": np.int64(cut), "total": np.int64(total),
+                     "cv_keys": keys}, meta)
         cv = (int(len(np.unique(np.concatenate(cv_parts)))) if cv_parts else 0) if comm_volume else None
         balance = pure.part_balance(assignment, k, deg if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0

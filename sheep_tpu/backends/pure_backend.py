@@ -26,6 +26,9 @@ class PureBackend(Partitioner):
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, **opts) -> PartitionResult:
+        if opts.get("checkpointer") is not None:
+            raise ValueError(
+                "the pure backend does not checkpoint; use cpu/tpu/tpu-sharded")
         t = {}
         t0 = time.perf_counter()
         n = stream.num_vertices

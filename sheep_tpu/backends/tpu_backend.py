@@ -59,26 +59,47 @@ class TpuBackend(Partitioner):
         self.alpha = alpha
 
     def partition(self, stream, k: int, weights: str = "unit",
-                  comm_volume: bool = True, **opts) -> PartitionResult:
+                  comm_volume: bool = True, checkpointer=None,
+                  resume: bool = False, **opts) -> PartitionResult:
+        from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils.fault import maybe_fail
+
         t = {}
         cs = self.chunk_edges
         t0 = time.perf_counter()
         n = stream.num_vertices
+        meta = ckpt.stream_meta(stream, k, cs, weights=weights,
+                                alpha=self.alpha, comm_volume=comm_volume,
+                                state_format="minp")
+        state = ckpt.resume_state(checkpointer, meta, resume)
+        from_phase = ckpt.phase_index(state.phase) if state else 0
+
         # Device accumulation is int32; flush to a host int64 accumulator
         # before a vertex could possibly see 2^31 endpoints, so trillion-edge
         # streams cannot overflow (cross-chunk totals live host-side).
         flush_every = max(1, (2**31 - 1) // max(2 * cs, 1))
-        deg_host = np.zeros(n, dtype=np.int64)
-        deg = degrees_ops.init_degrees(n)
-        since_flush = 0
-        for chunk in stream.chunks(cs):
-            deg = degrees_ops.degree_chunk(deg, pad_chunk(chunk, cs, n), n)
-            since_flush += 1
-            if since_flush >= flush_every:
-                deg_host += np.asarray(deg[:n], dtype=np.int64)
-                deg = degrees_ops.init_degrees(n)
-                since_flush = 0
-        deg_host += np.asarray(deg[:n], dtype=np.int64)
+        if state:
+            deg_host = state.arrays["deg"].copy()
+        else:
+            deg_host = np.zeros(n, dtype=np.int64)
+        if from_phase == 0:
+            start = state.chunk_idx if state else 0
+            deg = degrees_ops.init_degrees(n)
+            since_flush = 0
+            idx = start
+            for chunk in stream.chunks(cs, start_chunk=start):
+                deg = degrees_ops.degree_chunk(deg, pad_chunk(chunk, cs, n), n)
+                since_flush += 1
+                idx += 1
+                maybe_fail("degrees", idx - start)
+                at_ckpt = checkpointer is not None and checkpointer.due(idx - start)
+                if since_flush >= flush_every or at_ckpt:
+                    deg_host += np.asarray(deg[:n], dtype=np.int64)
+                    deg = degrees_ops.init_degrees(n)
+                    since_flush = 0
+                if at_ckpt:
+                    checkpointer.save("degrees", idx, {"deg": deg_host}, meta)
+            deg_host += np.asarray(deg[:n], dtype=np.int64)
         t["degrees"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -92,14 +113,30 @@ class TpuBackend(Partitioner):
         t["sort"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        minp = jnp.full(n + 1, n, dtype=jnp.int32)
-        total_rounds = 0
-        for chunk in stream.chunks(cs):
-            minp, rounds = elim_ops.build_chunk_step(
-                minp, pad_chunk(chunk, cs, n), pos, order, n,
-                climb_steps=self.climb_steps)
-            total_rounds += int(rounds)
-        minp.block_until_ready()
+        if state and from_phase >= 2:
+            minp = jnp.asarray(state.arrays["minp"])
+            total_rounds = 0
+        else:
+            if state and state.phase == "build":
+                minp = jnp.asarray(state.arrays["minp"])
+                start = state.chunk_idx
+            else:
+                minp = jnp.full(n + 1, n, dtype=jnp.int32)
+                start = 0
+            total_rounds = 0
+            idx = start
+            for chunk in stream.chunks(cs, start_chunk=start):
+                minp, rounds = elim_ops.build_chunk_step(
+                    minp, pad_chunk(chunk, cs, n), pos, order, n,
+                    climb_steps=self.climb_steps)
+                total_rounds += int(rounds)
+                idx += 1
+                maybe_fail("build", idx - start)
+                if checkpointer is not None and checkpointer.due(idx - start):
+                    checkpointer.save(
+                        "build", idx,
+                        {"deg": deg_host, "minp": np.asarray(minp)}, meta)
+            minp.block_until_ready()
         t["build"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -116,13 +153,32 @@ class TpuBackend(Partitioner):
         t0 = time.perf_counter()
         cut = total = 0
         cv_chunks = []
-        for chunk in stream.chunks(cs):
+        start = 0
+        if state and state.phase == "score":
+            start = state.chunk_idx
+            cut = int(state.arrays["cut"])
+            total = int(state.arrays["total"])
+            if comm_volume:
+                cv_chunks.append(state.arrays["cv_keys"])
+        idx = start
+        for chunk in stream.chunks(cs, start_chunk=start):
             padded = pad_chunk(chunk, cs, n)
             c, tt = score_ops.score_chunk(padded, assign, n)
             cut += int(c)
             total += int(tt)
             if comm_volume:
                 cv_chunks.append(score_ops.cut_pair_keys_host(padded, assign, n, k))
+            idx += 1
+            maybe_fail("score", idx - start)
+            if checkpointer is not None and checkpointer.due(idx - start):
+                keys = (np.unique(np.concatenate(cv_chunks))
+                        if cv_chunks else np.zeros(0, np.int64))
+                cv_chunks = [keys] if comm_volume else []
+                checkpointer.save(
+                    "score", idx,
+                    {"deg": deg_host, "minp": np.asarray(minp),
+                     "cut": np.int64(cut), "total": np.int64(total),
+                     "cv_keys": keys}, meta)
         cv = None
         if comm_volume:
             allk = np.concatenate(cv_chunks) if cv_chunks else np.zeros(0, np.int64)

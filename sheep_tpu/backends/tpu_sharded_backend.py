@@ -29,7 +29,8 @@ class TpuShardedBackend(Partitioner):
         self.n_devices = n_devices
 
     def partition(self, stream, k: int, weights: str = "unit",
-                  comm_volume: bool = False, **opts) -> PartitionResult:
+                  comm_volume: bool = False, checkpointer=None,
+                  resume: bool = False, **opts) -> PartitionResult:
         n = stream.num_vertices
         mesh = shards_mesh(self.n_devices)
         # shrink the chunk so small graphs don't pad (and compile) up to the
@@ -43,7 +44,8 @@ class TpuShardedBackend(Partitioner):
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,
-                       comm_volume=comm_volume, timings=timings)
+                       comm_volume=comm_volume, timings=timings,
+                       checkpointer=checkpointer, resume=resume)
         return PartitionResult(
             assignment=out["assignment"], k=k, edge_cut=out["edge_cut"],
             total_edges=out["total_edges"],

@@ -43,6 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="vertex count if known (skips a counting pass)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace (tpu backend) to this dir")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save O(V) chunk-level checkpoints to this dir")
+    p.add_argument("--checkpoint-every", type=int, default=64,
+                   help="checkpoint cadence in chunks (default 64)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--json", action="store_true", help="print only the JSON result line")
     p.add_argument("--list-backends", action="store_true", help="list backends and exit")
     return p
@@ -61,6 +67,8 @@ def main(argv=None) -> int:
         return 0
     if args.input is None or args.k is None:
         build_parser().error("--input and --k are required")
+    if args.resume and not args.checkpoint_dir:
+        build_parser().error("--resume requires --checkpoint-dir")
 
     backend = args.backend
     if backend is None:
@@ -77,6 +85,15 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     with EdgeStream.open(args.input, n_vertices=args.num_vertices) as es:
+        ckpt_kw = {}
+        if args.checkpoint_dir:
+            from sheep_tpu.utils.checkpoint import Checkpointer
+
+            ckpt_kw = {
+                "checkpointer": Checkpointer(args.checkpoint_dir,
+                                             every=args.checkpoint_every),
+                "resume": args.resume,
+            }
         profile = None
         if args.profile_dir:
             import jax
@@ -85,7 +102,7 @@ def main(argv=None) -> int:
             profile.__enter__()
         try:
             res = be.partition(es, args.k, weights=args.weights,
-                               comm_volume=not args.no_comm_volume)
+                               comm_volume=not args.no_comm_volume, **ckpt_kw)
         finally:
             if profile is not None:
                 profile.__exit__(None, None, None)

@@ -183,37 +183,59 @@ class ShardedPipeline:
     # -- full run (single process; multi-host callers drive the steps) -----
     def run(self, stream, k: int, alpha: float = 1.0,
             weights: Optional[str] = "unit", comm_volume: bool = False,
-            timings: Optional[dict] = None):
+            timings: Optional[dict] = None, checkpointer=None,
+            resume: bool = False):
         """Drive the whole sharded pipeline over the stream.
 
         This is the single implementation of the streaming loops; backends
         wrap it and convert the result dict. ``timings`` (if given) is
-        filled with per-phase seconds.
+        filled with per-phase seconds. ``checkpointer`` saves O(V) state
+        every ``checkpointer.every`` batches; ``resume`` restarts from it.
         """
         import time
 
         from sheep_tpu.core import pure
         from sheep_tpu.ops import score as score_ops
         from sheep_tpu.ops.split import tree_split_host
+        from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils.fault import maybe_fail
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
+        meta = ckpt.stream_meta(stream, k, cs, weights=weights, alpha=alpha,
+                                comm_volume=comm_volume,
+                                state_format="sharded", devices=d)
+        state = ckpt.resume_state(checkpointer, meta, resume)
+        from_phase = ckpt.phase_index(state.phase) if state else 0
 
         # pass 1: degrees, int32 on device with int64 host flushes so no
         # per-vertex endpoint count can reach 2^31 between flushes
         t0 = time.perf_counter()
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
-        deg_host = np.zeros(n, dtype=np.int64)
-        deg_all = self.init_degrees()
-        since = 0
-        for batch, _ in chunk_batches(stream, cs, d, n):
-            deg_all = self.deg_step(deg_all, self.put_batch(batch))
-            since += 1
-            if since >= flush_every:
-                deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
-                deg_all = self.init_degrees()
-                since = 0
-        deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+        if state:
+            deg_host = state.arrays["deg"].copy()
+        else:
+            deg_host = np.zeros(n, dtype=np.int64)
+        if from_phase == 0:
+            start = state.chunk_idx if state else 0
+            deg_all = self.init_degrees()
+            since = batches = 0
+            for batch, filled in chunk_batches(stream, cs, d, n,
+                                               start_chunk=start):
+                deg_all = self.deg_step(deg_all, self.put_batch(batch))
+                since += 1
+                batches += 1
+                maybe_fail("degrees", batches)
+                at_ckpt = checkpointer is not None and checkpointer.due(batches)
+                if since >= flush_every or at_ckpt:
+                    deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
+                                           dtype=np.int64)
+                    deg_all = self.init_degrees()
+                    since = 0
+                if at_ckpt:
+                    checkpointer.save("degrees", start + batches * d,
+                                      {"deg": deg_host}, meta)
+            deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
         # positions are ordinal: rank-compress if totals exceed int32
         if deg_host.size and deg_host.max() >= 2**31:
             deg_rank = np.argsort(np.argsort(deg_host, kind="stable"),
@@ -228,11 +250,29 @@ class ShardedPipeline:
 
         # pass 2: per-device forests, then butterfly merge (comm point 2)
         t0 = time.perf_counter()
-        forest_all = self.init_forest()
-        for batch, _ in chunk_batches(stream, cs, d, n):
-            forest_all = self.build_step(forest_all, self.put_batch(batch), pos, order)
-        merged = self.merge_all(forest_all, pos, order)
-        merged.block_until_ready()
+        if state and from_phase >= 2:
+            merged = jnp.asarray(state.arrays["merged"])
+        else:
+            if state and state.phase == "build":
+                forest_all = jax.device_put(state.arrays["forest_all"],
+                                            self.state_sharding)
+                start = state.chunk_idx
+            else:
+                forest_all = self.init_forest()
+                start = 0
+            batches = 0
+            for batch, _ in chunk_batches(stream, cs, d, n, start_chunk=start):
+                forest_all = self.build_step(forest_all, self.put_batch(batch),
+                                             pos, order)
+                batches += 1
+                maybe_fail("build", batches)
+                if checkpointer is not None and checkpointer.due(batches):
+                    checkpointer.save(
+                        "build", start + batches * d,
+                        {"deg": deg_host, "forest_all": np.asarray(forest_all)},
+                        meta)
+            merged = self.merge_all(forest_all, pos, order)
+            merged.block_until_ready()
         t["build+merge"] = time.perf_counter() - t0
 
         # split on host over O(V) state
@@ -249,13 +289,32 @@ class ShardedPipeline:
         t0 = time.perf_counter()
         cut = total = 0
         cv_chunks = []
-        for batch, _ in chunk_batches(stream, cs, d, n):
+        start = 0
+        if state and state.phase == "score":
+            start = state.chunk_idx
+            cut = int(state.arrays["cut"])
+            total = int(state.arrays["total"])
+            if comm_volume:
+                cv_chunks.append(state.arrays["cv_keys"])
+        batches = 0
+        for batch, _ in chunk_batches(stream, cs, d, n, start_chunk=start):
             dev_batch = self.put_batch(batch)
             c, tt = np.asarray(self.score_step(dev_batch, assign))
             cut += int(c)
             total += int(tt)
             if comm_volume:
                 cv_chunks.append(score_ops.cut_pair_keys_host(batch, assign, n, k))
+            batches += 1
+            maybe_fail("score", batches)
+            if checkpointer is not None and checkpointer.due(batches):
+                keys = (np.unique(np.concatenate(cv_chunks))
+                        if cv_chunks else np.zeros(0, np.int64))
+                cv_chunks = [keys] if comm_volume else []
+                checkpointer.save(
+                    "score", start + batches * d,
+                    {"deg": deg_host, "merged": np.asarray(merged),
+                     "cut": np.int64(cut), "total": np.int64(total),
+                     "cv_keys": keys}, meta)
         cv = (int(len(np.unique(np.concatenate(cv_chunks)))) if cv_chunks else 0) \
             if comm_volume else None
         balance = pure.part_balance(assign_host, k,

@@ -1,0 +1,2 @@
+from sheep_tpu.utils.checkpoint import Checkpointer, CheckpointState  # noqa: F401
+from sheep_tpu.utils.fault import maybe_fail  # noqa: F401

@@ -1,0 +1,193 @@
+"""Checkpoint / resume (SURVEY.md §5 "Checkpoint / resume").
+
+Partial elimination forests are associative, mergeable state, so the
+natural unit of recovery is the *chunk*: persist ``(phase, next global
+chunk index, O(V) arrays)`` every N chunks, and on restart re-open the
+EdgeStream at the saved chunk index (``EdgeStream.chunks(start_chunk=...)``)
+and continue. Each save costs O(V) bytes — independent of E, so
+checkpointing a trillion-edge run is as cheap as a million-edge one.
+
+Crash safety: the arrays go to a uniquely-named ``.npz`` written via a
+temp file + ``os.replace``; the manifest (also atomically replaced) names
+that file, so a crash at any instant leaves either the old or the new
+checkpoint fully intact, never a torn one. Multi-host runs write one
+checkpoint per process (``process`` tag in the filename), mirroring how the
+reference would restart individual MPI ranks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+# phase progression of every backend's pipeline (SURVEY.md §3.1)
+PHASES = ("degrees", "build", "score", "done")
+
+
+def phase_index(phase: str) -> int:
+    return PHASES.index(phase)
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    phase: str
+    chunk_idx: int  # next global chunk index to process in `phase`
+    arrays: Dict[str, np.ndarray]
+    meta: Dict
+
+    def matches(self, meta: Dict) -> bool:
+        """A checkpoint only resumes a run with identical inputs and
+        options. Exact dict equality: a missing key on either side (e.g. a
+        sharded-pipeline checkpoint resumed by the single-device backend,
+        whose state arrays are shaped differently) is a mismatch."""
+        return self.meta == meta
+
+
+class Checkpointer:
+    """Per-process checkpoint writer/reader rooted at a directory.
+
+    ``every`` is the save cadence in chunks (or batches for the sharded
+    pipeline); backends call :meth:`due` inside their streaming loops and
+    :meth:`save` when it fires.
+    """
+
+    def __init__(self, directory: str, every: int = 64, process: int = 0):
+        if every < 1:
+            raise ValueError("checkpoint cadence must be >= 1 chunk")
+        self.dir = directory
+        self.every = int(every)
+        self.process = int(process)
+        os.makedirs(directory, exist_ok=True)
+
+    # -- cadence -----------------------------------------------------------
+    def due(self, chunks_done: int) -> bool:
+        return chunks_done > 0 and chunks_done % self.every == 0
+
+    # -- paths -------------------------------------------------------------
+    @property
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, f"sheep_ckpt_p{self.process}.json")
+
+    def _data_name(self, phase: str, chunk_idx: int) -> str:
+        return f"sheep_ckpt_p{self.process}_{phase}_{chunk_idx}.npz"
+
+    # -- save / load -------------------------------------------------------
+    def save(self, phase: str, chunk_idx: int,
+             arrays: Dict[str, np.ndarray], meta: Optional[Dict] = None) -> None:
+        assert phase in PHASES, phase
+        name = self._data_name(phase, chunk_idx)
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.dir, name))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        manifest = {
+            "version": FORMAT_VERSION,
+            "phase": phase,
+            "chunk_idx": int(chunk_idx),
+            "data": name,
+            "meta": meta or {},
+        }
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".json.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._manifest_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        self._sweep(keep=name)
+
+    def load(self) -> Optional[CheckpointState]:
+        try:
+            with open(self._manifest_path) as f:
+                manifest = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+        if manifest.get("version") != FORMAT_VERSION:
+            return None
+        data_path = os.path.join(self.dir, manifest["data"])
+        try:
+            with np.load(data_path) as z:
+                arrays = {k: z[k] for k in z.files}
+        except (FileNotFoundError, OSError):
+            return None
+        return CheckpointState(
+            phase=manifest["phase"],
+            chunk_idx=int(manifest["chunk_idx"]),
+            arrays=arrays,
+            meta=manifest.get("meta", {}),
+        )
+
+    def clear(self) -> None:
+        self._sweep(keep=None)
+        try:
+            os.remove(self._manifest_path)
+        except FileNotFoundError:
+            pass
+
+    def _sweep(self, keep: Optional[str]) -> None:
+        """Remove this process's stale data files (all but `keep`)."""
+        prefix = f"sheep_ckpt_p{self.process}_"
+        for fname in os.listdir(self.dir):
+            if fname.startswith(prefix) and fname.endswith(".npz") and fname != keep:
+                try:
+                    os.remove(os.path.join(self.dir, fname))
+                except FileNotFoundError:
+                    pass
+
+
+def stream_meta(stream, k: int, chunk_edges: int, weights: str,
+                alpha: float, comm_volume: bool, **extra) -> Dict:
+    """Run fingerprint stored in the manifest; resume refuses to continue
+    from a checkpoint whose fingerprint differs, because *every* option that
+    affects the result is part of it — a different graph/k/chunking would
+    corrupt the partition, a different alpha/weights would mix two
+    assignments into one set of score counters, and a different comm_volume
+    flag would undercount the cv_keys accumulated before the checkpoint."""
+    meta = {
+        "path": getattr(stream, "path", None),
+        "n_vertices": int(stream.num_vertices),
+        "k": int(k),
+        "chunk_edges": int(chunk_edges),
+        "weights": str(weights),
+        "alpha": float(alpha),
+        "comm_volume": bool(comm_volume),
+    }
+    m = stream.num_edges_cheap
+    if m is not None:
+        meta["num_edges"] = int(m)
+    meta.update(extra)
+    return meta
+
+
+def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
+                 resume: bool) -> Optional[CheckpointState]:
+    """Load-and-validate helper shared by the backends."""
+    if checkpointer is None or not resume:
+        return None
+    state = checkpointer.load()
+    if state is None:
+        return None
+    if not state.matches(meta):
+        raise ValueError(
+            "checkpoint does not match this run "
+            f"(saved {state.meta}, current {meta}); "
+            "pass a fresh --checkpoint-dir or drop --resume")
+    return state

@@ -1,0 +1,42 @@
+"""Fault injection (SURVEY.md §5 "Failure detection / fault injection").
+
+A test hook that kills the pipeline mid-stream, exercising the
+checkpoint/resume recovery path. Enabled via the environment variable
+
+    SHEEP_FAULT_INJECT="<phase>:<chunks>"     e.g. "build:3"
+
+which makes the named phase raise :class:`InjectedFault` after processing
+that many chunks. The recovery tests (tests/test_checkpoint.py) inject a
+fault, catch it, then resume from the last checkpoint and assert the final
+partition is identical to an uninterrupted run — the mergeable-forest
+property that makes chunk-level restart sound.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "SHEEP_FAULT_INJECT"
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injection hook; never raised in production runs."""
+
+
+def _parse(spec: str):
+    phase, _, count = spec.partition(":")
+    try:
+        return phase, int(count)
+    except ValueError:
+        raise ValueError(f"bad {ENV_VAR} spec {spec!r}; want '<phase>:<int>'")
+
+
+def maybe_fail(phase: str, chunks_done: int) -> None:
+    """Raise InjectedFault iff the env hook targets this phase and count."""
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        return
+    target_phase, target_count = _parse(spec)
+    if phase == target_phase and chunks_done >= target_count:
+        raise InjectedFault(
+            f"injected fault in phase {phase!r} after {chunks_done} chunks")

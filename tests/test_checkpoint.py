@@ -1,0 +1,177 @@
+"""Checkpoint/resume + fault-injection recovery (SURVEY.md §5, §4).
+
+The core property: a run killed mid-stream and resumed from its last
+checkpoint produces the *identical* partition to an uninterrupted run —
+sound because the carried state (degree counts, partial forests, score
+counters) is mergeable across chunk boundaries.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.base import get_backend, list_backends
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.io import generators
+from sheep_tpu.utils.checkpoint import Checkpointer, resume_state, stream_meta
+from sheep_tpu.utils.fault import ENV_VAR, InjectedFault
+
+K = 4
+CHUNK = 256  # small so even tiny graphs span many chunks
+
+
+def graph():
+    e = generators.rmat(10, 8, seed=3)
+    return EdgeStream.from_array(e, n_vertices=1 << 10)
+
+
+STREAMING_BACKENDS = [b for b in ("cpu", "tpu", "tpu-sharded")
+                      if b in list_backends()]
+
+
+# ---------------------------------------------------------------- unit level
+
+def test_save_load_roundtrip(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=2)
+    arrays = {"deg": np.arange(10, dtype=np.int64), "cut": np.int64(7)}
+    ck.save("build", 6, arrays, {"k": 4})
+    state = ck.load()
+    assert state.phase == "build" and state.chunk_idx == 6
+    assert np.array_equal(state.arrays["deg"], arrays["deg"])
+    assert int(state.arrays["cut"]) == 7
+    assert state.meta == {"k": 4}
+
+
+def test_sweep_keeps_only_latest(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("degrees", 1, {"deg": np.zeros(4, np.int64)})
+    ck.save("degrees", 2, {"deg": np.zeros(4, np.int64)})
+    npz = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+    assert len(npz) == 1 and "_2" in npz[0]
+
+
+def test_clear(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("degrees", 1, {"deg": np.zeros(4, np.int64)})
+    ck.clear()
+    assert ck.load() is None
+
+
+def test_per_process_isolation(tmp_path):
+    a = Checkpointer(str(tmp_path), every=1, process=0)
+    b = Checkpointer(str(tmp_path), every=1, process=1)
+    a.save("degrees", 1, {"deg": np.zeros(4, np.int64)})
+    b.save("build", 9, {"deg": np.ones(4, np.int64)})
+    assert a.load().phase == "degrees"
+    assert b.load().phase == "build" and b.load().chunk_idx == 9
+
+
+def _meta(es, **over):
+    kw = dict(k=8, chunk_edges=CHUNK, weights="unit", alpha=1.0,
+              comm_volume=True)
+    kw.update(over)
+    return stream_meta(es, **kw)
+
+
+@pytest.mark.parametrize("change", [
+    {"k": 4}, {"alpha": 0.9}, {"comm_volume": False}, {"weights": "degree"},
+    {"chunk_edges": CHUNK * 2},
+])
+def test_resume_refuses_mismatched_options(tmp_path, change):
+    ck = Checkpointer(str(tmp_path), every=1)
+    es = graph()
+    ck.save("build", 2, {"deg": np.zeros(4, np.int64)}, _meta(es))
+    with pytest.raises(ValueError, match="does not match"):
+        resume_state(ck, _meta(es, **change), resume=True)
+
+
+def test_resume_refuses_cross_backend_state(tmp_path):
+    """A sharded checkpoint resumed by the single-device backend must be a
+    clean refusal, not a KeyError deep in partition()."""
+    es = graph()
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("build", 2, {"deg": np.zeros(4, np.int64)},
+            _meta(es, state_format="sharded", devices=8))
+    with pytest.raises(ValueError, match="does not match"):
+        resume_state(ck, _meta(es, state_format="minp"), resume=True)
+
+
+def test_pure_backend_rejects_checkpointer(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=1)
+    with pytest.raises(ValueError, match="does not checkpoint"):
+        get_backend("pure").partition(graph(), K, checkpointer=ck)
+
+
+def test_cadence():
+    ck = Checkpointer("/tmp/_sheep_unused", every=3)
+    assert [i for i in range(1, 10) if ck.due(i)] == [3, 6, 9]
+
+
+# ------------------------------------------------------- recovery end-to-end
+
+@pytest.mark.parametrize("backend", STREAMING_BACKENDS)
+@pytest.mark.parametrize("phase", ["degrees", "build", "score"])
+def test_fault_then_resume_matches_uninterrupted(tmp_path, backend, phase,
+                                                 monkeypatch):
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    expect = get_backend(backend, **kw).partition(es, K, comm_volume=True)
+
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, f"{phase}:2")
+    with pytest.raises(InjectedFault):
+        get_backend(backend, **kw).partition(
+            es, K, comm_volume=True, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    saved = ck.load()
+    assert saved is not None, "no checkpoint written before the fault"
+
+    res = get_backend(backend, **kw).partition(
+        es, K, comm_volume=True, checkpointer=ck, resume=True)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert res.edge_cut == expect.edge_cut
+    assert res.total_edges == expect.total_edges
+    assert res.comm_volume == expect.comm_volume
+
+
+@pytest.mark.parametrize("backend", STREAMING_BACKENDS[:1])
+def test_resume_without_checkpoint_is_fresh_run(tmp_path, backend):
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    ck = Checkpointer(str(tmp_path), every=4)
+    expect = get_backend(backend, **kw).partition(es, K)
+    res = get_backend(backend, **kw).partition(es, K, checkpointer=ck,
+                                               resume=True)
+    assert np.array_equal(res.assignment, expect.assignment)
+
+
+def test_cli_checkpoint_resume(tmp_path, monkeypatch):
+    from sheep_tpu import cli
+    from sheep_tpu.io import formats
+
+    e = generators.rmat(9, 8, seed=5)
+    gpath = str(tmp_path / "g.bin64")
+    formats.write_edges(gpath, e)
+    ckdir = str(tmp_path / "ck")
+
+    out1 = str(tmp_path / "full.parts")
+    assert cli.main(["--input", gpath, "--k", "4", "--backend",
+                     STREAMING_BACKENDS[0], "--chunk-edges", str(CHUNK),
+                     "--output", out1, "--json"]) == 0
+
+    monkeypatch.setenv(ENV_VAR, "build:2")
+    with pytest.raises(InjectedFault):
+        cli.main(["--input", gpath, "--k", "4", "--backend",
+                  STREAMING_BACKENDS[0], "--chunk-edges", str(CHUNK),
+                  "--checkpoint-dir", ckdir, "--checkpoint-every", "1",
+                  "--json"])
+    monkeypatch.delenv(ENV_VAR)
+
+    out2 = str(tmp_path / "resumed.parts")
+    assert cli.main(["--input", gpath, "--k", "4", "--backend",
+                     STREAMING_BACKENDS[0], "--chunk-edges", str(CHUNK),
+                     "--checkpoint-dir", ckdir, "--resume",
+                     "--output", out2, "--json"]) == 0
+    assert np.array_equal(formats.read_partition(out1),
+                          formats.read_partition(out2))
