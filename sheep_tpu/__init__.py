@@ -27,7 +27,7 @@ def partition(path, k, backend=None, **opts):
 
     ``backend=None`` auto-selects the best registered backend
     (tpu > cpu > pure). Constructor options of the chosen backend (e.g.
-    ``chunk_edges``, ``alpha``, ``climb_steps``) and partition options
+    ``chunk_edges``, ``alpha``, ``lift_levels``) and partition options
     (e.g. ``weights``, ``comm_volume``) are both accepted; unknown options
     raise TypeError rather than being silently dropped.
     """

@@ -107,17 +107,14 @@ class CpuBackend(Partitioner):
             idx += 1
             maybe_fail("score", idx - start)
             if checkpointer is not None and checkpointer.due(idx - start):
-                keys = (np.unique(np.concatenate(cv_parts))
-                        if cv_parts else np.zeros(0, np.int64))
-                cv_parts = [keys] if comm_volume else []
-                checkpointer.save(
-                    "score", idx,
-                    {"deg": deg, "parent": parent,
-                     "cut": np.int64(cut), "total": np.int64(total),
-                     "cv_keys": keys}, meta)
-        cv = (int(len(np.unique(np.concatenate(cv_parts)))) if cv_parts else 0) if comm_volume else None
+                cv_parts = ckpt.save_score_state(
+                    checkpointer, idx, cut, total, cv_parts,
+                    {"deg": deg, "parent": parent}, meta, comm_volume)
+        cv = int(len(ckpt.compact_cv_keys(cv_parts))) if comm_volume else None
         balance = pure.part_balance(assignment, k, deg if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        if checkpointer is not None:
+            checkpointer.clear()
 
         return PartitionResult(
             assignment=assignment, k=k, edge_cut=cut, total_edges=total,

@@ -5,7 +5,7 @@ Single-device streaming pipeline (the sharded multi-device path lives in
 
   pass 1  degrees        scatter-add per chunk           (device)
   sort    elim order     one int64 key sort              (device)
-  pass 2  tree build     constraint-rewrite fixpoint     (device, O(V+C) mem)
+  pass 2  tree build     constraint-rewrite fixpoint     (device, O(V+C) + capped tables)
   split   tree split     two linear passes over O(V)     (host)
   pass 3  scoring        gathered counters               (device)
 
@@ -52,10 +52,10 @@ class TpuBackend(Partitioner):
     name = "tpu"
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
-    def __init__(self, chunk_edges: int = 1 << 22, climb_steps: int = 4,
+    def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0):
         self.chunk_edges = chunk_edges
-        self.climb_steps = climb_steps
+        self.lift_levels = lift_levels
         self.alpha = alpha
 
     def partition(self, stream, k: int, weights: str = "unit",
@@ -128,7 +128,7 @@ class TpuBackend(Partitioner):
             for chunk in stream.chunks(cs, start_chunk=start):
                 minp, rounds = elim_ops.build_chunk_step(
                     minp, pad_chunk(chunk, cs, n), pos, order, n,
-                    climb_steps=self.climb_steps)
+                    lift_levels=self.lift_levels)
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)
@@ -171,24 +171,19 @@ class TpuBackend(Partitioner):
             idx += 1
             maybe_fail("score", idx - start)
             if checkpointer is not None and checkpointer.due(idx - start):
-                keys = (np.unique(np.concatenate(cv_chunks))
-                        if cv_chunks else np.zeros(0, np.int64))
-                cv_chunks = [keys] if comm_volume else []
-                checkpointer.save(
-                    "score", idx,
-                    {"deg": deg_host, "minp": np.asarray(minp),
-                     "cut": np.int64(cut), "total": np.int64(total),
-                     "cv_keys": keys}, meta)
-        cv = None
-        if comm_volume:
-            allk = np.concatenate(cv_chunks) if cv_chunks else np.zeros(0, np.int64)
-            cv = int(len(np.unique(allk)))
+                cv_chunks = ckpt.save_score_state(
+                    checkpointer, idx, cut, total, cv_chunks,
+                    {"deg": deg_host, "minp": np.asarray(minp)}, meta,
+                    comm_volume)
+        cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
         from sheep_tpu.core import pure
 
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
         t["fixpoint_rounds"] = float(total_rounds)
+        if checkpointer is not None:
+            checkpointer.clear()
 
         return PartitionResult(
             assignment=assign_host, k=k, edge_cut=cut, total_edges=total,

@@ -21,10 +21,10 @@ class TpuShardedBackend(Partitioner):
     name = "tpu-sharded"
     supports_multidevice = True
 
-    def __init__(self, chunk_edges: int = 1 << 22, climb_steps: int = 4,
+    def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0, n_devices: int | None = None):
         self.chunk_edges = chunk_edges
-        self.climb_steps = climb_steps
+        self.lift_levels = lift_levels
         self.alpha = alpha
         self.n_devices = n_devices
 
@@ -40,7 +40,7 @@ class TpuShardedBackend(Partitioner):
         m_cheap = stream.num_edges_cheap
         if m_cheap is not None:
             cs = min(cs, max(1024, -(-m_cheap // mesh.devices.size)))
-        pipe = ShardedPipeline(n, cs, mesh, climb_steps=self.climb_steps)
+        pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,

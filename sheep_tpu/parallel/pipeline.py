@@ -64,11 +64,11 @@ def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
 class ShardedPipeline:
     """Compiled sharded pipeline for a fixed (n, chunk_edges, mesh)."""
 
-    def __init__(self, n: int, chunk_edges: int, mesh, climb_steps: int = 4):
+    def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0):
         self.n = n
         self.cs = chunk_edges
         self.mesh = mesh
-        self.climb_steps = climb_steps
+        self.lift_levels = lift_levels
         d = mesh.devices.size
         self.n_devices = d
         self.rounds = max(1, math.ceil(math.log2(d))) if d > 1 else 0
@@ -78,7 +78,7 @@ class ShardedPipeline:
         self.repl_sharding = NamedSharding(mesh, P())
 
         n_ = self.n
-        climb = self.climb_steps
+        lift = self.lift_levels
 
         @partial(jax.jit,
                  in_shardings=(self.state_sharding, self.batch_sharding),
@@ -107,7 +107,7 @@ class ShardedPipeline:
             def f(forest_local, chunk_local, pos_, order_):
                 minp, _ = elim_ops.build_chunk_step(
                     forest_local[0], chunk_local[0], pos_, order_, n_,
-                    climb_steps=climb)
+                    lift_levels=lift)
                 return minp[None]
             return shard_map(
                 f, mesh=mesh,
@@ -136,7 +136,7 @@ class ShardedPipeline:
                     # is complete after ceil(log2 d) rounds for any d.
                     other = jnp.where((idx ^ (1 << r)) < d_, other, jnp.int32(n_))
                     forest = elim_ops.merge_forests(
-                        forest, other, pos_, order_, n_, climb_steps=climb)
+                        forest, other, pos_, order_, n_, lift_levels=lift)
                 return forest[None]
             merged = shard_map(
                 f, mesh=mesh,
@@ -226,7 +226,9 @@ class ShardedPipeline:
                 since += 1
                 batches += 1
                 maybe_fail("degrees", batches)
-                at_ckpt = checkpointer is not None and checkpointer.due(batches)
+                # cadence is in *chunks* (one batch = d chunks), matching
+                # the single-device backends and the --checkpoint-every doc
+                at_ckpt = checkpointer is not None and checkpointer.due(batches * d)
                 if since >= flush_every or at_ckpt:
                     deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
                                            dtype=np.int64)
@@ -254,8 +256,13 @@ class ShardedPipeline:
             merged = jnp.asarray(state.arrays["merged"])
         else:
             if state and state.phase == "build":
-                forest_all = jax.device_put(state.arrays["forest_all"],
-                                            self.state_sharding)
+                # build checkpoints store the O(V) *merged* forest, not the
+                # O(V*d) per-device stack; merging is associative and
+                # idempotent, so re-seeding one shard with it (others
+                # empty) reproduces the identical fixpoint
+                fa = np.full((d, n + 1), n, np.int32)
+                fa[0] = state.arrays["merged_partial"]
+                forest_all = jax.device_put(fa, self.state_sharding)
                 start = state.chunk_idx
             else:
                 forest_all = self.init_forest()
@@ -266,11 +273,11 @@ class ShardedPipeline:
                                              pos, order)
                 batches += 1
                 maybe_fail("build", batches)
-                if checkpointer is not None and checkpointer.due(batches):
+                if checkpointer is not None and checkpointer.due(batches * d):
+                    partial = np.asarray(self.merge_all(forest_all, pos, order))
                     checkpointer.save(
                         "build", start + batches * d,
-                        {"deg": deg_host, "forest_all": np.asarray(forest_all)},
-                        meta)
+                        {"deg": deg_host, "merged_partial": partial}, meta)
             merged = self.merge_all(forest_all, pos, order)
             merged.block_until_ready()
         t["build+merge"] = time.perf_counter() - t0
@@ -306,20 +313,17 @@ class ShardedPipeline:
                 cv_chunks.append(score_ops.cut_pair_keys_host(batch, assign, n, k))
             batches += 1
             maybe_fail("score", batches)
-            if checkpointer is not None and checkpointer.due(batches):
-                keys = (np.unique(np.concatenate(cv_chunks))
-                        if cv_chunks else np.zeros(0, np.int64))
-                cv_chunks = [keys] if comm_volume else []
-                checkpointer.save(
-                    "score", start + batches * d,
-                    {"deg": deg_host, "merged": np.asarray(merged),
-                     "cut": np.int64(cut), "total": np.int64(total),
-                     "cv_keys": keys}, meta)
-        cv = (int(len(np.unique(np.concatenate(cv_chunks)))) if cv_chunks else 0) \
-            if comm_volume else None
+            if checkpointer is not None and checkpointer.due(batches * d):
+                cv_chunks = ckpt.save_score_state(
+                    checkpointer, start + batches * d, cut, total, cv_chunks,
+                    {"deg": deg_host, "merged": np.asarray(merged)}, meta,
+                    comm_volume)
+        cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        if checkpointer is not None:
+            checkpointer.clear()
         return {
             "assignment": assign_host, "parent": parent, "pos": pos_host,
             "degrees": deg_host, "edge_cut": cut, "total_edges": total,

@@ -27,8 +27,9 @@ import numpy as np
 
 FORMAT_VERSION = 1
 
-# phase progression of every backend's pipeline (SURVEY.md §3.1)
-PHASES = ("degrees", "build", "score", "done")
+# phase progression of every backend's pipeline (SURVEY.md §3.1); a
+# successful run clears its checkpoint instead of writing a terminal phase
+PHASES = ("degrees", "build", "score")
 
 
 def phase_index(phase: str) -> int:
@@ -170,11 +171,50 @@ def stream_meta(stream, k: int, chunk_edges: int, weights: str,
         "alpha": float(alpha),
         "comm_volume": bool(comm_volume),
     }
+    # content identity, not just the name: a regenerated file at the same
+    # path (same V, same E) must not resume against old partial state
+    if meta["path"] is not None:
+        try:
+            st = os.stat(meta["path"])
+            meta["file_size"] = int(st.st_size)
+            meta["file_mtime_ns"] = int(st.st_mtime_ns)
+        except OSError:
+            pass
+    elif getattr(stream, "_edges", None) is not None:
+        # in-memory stream: hash a bounded sample so two arrays with the
+        # same (V, E) but different edges cannot cross-resume
+        import hashlib
+
+        e = stream._edges
+        sample = np.ascontiguousarray(np.concatenate([e[:4096], e[-4096:]]))
+        meta["content_sha1"] = hashlib.sha1(sample.tobytes()).hexdigest()
     m = stream.num_edges_cheap
     if m is not None:
         meta["num_edges"] = int(m)
     meta.update(extra)
     return meta
+
+
+def compact_cv_keys(cv_chunks) -> np.ndarray:
+    """Merge accumulated cut-pair key arrays into one sorted unique array
+    (the comm-volume accumulator; SURVEY.md §2 #8)."""
+    if not cv_chunks:
+        return np.zeros(0, np.int64)
+    return np.unique(np.concatenate(cv_chunks))
+
+
+def save_score_state(checkpointer: Checkpointer, chunk_idx: int, cut: int,
+                     total: int, cv_chunks, extra_arrays: Dict, meta: Dict,
+                     comm_volume: bool):
+    """Shared score-phase checkpoint: compact the cv-key accumulator, save
+    it with the counters, and return the compacted accumulator list the
+    caller should carry forward (empty when comm_volume is off)."""
+    keys = compact_cv_keys(cv_chunks)
+    checkpointer.save(
+        "score", chunk_idx,
+        {**extra_arrays, "cut": np.int64(cut), "total": np.int64(total),
+         "cv_keys": keys}, meta)
+    return [keys] if comm_volume else []
 
 
 def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,

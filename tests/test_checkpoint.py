@@ -103,8 +103,8 @@ def test_pure_backend_rejects_checkpointer(tmp_path):
         get_backend("pure").partition(graph(), K, checkpointer=ck)
 
 
-def test_cadence():
-    ck = Checkpointer("/tmp/_sheep_unused", every=3)
+def test_cadence(tmp_path):
+    ck = Checkpointer(str(tmp_path), every=3)
     assert [i for i in range(1, 10) if ck.due(i)] == [3, 6, 9]
 
 
@@ -133,6 +133,44 @@ def test_fault_then_resume_matches_uninterrupted(tmp_path, backend, phase,
     assert res.edge_cut == expect.edge_cut
     assert res.total_edges == expect.total_edges
     assert res.comm_volume == expect.comm_volume
+
+
+def test_successful_run_clears_checkpoint(tmp_path):
+    es = graph()
+    ck = Checkpointer(str(tmp_path), every=1)
+    get_backend(STREAMING_BACKENDS[0], chunk_edges=CHUNK).partition(
+        es, K, checkpointer=ck)
+    assert ck.load() is None, "completed run left a stale checkpoint"
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
+
+
+def test_resume_refuses_different_inmemory_graph(tmp_path):
+    """Two in-memory graphs with identical (V, E) but different edges must
+    not cross-resume: the fingerprint hashes sampled edge content."""
+    a = EdgeStream.from_array(generators.rmat(10, 8, seed=3), n_vertices=1 << 10)
+    b = EdgeStream.from_array(generators.rmat(10, 8, seed=4), n_vertices=1 << 10)
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("build", 2, {"deg": np.zeros(4, np.int64)}, _meta(a))
+    with pytest.raises(ValueError, match="does not match"):
+        resume_state(ck, _meta(b), resume=True)
+
+
+def test_resume_refuses_regenerated_input_file(tmp_path):
+    """Same path + same shape but different bytes must not resume: the
+    fingerprint includes file size/mtime (content identity)."""
+    from sheep_tpu.io import formats
+
+    gpath = str(tmp_path / "g.bin64")
+    formats.write_edges(gpath, generators.rmat(9, 8, seed=1))
+    with EdgeStream.open(gpath) as es:
+        meta_a = _meta(es)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    ck.save("build", 2, {"deg": np.zeros(4, np.int64)}, meta_a)
+
+    os.utime(gpath, ns=(1, 1))  # same bytes, different mtime
+    with EdgeStream.open(gpath) as es:
+        with pytest.raises(ValueError, match="does not match"):
+            resume_state(ck, _meta(es), resume=True)
 
 
 @pytest.mark.parametrize("backend", STREAMING_BACKENDS[:1])

@@ -53,17 +53,31 @@ def test_degrees_and_order_match_oracle(graph):
     assert int(pos[n]) == n and int(order[n]) == n
 
 
-@pytest.mark.parametrize("climb_steps", [1, 4])
-def test_fixpoint_tree_matches_oracle(graph, climb_steps):
+@pytest.mark.parametrize("lift_levels", [1, 0])
+def test_fixpoint_tree_matches_oracle(graph, lift_levels):
     e, n = graph
     pos, order = _device_order(e, n)
     minp, rounds = elim_ops.build_chunk_step(
         jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n),
-        pos, order, n, climb_steps=climb_steps)
+        pos, order, n, lift_levels=lift_levels)
     parent = elim_ops.minp_to_parent(minp, order, n)
     expect = pure.build_elim_tree(e, pure.elimination_order(pure.degrees(e, n))).parent
     np.testing.assert_array_equal(parent, expect)
     assert int(rounds) < n  # converged well before the trivial bound
+
+
+@pytest.mark.parametrize("descent", ["exact", "stream"])
+def test_fixpoint_descent_modes_match_oracle(graph, descent):
+    e, n = graph
+    pos, order = _device_order(e, n)
+    lo, hi = elim_ops.orient_edges(
+        jnp.asarray(pad_chunk(e, len(e), n)), pos, n)
+    minp, rounds = elim_ops.elim_fixpoint(lo, hi, pos, order, n,
+                                          descent=descent)
+    parent = elim_ops.minp_to_parent(minp, order, n)
+    expect = pure.build_elim_tree(
+        e, pure.elimination_order(pure.degrees(e, n))).parent
+    np.testing.assert_array_equal(parent, expect)
 
 
 def test_streaming_chunks_match_batch(graph):
