@@ -181,7 +181,6 @@ class TpuBackend(Partitioner):
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
-        t["fixpoint_rounds"] = float(total_rounds)
         if checkpointer is not None:
             checkpointer.clear()
 
@@ -189,4 +188,5 @@ class TpuBackend(Partitioner):
             assignment=assign_host, k=k, edge_cut=cut, total_edges=total,
             cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
             phase_times=t, backend=self.name,
+            diagnostics={"fixpoint_rounds": float(total_rounds)},
         )

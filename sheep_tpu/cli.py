@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="vertex count if known (skips a counting pass)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax profiler trace (tpu backend) to this dir")
+    p.add_argument("--metrics-out", default=None,
+                   help="append structured JSONL metrics (phases, scores, "
+                        "part loads, device memory) to this file")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save O(V) chunk-level checkpoints to this dir")
     p.add_argument("--checkpoint-every", type=int, default=64,
@@ -51,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--json", action="store_true", help="print only the JSON result line")
     p.add_argument("--list-backends", action="store_true", help="list backends and exit")
+    mh = p.add_argument_group("multi-host (the reference's mpirun equivalent)")
+    mh.add_argument("--coordinator", default=None,
+                    help="coordinator address host:port; launch one process "
+                         "per host with the same value")
+    mh.add_argument("--num-processes", type=int, default=None,
+                    help="total number of processes in the multi-host run")
+    mh.add_argument("--process-id", type=int, default=None,
+                    help="this process's rank in [0, num_processes)")
     return p
 
 
@@ -69,6 +80,19 @@ def main(argv=None) -> int:
         build_parser().error("--input and --k are required")
     if args.resume and not args.checkpoint_dir:
         build_parser().error("--resume requires --checkpoint-dir")
+
+    is_main = True
+    process_id = 0
+    if args.coordinator or args.num_processes:
+        from sheep_tpu.parallel.mesh import init_distributed
+
+        init_distributed(args.coordinator, args.num_processes, args.process_id)
+        import jax
+
+        process_id = jax.process_index()
+        is_main = process_id == 0
+        if args.backend is None:
+            args.backend = "tpu-sharded"
 
     backend = args.backend
     if backend is None:
@@ -91,7 +115,8 @@ def main(argv=None) -> int:
 
             ckpt_kw = {
                 "checkpointer": Checkpointer(args.checkpoint_dir,
-                                             every=args.checkpoint_every),
+                                             every=args.checkpoint_every,
+                                             process=process_id),
                 "resume": args.resume,
             }
         profile = None
@@ -110,13 +135,21 @@ def main(argv=None) -> int:
         n = es.num_vertices
         m = res.total_edges
 
-    if args.output:
+    if args.output and is_main:
         write_partition(args.output, res.assignment)
+
+    if args.metrics_out and is_main:
+        from sheep_tpu.utils.metrics import MetricsWriter, emit_run_metrics
+
+        with MetricsWriter(args.metrics_out) as mw:
+            emit_run_metrics(mw, res, n, wall, graph=args.input)
 
     summary = res.summary()
     summary["wall_seconds"] = round(wall, 4)
     summary["edges_per_sec"] = round(m / wall, 1) if wall > 0 else None
     summary["n_vertices"] = n
+    if not is_main:
+        return 0
     if not args.json:
         print(f"graph: {args.input}  V={n:,}  E={m:,}")
         print(f"backend: {res.backend}  k={res.k}")

@@ -1,0 +1,114 @@
+// Sanitizer selftest for the native core (SURVEY.md §5 "Race detection /
+// sanitizers"): exercises every exported function on synthetic graphs with
+// invariant checks, built with -fsanitize=address,undefined by
+// `make sanitize` and run by tests/test_sanitize.py. A standalone binary
+// (rather than loading a sanitized .so into Python) so the ASan runtime
+// needs no LD_PRELOAD gymnastics.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+using i64 = int64_t;
+using i32 = int32_t;
+
+extern "C" {
+void sheep_degrees(const i64*, i64, i64, i64*);
+void sheep_elim_order(const i64*, i64, i64*);
+void sheep_build_elim_tree(const i64*, i64, const i64*, i64, i64*);
+void sheep_merge_trees(i64*, const i64*, const i64*, i64);
+void sheep_tree_split(const i64*, const i64*, const double*, i64, i64, double,
+                      i32*);
+void sheep_score_chunk(const i64*, i64, const i32*, i64, i64*, i64*);
+i64 sheep_cut_pairs(const i64*, i64, const i32*, i64, i64, i64*);
+i64 sheep_parse_text(const char*, i64, i64*, i64, i64*);
+i64 sheep_core_abi_version();
+}
+
+static uint64_t rng_state = 0x9e3779b97f4a7c15ull;
+static uint64_t rng() {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return rng_state;
+}
+
+#define CHECK(cond, msg)                              \
+  do {                                                \
+    if (!(cond)) {                                    \
+      std::fprintf(stderr, "FAIL: %s\n", msg);        \
+      std::exit(1);                                   \
+    }                                                 \
+  } while (0)
+
+int main() {
+  CHECK(sheep_core_abi_version() == 1, "abi version");
+
+  const i64 n = 700, m = 4000, k = 7;
+  std::vector<i64> edges(2 * m);
+  for (i64 i = 0; i < m; ++i) {
+    edges[2 * i] = (i64)(rng() % n);
+    edges[2 * i + 1] = (i64)(rng() % n);
+  }
+  // a few malformed rows exercise the bounds checks
+  edges[0] = -3;
+  edges[3] = n + 17;
+  edges[10] = edges[11];  // self loop
+
+  std::vector<i64> deg(n, 0);
+  sheep_degrees(edges.data(), m, n, deg.data());
+
+  std::vector<i64> pos(n);
+  sheep_elim_order(deg.data(), n, pos.data());
+  std::vector<char> seen(n, 0);
+  for (i64 v = 0; v < n; ++v) {
+    CHECK(pos[v] >= 0 && pos[v] < n, "pos in range");
+    CHECK(!seen[pos[v]], "pos is a permutation");
+    seen[pos[v]] = 1;
+  }
+
+  // one-shot build vs chunked build + merge must agree (associativity)
+  std::vector<i64> parent(n, -1);
+  sheep_build_elim_tree(edges.data(), m, pos.data(), n, parent.data());
+  for (i64 v = 0; v < n; ++v)
+    if (parent[v] >= 0)
+      CHECK(pos[parent[v]] > pos[v], "parent later in elimination order");
+
+  std::vector<i64> pa(n, -1), pb(n, -1);
+  const i64 half = m / 2;
+  sheep_build_elim_tree(edges.data(), half, pos.data(), n, pa.data());
+  sheep_build_elim_tree(edges.data() + 2 * half, m - half, pos.data(), n,
+                        pb.data());
+  sheep_merge_trees(pa.data(), pb.data(), pos.data(), n);
+  CHECK(std::memcmp(pa.data(), parent.data(), n * sizeof(i64)) == 0,
+        "chunked+merged tree == one-shot tree");
+
+  std::vector<double> w(n, 1.0);
+  std::vector<i32> assign(n, -1);
+  sheep_tree_split(parent.data(), pos.data(), w.data(), n, k, 1.0,
+                   assign.data());
+  for (i64 v = 0; v < n; ++v)
+    CHECK(assign[v] >= 0 && assign[v] < k, "assignment in range");
+
+  i64 cut = 0, total = 0;
+  sheep_score_chunk(edges.data(), m, assign.data(), n, &cut, &total);
+  CHECK(total <= m && cut <= total, "score counters sane");
+
+  std::vector<i64> pairs(2 * m);
+  i64 npairs = sheep_cut_pairs(edges.data(), m, assign.data(), n, k,
+                               pairs.data());
+  CHECK(npairs == 2 * cut, "two cut pairs per cut edge");
+
+  const char* text = "# comment\n1 2\n\n3\t4\n 9 9 \nbogus line\n5 6";
+  std::vector<i64> out(64);
+  i64 consumed = 0;
+  i64 ne = sheep_parse_text(text, (i64)std::strlen(text), out.data(), 32,
+                            &consumed);
+  CHECK(ne == 3, "parsed complete lines only");
+  CHECK(out[0] == 1 && out[1] == 2 && out[4] == 9, "parsed values");
+
+  std::puts("selftest OK");
+  return 0;
+}

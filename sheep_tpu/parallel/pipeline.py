@@ -72,6 +72,16 @@ class ShardedPipeline:
         d = mesh.devices.size
         self.n_devices = d
         self.rounds = max(1, math.ceil(math.log2(d))) if d > 1 else 0
+        # multi-host layout: this process owns n_local contiguous mesh rows
+        # (jax.devices() orders by process); chunks round-robin over
+        # *processes* at the stream level and over local rows within one
+        self.procs = len({dev.process_index for dev in mesh.devices.flat})
+        self.proc = jax.process_index() if self.procs > 1 else 0
+        self.n_local = (sum(1 for dev in mesh.devices.flat
+                            if dev.process_index == jax.process_index())
+                        if self.procs > 1 else d)
+        if self.procs > 1 and self.n_local * self.procs != d:
+            raise ValueError("uneven devices per process not supported")
 
         self.batch_sharding = NamedSharding(mesh, P(SHARD_AXIS, None, None))
         self.state_sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
@@ -164,21 +174,62 @@ class ShardedPipeline:
         self.merge_all = merge_all
         self.score_step = score_step
 
+    # -- host->device placement (multi-host aware) -------------------------
+    def _put(self, sharding, arr: np.ndarray):
+        """Single process: plain device_put. Multi-host: every process
+        passes its process-local rows (or the full array for replicated
+        shardings) and JAX assembles the global array."""
+        if self.procs == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(sharding, arr)
+
     # -- state constructors ------------------------------------------------
     def init_degrees(self):
-        return jax.device_put(
-            np.zeros((self.n_devices, self.n + 1), np.int32), self.state_sharding)
+        return self._put(self.state_sharding,
+                         np.zeros((self.n_local, self.n + 1), np.int32))
 
     def init_forest(self):
-        return jax.device_put(
-            np.full((self.n_devices, self.n + 1), self.n, np.int32),
-            self.state_sharding)
+        return self._put(self.state_sharding,
+                         np.full((self.n_local, self.n + 1), self.n, np.int32))
 
     def put_batch(self, batch: np.ndarray):
-        return jax.device_put(batch, self.batch_sharding)
+        return self._put(self.batch_sharding, batch)
 
     def put_replicated(self, arr):
-        return jax.device_put(np.asarray(arr), self.repl_sharding)
+        return self._put(self.repl_sharding, np.asarray(arr))
+
+    # -- lockstep batch iteration ------------------------------------------
+    def iter_batches(self, stream, start_chunk: int = 0):
+        """Yield (n_local, C, 2) host batches from this process's shard of
+        the chunk stream. Multi-host: every process yields the SAME number
+        of batches (stragglers pad with all-sentinel batches) so the
+        per-batch collectives stay in lockstep — the count is computed
+        analytically from the stream length, no communication needed."""
+        rows = self.n_local
+        gen = (b for b, _ in chunk_batches(
+            stream, self.cs, rows, self.n, shard=self.proc,
+            num_shards=self.procs, start_chunk=start_chunk))
+        if self.procs == 1:
+            yield from gen
+            return
+        # num_edges is O(1) for binary/memory formats; for text it costs
+        # one counting parse, cached on the stream (so once per run, not
+        # per pass) — use binary edge lists for huge multi-host inputs
+        total = -(-stream.num_edges // self.cs)  # total chunks in stream
+
+        def owned(p):  # chunks i in [start_chunk, total) with i % procs == p
+            full = max(0, (total - p + self.procs - 1) // self.procs)
+            done = max(0, (start_chunk - p + self.procs - 1) // self.procs)
+            return full - done
+
+        nb = max(-(-owned(p) // rows) for p in range(self.procs))
+        produced = 0
+        for b in gen:
+            yield b
+            produced += 1
+        empty = np.full((rows, self.cs, 2), self.n, np.int32)
+        for _ in range(nb - produced):
+            yield empty
 
     # -- full run (single process; multi-host callers drive the steps) -----
     def run(self, stream, k: int, alpha: float = 1.0,
@@ -206,6 +257,10 @@ class ShardedPipeline:
                                 comm_volume=comm_volume,
                                 state_format="sharded", devices=d)
         state = ckpt.resume_state(checkpointer, meta, resume)
+        if self.procs > 1 and checkpointer is not None and resume:
+            # per-process manifests may be skewed by one save step; agree
+            # on a common step or the collective schedules desynchronize
+            state = ckpt.reconcile_multihost_resume(checkpointer, state, meta)
         from_phase = ckpt.phase_index(state.phase) if state else 0
 
         # pass 1: degrees, int32 on device with int64 host flushes so no
@@ -220,15 +275,15 @@ class ShardedPipeline:
             start = state.chunk_idx if state else 0
             deg_all = self.init_degrees()
             since = batches = 0
-            for batch, filled in chunk_batches(stream, cs, d, n,
-                                               start_chunk=start):
+            for batch in self.iter_batches(stream, start_chunk=start):
                 deg_all = self.deg_step(deg_all, self.put_batch(batch))
                 since += 1
                 batches += 1
                 maybe_fail("degrees", batches)
                 # cadence is in *chunks* (one batch = d chunks), matching
                 # the single-device backends and the --checkpoint-every doc
-                at_ckpt = checkpointer is not None and checkpointer.due(batches * d)
+                at_ckpt = (checkpointer is not None and
+                           checkpointer.due_span((batches - 1) * d, batches * d))
                 if since >= flush_every or at_ckpt:
                     deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
                                            dtype=np.int64)
@@ -259,21 +314,26 @@ class ShardedPipeline:
                 # build checkpoints store the O(V) *merged* forest, not the
                 # O(V*d) per-device stack; merging is associative and
                 # idempotent, so re-seeding one shard with it (others
-                # empty) reproduces the identical fixpoint
-                fa = np.full((d, n + 1), n, np.int32)
-                fa[0] = state.arrays["merged_partial"]
-                forest_all = jax.device_put(fa, self.state_sharding)
+                # empty) reproduces the identical fixpoint. Multi-host:
+                # each process provides its local rows; the merged forest
+                # rides in global row 0 (process 0).
+                rows = self.n_local
+                fa = np.full((rows, n + 1), n, np.int32)
+                if self.proc == 0:
+                    fa[0] = state.arrays["merged_partial"]
+                forest_all = self._put(self.state_sharding, fa)
                 start = state.chunk_idx
             else:
                 forest_all = self.init_forest()
                 start = 0
             batches = 0
-            for batch, _ in chunk_batches(stream, cs, d, n, start_chunk=start):
+            for batch in self.iter_batches(stream, start_chunk=start):
                 forest_all = self.build_step(forest_all, self.put_batch(batch),
                                              pos, order)
                 batches += 1
                 maybe_fail("build", batches)
-                if checkpointer is not None and checkpointer.due(batches * d):
+                if checkpointer is not None and \
+                        checkpointer.due_span((batches - 1) * d, batches * d):
                     partial = np.asarray(self.merge_all(forest_all, pos, order))
                     checkpointer.save(
                         "build", start + batches * d,
@@ -304,7 +364,7 @@ class ShardedPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         batches = 0
-        for batch, _ in chunk_batches(stream, cs, d, n, start_chunk=start):
+        for batch in self.iter_batches(stream, start_chunk=start):
             dev_batch = self.put_batch(batch)
             c, tt = np.asarray(self.score_step(dev_batch, assign))
             cut += int(c)
@@ -313,12 +373,28 @@ class ShardedPipeline:
                 cv_chunks.append(score_ops.cut_pair_keys_host(batch, assign, n, k))
             batches += 1
             maybe_fail("score", batches)
-            if checkpointer is not None and checkpointer.due(batches * d):
+            if checkpointer is not None and \
+                    checkpointer.due_span((batches - 1) * d, batches * d):
                 cv_chunks = ckpt.save_score_state(
                     checkpointer, start + batches * d, cut, total, cv_chunks,
                     {"deg": deg_host, "merged": np.asarray(merged)}, meta,
                     comm_volume)
-        cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
+        cv = None
+        if comm_volume:
+            keys = ckpt.compact_cv_keys(cv_chunks)
+            if self.procs > 1:
+                # each process saw only its shard's cut edges: union the
+                # per-host key sets (padded allgather, then host unique)
+                from jax.experimental import multihost_utils
+
+                lens = multihost_utils.process_allgather(
+                    np.array([len(keys)], np.int64))
+                mx = max(1, int(lens.max()))
+                pad = np.full(mx, -1, np.int64)
+                pad[:len(keys)] = keys
+                allk = multihost_utils.process_allgather(pad)
+                keys = np.unique(allk[allk >= 0])
+            cv = int(len(keys))
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0

@@ -56,6 +56,9 @@ class PartitionResult:
     comm_volume: Optional[int] = None  # distinct (vertex, foreign part) pairs
     phase_times: Dict[str, float] = dataclasses.field(default_factory=dict)
     backend: str = ""
+    # non-time diagnostics (e.g. fixpoint round counts) — kept out of
+    # phase_times so per-phase throughput math stays meaningful
+    diagnostics: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def validate(self, n: int) -> None:
         a = self.assignment
@@ -72,4 +75,5 @@ class PartitionResult:
             "comm_volume": None if self.comm_volume is None else int(self.comm_volume),
             "backend": self.backend,
             "phase_times": {k: round(v, 6) for k, v in self.phase_times.items()},
+            **({"diagnostics": self.diagnostics} if self.diagnostics else {}),
         }

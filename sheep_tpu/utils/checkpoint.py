@@ -71,6 +71,13 @@ class Checkpointer:
     def due(self, chunks_done: int) -> bool:
         return chunks_done > 0 and chunks_done % self.every == 0
 
+    def due_span(self, before: int, after: int) -> bool:
+        """True when the (before, after] chunk window crosses a cadence
+        boundary — the right test when progress advances in strides (the
+        sharded pipeline consumes d chunks per batch, and d need not
+        divide ``every``)."""
+        return after // self.every > before // self.every
+
     # -- paths -------------------------------------------------------------
     @property
     def _manifest_path(self) -> str:
@@ -82,6 +89,15 @@ class Checkpointer:
     # -- save / load -------------------------------------------------------
     def save(self, phase: str, chunk_idx: int,
              arrays: Dict[str, np.ndarray], meta: Optional[Dict] = None) -> None:
+        """Atomically persist a checkpoint step.
+
+        The manifest records the latest step AND the immediately previous
+        one, and the sweep keeps both data files. Multi-host runs need the
+        previous step: host-side save skew across processes is at most one
+        step (saves sit between lockstep collectives), so a process whose
+        latest save is one step ahead of the common minimum can always
+        fall back to its previous save (see
+        ``reconcile_multihost_resume``)."""
         assert phase in PHASES, phase
         name = self._data_name(phase, chunk_idx)
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".npz.tmp")
@@ -95,11 +111,17 @@ class Checkpointer:
             if os.path.exists(tmp):
                 os.remove(tmp)
             raise
+        prev = None
+        old = self._read_manifest()
+        if old is not None:
+            prev = {"phase": old["phase"], "chunk_idx": old["chunk_idx"],
+                    "data": old["data"]}
         manifest = {
             "version": FORMAT_VERSION,
             "phase": phase,
             "chunk_idx": int(chunk_idx),
             "data": name,
+            "previous": prev,
             "meta": meta or {},
         }
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".json.tmp")
@@ -113,9 +135,12 @@ class Checkpointer:
             if os.path.exists(tmp):
                 os.remove(tmp)
             raise
-        self._sweep(keep=name)
+        keep = {name}
+        if prev is not None:
+            keep.add(prev["data"])
+        self._sweep(keep=keep)
 
-    def load(self) -> Optional[CheckpointState]:
+    def _read_manifest(self) -> Optional[Dict]:
         try:
             with open(self._manifest_path) as f:
                 manifest = json.load(f)
@@ -123,31 +148,53 @@ class Checkpointer:
             return None
         if manifest.get("version") != FORMAT_VERSION:
             return None
-        data_path = os.path.join(self.dir, manifest["data"])
+        return manifest
+
+    def _load_entry(self, entry: Dict, meta: Dict) -> Optional[CheckpointState]:
+        data_path = os.path.join(self.dir, entry["data"])
         try:
             with np.load(data_path) as z:
                 arrays = {k: z[k] for k in z.files}
         except (FileNotFoundError, OSError):
             return None
         return CheckpointState(
-            phase=manifest["phase"],
-            chunk_idx=int(manifest["chunk_idx"]),
+            phase=entry["phase"],
+            chunk_idx=int(entry["chunk_idx"]),
             arrays=arrays,
-            meta=manifest.get("meta", {}),
+            meta=meta,
         )
 
+    def load(self) -> Optional[CheckpointState]:
+        manifest = self._read_manifest()
+        if manifest is None:
+            return None
+        return self._load_entry(manifest, manifest.get("meta", {}))
+
+    def load_at(self, phase: str, chunk_idx: int) -> Optional[CheckpointState]:
+        """Load the step (phase, chunk_idx) if it is the latest or the
+        retained previous step; None otherwise."""
+        manifest = self._read_manifest()
+        if manifest is None:
+            return None
+        meta = manifest.get("meta", {})
+        for entry in (manifest, manifest.get("previous")):
+            if entry and entry["phase"] == phase \
+                    and int(entry["chunk_idx"]) == int(chunk_idx):
+                return self._load_entry(entry, meta)
+        return None
+
     def clear(self) -> None:
-        self._sweep(keep=None)
+        self._sweep(keep=set())
         try:
             os.remove(self._manifest_path)
         except FileNotFoundError:
             pass
 
-    def _sweep(self, keep: Optional[str]) -> None:
+    def _sweep(self, keep: set) -> None:
         """Remove this process's stale data files (all but `keep`)."""
         prefix = f"sheep_ckpt_p{self.process}_"
         for fname in os.listdir(self.dir):
-            if fname.startswith(prefix) and fname.endswith(".npz") and fname != keep:
+            if fname.startswith(prefix) and fname.endswith(".npz") and fname not in keep:
                 try:
                     os.remove(os.path.join(self.dir, fname))
                 except FileNotFoundError:
@@ -231,3 +278,48 @@ def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
             f"(saved {state.meta}, current {meta}); "
             "pass a fresh --checkpoint-dir or drop --resume")
     return state
+
+
+def reconcile_multihost_resume(checkpointer: Checkpointer,
+                               state: Optional[CheckpointState],
+                               meta: Dict) -> Optional[CheckpointState]:
+    """Agree on one global resume step across processes.
+
+    Per-process manifests can be skewed by exactly one save step (a crash
+    between one process's save and another's); resuming from skewed steps
+    would desynchronize the collective schedules and hang the run. All
+    processes allgather their latest (phase, chunk) step and fall back to
+    the common minimum — each process either already holds it, or holds it
+    as its retained *previous* step. No common step -> fresh start.
+
+    Failure is collective: whether every process can produce the common
+    step is itself allgathered, so an unrecoverable skew raises on ALL
+    processes instead of leaving the healthy ones hanging in their first
+    collective while one process exits.
+    """
+    from jax.experimental import multihost_utils
+
+    own = (phase_index(state.phase), state.chunk_idx) if state else (-1, -1)
+    allsteps = np.asarray(multihost_utils.process_allgather(
+        np.array(own, dtype=np.int64)))
+    lex = sorted(map(tuple, allsteps.reshape(-1, 2).tolist()))
+    lo_phase, lo_chunk = lex[0]
+    fresh = lo_phase < 0  # someone has no checkpoint at all: start fresh
+    candidate: Optional[CheckpointState] = None
+    if not fresh:
+        if (lo_phase, lo_chunk) == own:
+            candidate = state
+        else:
+            candidate = checkpointer.load_at(PHASES[lo_phase], lo_chunk)
+        if candidate is not None and not candidate.matches(meta):
+            candidate = None
+    ok = fresh or candidate is not None
+    all_ok = np.asarray(multihost_utils.process_allgather(
+        np.array([1 if ok else 0], dtype=np.int64)))
+    if not all_ok.all():
+        raise ValueError(
+            f"cannot resume: common step {(lo_phase, lo_chunk)} is not "
+            f"retained (or does not match this run) on every process "
+            f"(this process has {own}, ok={ok}); checkpoints skewed by "
+            "more than one step — restart fresh")
+    return None if fresh else candidate

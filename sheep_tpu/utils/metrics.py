@@ -1,0 +1,101 @@
+"""Structured JSON-lines metrics (SURVEY.md §5 "Metrics / logging").
+
+The reference printed scores to stdout; the rebuild's observability
+contract is machine-readable: one JSON object per line, appended to a
+file (or any writable handle), covering per-phase throughput, partition
+quality, per-part loads, and device-memory high-water marks where the
+platform exposes them.
+
+Usage:
+    mw = MetricsWriter(path)
+    mw.emit("phase", phase="build", seconds=2.3, edges_per_sec=1.2e8)
+    mw.close()
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional, Union
+
+import numpy as np
+
+
+class MetricsWriter:
+    """Append-only JSONL sink; every record gets ``event`` and ``ts``."""
+
+    def __init__(self, dest: Union[str, IO]):
+        if isinstance(dest, str):
+            self._fh: IO = open(dest, "a")
+            self._owns = True
+        else:
+            self._fh = dest
+            self._owns = False
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event, "ts": round(time.time(), 3)}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self._fh.close()
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def _jsonable(x):
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    raise TypeError(f"not JSON serializable: {type(x)}")
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Allocator stats of the default device (HBM high-water mark on TPU);
+    None where the platform doesn't expose them (e.g. CPU)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+        return {k: int(stats[k]) for k in keep if k in stats}
+    except Exception:
+        return None
+
+
+def emit_run_metrics(mw: MetricsWriter, res, n_vertices: int,
+                     wall_seconds: float, graph: Optional[str] = None) -> None:
+    """Standard record set for one partition run: per-phase throughput,
+    summary scores, per-part loads, device memory."""
+    m = res.total_edges
+    mw.emit("run", graph=graph, backend=res.backend, k=res.k,
+            n_vertices=int(n_vertices), total_edges=int(m),
+            wall_seconds=round(wall_seconds, 4),
+            edges_per_sec=round(m / wall_seconds, 1) if wall_seconds > 0 else None)
+    for phase, secs in res.phase_times.items():
+        mw.emit("phase", phase=phase, seconds=round(secs, 6),
+                edges_per_sec=round(m / secs, 1) if secs > 0 else None)
+    mw.emit("scores", edge_cut=int(res.edge_cut),
+            cut_ratio=float(res.cut_ratio), balance=float(res.balance),
+            comm_volume=None if res.comm_volume is None else int(res.comm_volume))
+    if res.diagnostics:
+        mw.emit("diagnostics", **res.diagnostics)
+    loads = np.bincount(res.assignment, minlength=res.k)
+    mw.emit("part_loads", loads=loads, max=int(loads.max()),
+            min=int(loads.min()))
+    mem = device_memory_stats()
+    if mem is not None:
+        mw.emit("device_memory", **mem)

@@ -43,12 +43,18 @@ def test_save_load_roundtrip(tmp_path):
     assert state.meta == {"k": 4}
 
 
-def test_sweep_keeps_only_latest(tmp_path):
+def test_sweep_keeps_latest_and_previous(tmp_path):
+    """Two steps are retained (multi-host skew fallback needs the previous
+    one); older steps are swept."""
     ck = Checkpointer(str(tmp_path), every=1)
-    ck.save("degrees", 1, {"deg": np.zeros(4, np.int64)})
-    ck.save("degrees", 2, {"deg": np.zeros(4, np.int64)})
-    npz = [f for f in os.listdir(tmp_path) if f.endswith(".npz")]
-    assert len(npz) == 1 and "_2" in npz[0]
+    for idx in (1, 2, 3):
+        ck.save("degrees", idx, {"deg": np.zeros(4, np.int64)})
+    npz = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert len(npz) == 2
+    assert any("_2" in f for f in npz) and any("_3" in f for f in npz)
+    assert ck.load().chunk_idx == 3
+    assert ck.load_at("degrees", 2).chunk_idx == 2
+    assert ck.load_at("degrees", 1) is None
 
 
 def test_clear(tmp_path):

@@ -1,0 +1,52 @@
+"""JSONL metrics sink (SURVEY.md §5 metrics/observability)."""
+
+import io
+import json
+
+import numpy as np
+
+from sheep_tpu import cli
+from sheep_tpu.io import formats, generators
+from sheep_tpu.utils.metrics import MetricsWriter, emit_run_metrics
+
+
+def test_writer_appends_jsonl(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsWriter(path) as mw:
+        mw.emit("phase", phase="build", seconds=1.5)
+    with MetricsWriter(path) as mw:
+        mw.emit("scores", edge_cut=np.int64(7), loads=np.array([1, 2]))
+    recs = [json.loads(l) for l in open(path)]
+    assert [r["event"] for r in recs] == ["phase", "scores"]
+    assert recs[0]["phase"] == "build" and "ts" in recs[0]
+    assert recs[1]["edge_cut"] == 7 and recs[1]["loads"] == [1, 2]
+
+
+def test_emit_run_metrics_record_set():
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    es = EdgeStream.from_array(generators.karate_club(), n_vertices=34)
+    res = get_backend("pure").partition(es, 2)
+    buf = io.StringIO()
+    emit_run_metrics(MetricsWriter(buf), res, 34, 0.5, graph="karate")
+    recs = [json.loads(l) for l in buf.getvalue().splitlines()]
+    events = [r["event"] for r in recs]
+    assert events[:4] == ["run", "phase", "phase", "phase"] or "run" in events
+    by = {}
+    for r in recs:
+        by.setdefault(r["event"], r)
+    assert by["run"]["k"] == 2 and by["run"]["total_edges"] == 78
+    assert by["scores"]["edge_cut"] == res.edge_cut
+    assert sum(by["part_loads"]["loads"]) == 34
+
+
+def test_cli_metrics_out(tmp_path):
+    gpath = str(tmp_path / "g.edges")
+    formats.write_edges(gpath, generators.karate_club())
+    mpath = str(tmp_path / "m.jsonl")
+    assert cli.main(["--input", gpath, "--k", "2", "--backend", "pure",
+                     "--metrics-out", mpath, "--json"]) == 0
+    recs = [json.loads(l) for l in open(mpath)]
+    events = {r["event"] for r in recs}
+    assert {"run", "phase", "scores", "part_loads"} <= events
