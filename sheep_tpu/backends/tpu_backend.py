@@ -31,6 +31,7 @@ from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
 from sheep_tpu.types import PartitionResult
+from sheep_tpu.utils.prefetch import prefetch
 
 
 def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
@@ -87,8 +88,10 @@ class TpuBackend(Partitioner):
             deg = degrees_ops.init_degrees(n)
             since_flush = 0
             idx = start
-            for chunk in stream.chunks(cs, start_chunk=start):
-                deg = degrees_ops.degree_chunk(deg, pad_chunk(chunk, cs, n), n)
+            # read+parse+pad of chunk i+1 overlaps the device fold of i
+            for padded in prefetch(pad_chunk(c, cs, n)
+                                   for c in stream.chunks(cs, start_chunk=start)):
+                deg = degrees_ops.degree_chunk(deg, padded, n)
                 since_flush += 1
                 idx += 1
                 maybe_fail("degrees", idx - start)
@@ -125,9 +128,10 @@ class TpuBackend(Partitioner):
                 start = 0
             total_rounds = 0
             idx = start
-            for chunk in stream.chunks(cs, start_chunk=start):
+            for padded in prefetch(pad_chunk(c, cs, n)
+                                   for c in stream.chunks(cs, start_chunk=start)):
                 minp, rounds = elim_ops.build_chunk_step(
-                    minp, pad_chunk(chunk, cs, n), pos, order, n,
+                    minp, padded, pos, order, n,
                     lift_levels=self.lift_levels)
                 total_rounds += int(rounds)
                 idx += 1
@@ -161,8 +165,8 @@ class TpuBackend(Partitioner):
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         idx = start
-        for chunk in stream.chunks(cs, start_chunk=start):
-            padded = pad_chunk(chunk, cs, n)
+        for padded in prefetch(pad_chunk(c, cs, n)
+                               for c in stream.chunks(cs, start_chunk=start)):
             c, tt = score_ops.score_chunk(padded, assign, n)
             cut += int(c)
             total += int(tt)

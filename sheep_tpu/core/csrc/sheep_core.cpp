@@ -210,8 +210,10 @@ void sheep_tree_split(const i64* parent, const i64* pos, const double* w,
       std::vector<i64>().swap(kids);
       continue;
     }
-    std::sort(kids.begin(), kids.end(),
-              [&](i64 a, i64 b) { return rem[a] > rem[b]; });
+    // stable: equal-rem ties keep discovery order, matching the Python
+    // reference's list.sort so native/pure assignments are bit-identical
+    std::stable_sort(kids.begin(), kids.end(),
+                     [&](i64 a, i64 b) { return rem[a] > rem[b]; });
     bag.clear();
     double bagw = 0.0;
     for (i64 c : kids) {

@@ -32,14 +32,18 @@ class EdgeStream:
         fmt: Optional[str] = None,
         edges: Optional[np.ndarray] = None,
         n_vertices: Optional[int] = None,
+        factory=None,
+        num_edges: Optional[int] = None,
     ):
-        if (path is None) == (edges is None):
-            raise ValueError("exactly one of path / edges required")
+        if sum(x is not None for x in (path, edges, factory)) != 1:
+            raise ValueError("exactly one of path / edges / factory required")
         self.path = path
         self._edges = None if edges is None else np.asarray(edges, dtype=np.int64)
-        self.fmt = fmt or (formats.detect_format(path) if path else "memory")
+        self._factory = factory
+        self.fmt = fmt or (formats.detect_format(path) if path
+                           else ("generator" if factory else "memory"))
         self._n_vertices = n_vertices
-        self._n_edges: Optional[int] = None
+        self._n_edges: Optional[int] = num_edges
         if self._edges is not None:
             self._n_edges = len(self._edges)
 
@@ -51,6 +55,19 @@ class EdgeStream:
     @classmethod
     def from_array(cls, edges: np.ndarray, n_vertices: Optional[int] = None) -> "EdgeStream":
         return cls(edges=edges, n_vertices=n_vertices)
+
+    @classmethod
+    def from_generator(cls, factory, n_vertices: Optional[int] = None,
+                       num_edges: Optional[int] = None) -> "EdgeStream":
+        """Stream from a re-openable chunk generator (trillion-edge soak
+        path: ``generators.rmat_stream`` never materializes the graph).
+
+        ``factory()`` must return a FRESH iterator of (c, 2) int arrays
+        each call — the pipeline makes multiple passes (degrees, build,
+        score), and checkpoint resume re-opens mid-stream. rmat_stream is
+        seeded per chunk, so replaying is deterministic and cheap.
+        """
+        return cls(factory=factory, n_vertices=n_vertices, num_edges=num_edges)
 
     # -- context manager (no persistent fd held between passes) ------------
     def __enter__(self) -> "EdgeStream":
@@ -67,7 +84,7 @@ class EdgeStream:
                 self._n_edges = os.path.getsize(self.path) // 8
             elif self.fmt == "bin64":
                 self._n_edges = os.path.getsize(self.path) // 16
-            else:  # text: one counting pass
+            else:  # text/generator: one counting pass
                 n = 0
                 for chunk in self.chunks():
                     n += len(chunk)
@@ -100,24 +117,79 @@ class EdgeStream:
         shard: int = 0,
         num_shards: int = 1,
         start_chunk: int = 0,
+        byte_range: bool = False,
     ) -> Iterator[np.ndarray]:
         """Yield (<=chunk_edges, 2) int64 arrays.
 
         ``shard``/``num_shards`` round-robins chunks across workers;
         ``start_chunk`` skips already-processed *global* chunk indices
         (checkpoint/resume support, SURVEY.md §5).
+
+        ``byte_range`` (text files only): instead of every worker parsing
+        the whole file and keeping 1/P of the chunks (O(P x file) total
+        parse work), worker p parses only the byte span
+        [size*p/P, size*(p+1)/P) with newline-boundary fixup — O(file)
+        total. Its local chunk j carries global index j*P + p, so
+        ``start_chunk`` resume semantics are unchanged. Binary/memory
+        formats ignore the flag (seeking already costs O(1/P) each).
         """
         if not (0 <= shard < num_shards):
             raise ValueError(f"bad shard {shard}/{num_shards}")
-        if self._edges is not None:
+        if self._factory is not None:
+            yield from self._chunks_generator(chunk_edges, shard, num_shards, start_chunk)
+        elif self._edges is not None:
             yield from self._chunks_memory(chunk_edges, shard, num_shards, start_chunk)
         elif self.fmt in ("bin32", "bin64"):
             yield from self._chunks_binary(chunk_edges, shard, num_shards, start_chunk)
+        elif byte_range:
+            yield from self._chunks_text_span(chunk_edges, shard, num_shards, start_chunk)
         else:
             yield from self._chunks_text(chunk_edges, shard, num_shards, start_chunk)
 
+    def count_edges_in_span(self, shard: int, num_shards: int) -> int:
+        """Edges in this worker's byte span (one O(file/P) parse, cached).
+        Used by the sharded pipeline to agree on lockstep batch counts."""
+        key = (shard, num_shards)
+        if not hasattr(self, "_span_counts"):
+            self._span_counts: dict = {}
+        if key not in self._span_counts:
+            self._span_counts[key] = sum(
+                len(c) for c in self.chunks(
+                    DEFAULT_CHUNK_EDGES, shard=shard, num_shards=num_shards,
+                    byte_range=True))
+        return self._span_counts[key]
+
     def _owns(self, idx: int, shard: int, num_shards: int, start_chunk: int) -> bool:
         return idx >= start_chunk and idx % num_shards == shard
+
+    @staticmethod
+    def _regroup(blocks, chunk_edges, own):
+        """Accumulate variable-size (c, 2) edge blocks into fixed-size
+        chunks; ``own(idx)`` filters by sequential chunk index. Shared by
+        the generator, native-text and byte-span paths so ownership/
+        boundary semantics cannot diverge between them."""
+        pend: list = []
+        pend_n = 0
+        idx = 0
+        for block in blocks:
+            block = np.asarray(block, dtype=np.int64).reshape(-1, 2)
+            pend.append(block)
+            pend_n += len(block)
+            while pend_n >= chunk_edges:
+                cat = np.concatenate(pend)
+                if own(idx):
+                    yield cat[:chunk_edges]
+                pend = [cat[chunk_edges:]]
+                pend_n = len(pend[0])
+                idx += 1
+        rest = np.concatenate(pend) if pend else np.zeros((0, 2), np.int64)
+        if len(rest) and own(idx):
+            yield rest
+
+    def _chunks_generator(self, chunk_edges, shard, num_shards, start_chunk):
+        yield from self._regroup(
+            self._factory(), chunk_edges,
+            lambda idx: self._owns(idx, shard, num_shards, start_chunk))
 
     def _chunks_memory(self, chunk_edges, shard, num_shards, start_chunk):
         e = self._edges
@@ -153,36 +225,102 @@ class EdgeStream:
     def _chunks_text_native(self, native, chunk_edges, shard, num_shards, start_chunk):
         """Block-wise parse via the C parser (~10x the Python loop). Malformed
         lines are skipped — the same policy as the Python path."""
-        pend: list = []
-        pend_n = 0
-        idx = 0
-        tail = b""
-        with open(self.path, "rb") as f:
-            while True:
-                block = f.read(1 << 24)
-                data = tail + block
-                if not data:
-                    break
-                if block:
-                    edges, consumed = native.parse_text(data)
+        def blocks():
+            tail = b""
+            with open(self.path, "rb") as f:
+                while True:
+                    block = f.read(1 << 24)
+                    data = tail + block
+                    if not data:
+                        return
+                    if block:
+                        edges, consumed = native.parse_text(data)
+                        tail = data[consumed:]
+                    else:  # final partial line (no trailing newline)
+                        edges, _ = native.parse_text(data + b"\n")
+                        tail = b""
+                    yield edges
+                    if not block:
+                        return
+
+        yield from self._regroup(
+            blocks(), chunk_edges,
+            lambda idx: self._owns(idx, shard, num_shards, start_chunk))
+
+    def _chunks_text_span(self, chunk_edges, shard, num_shards, start_chunk):
+        """Parse only this shard's byte span of a text file.
+
+        Boundary rule: a line belongs to the span containing its FIRST
+        byte. Entering mid-line (previous byte != newline) skips to the
+        next line; a line straddling the span's end is finished past the
+        boundary. Local chunk j is yielded iff its global index
+        j*num_shards + shard passes the ``start_chunk`` filter.
+        """
+        size = os.path.getsize(self.path)
+        start = size * shard // num_shards
+        end = size * (shard + 1) // num_shards
+
+        parse = self._block_parser()
+
+        def spans():
+            with open(self.path, "rb") as f:
+                if start > 0:
+                    f.seek(start - 1)
+                    if f.read(1) != b"\n":
+                        f.readline()  # tail of a line owned by the previous span
+                tail = b""
+                while f.tell() < end:
+                    block = f.read(min(1 << 24, end - f.tell()))
+                    if not block:
+                        break
+                    data = tail + block
+                    edges, consumed = parse(data)
                     tail = data[consumed:]
-                else:  # final partial line (no trailing newline)
-                    edges, _ = native.parse_text(data + b"\n")
-                    tail = b""
-                pend.append(edges)
-                pend_n += len(edges)
-                while pend_n >= chunk_edges:
-                    cat = np.concatenate(pend)
-                    if self._owns(idx, shard, num_shards, start_chunk):
-                        yield cat[:chunk_edges]
-                    pend = [cat[chunk_edges:]]
-                    pend_n = len(pend[0])
-                    idx += 1
-                if not block:
-                    break
-        rest = np.concatenate(pend) if pend else np.zeros((0, 2), np.int64)
-        if len(rest) and self._owns(idx, shard, num_shards, start_chunk):
-            yield rest
+                    if len(edges):
+                        yield edges
+                if tail:  # line straddling `end` (or EOF without newline)
+                    data = tail + f.readline()
+                    if not data.endswith(b"\n"):
+                        data += b"\n"
+                    edges, _ = parse(data)
+                    if len(edges):
+                        yield edges
+
+        # local chunk j carries global index j * num_shards + shard
+        yield from self._regroup(
+            spans(), chunk_edges,
+            lambda j: j * num_shards + shard >= start_chunk)
+
+    @staticmethod
+    def _block_parser():
+        """Best block parser available: the native C parser, else the
+        Python fallback — one dispatch shared by every text path."""
+        try:
+            from sheep_tpu.core import native
+
+            if native.available():
+                return native.parse_text
+        except Exception:
+            pass
+        return EdgeStream._parse_block_python
+
+    @staticmethod
+    def _parse_block_python(data: bytes):
+        """Python fallback for the native block parser: complete lines
+        only; returns (edges, bytes_consumed)."""
+        from sheep_tpu.io.formats import parse_text_line
+
+        nl = data.rfind(b"\n")
+        if nl < 0:
+            return np.zeros((0, 2), np.int64), 0
+        out = []
+        for line in data[: nl + 1].decode("utf-8", "replace").splitlines():
+            pair = parse_text_line(line)
+            if pair is not None:
+                out.append(pair)
+        arr = (np.asarray(out, dtype=np.int64) if out
+               else np.zeros((0, 2), np.int64))
+        return arr, nl + 1
 
     def _chunks_text_python(self, chunk_edges, shard, num_shards, start_chunk):
         from sheep_tpu.io.formats import parse_text_line

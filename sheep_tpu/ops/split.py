@@ -1,10 +1,17 @@
 """Tree split dispatch (SURVEY.md §2 #7).
 
 The split runs over O(V) tree state, not O(E) edges — it is two linear
-passes and never the bottleneck, so the default implementation runs on
-host via the shared reference semantics in ``core/pure.py`` (identical
-code path keeps cross-backend edge-cut equivalence exact). Inputs arrive
-as device arrays; only the O(V) parent/pos tables cross to host.
+passes and never the bottleneck at small V, but at the big eval configs
+(41M–1B vertices, BASELINE.md) an interpreted per-vertex loop would
+dominate the whole run. The TPU backends therefore route through the
+native C++ split (core/csrc sheep_tree_split) whenever the library is
+built, exactly like the cpu backend; the numpy/heapq reference in
+``core/pure.py`` is the fallback and the executable spec. Both
+implementations are bit-identical (stable descending child sort +
+identical heap tie-breaking — asserted by tests/test_split_native.py),
+so cross-backend edge-cut equivalence is unaffected by the dispatch.
+Inputs arrive as device arrays; only the O(V) parent/pos tables cross
+to host.
 """
 
 from __future__ import annotations
@@ -13,7 +20,7 @@ from typing import Optional
 
 import numpy as np
 
-from sheep_tpu.core import pure
+from sheep_tpu.core import native, pure
 from sheep_tpu.types import ElimTree
 
 
@@ -24,6 +31,10 @@ def tree_split_host(
     weights: Optional[np.ndarray] = None,
     alpha: float = 1.0,
 ) -> np.ndarray:
-    tree = ElimTree(parent=np.asarray(parent, dtype=np.int64),
-                    pos=np.asarray(pos, dtype=np.int64), n=len(parent))
+    parent64 = np.asarray(parent, dtype=np.int64)
+    pos64 = np.asarray(pos, dtype=np.int64)
+    if native.available():
+        return native.tree_split(parent64, pos64, k, weights=weights,
+                                 alpha=alpha)
+    tree = ElimTree(parent=parent64, pos=pos64, n=len(parent64))
     return pure.tree_split(tree, k, weights=weights, alpha=alpha)

@@ -42,7 +42,8 @@ from sheep_tpu.parallel.mesh import SHARD_AXIS
 
 
 def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
-                  shard: int = 0, num_shards: int = 1, start_chunk: int = 0):
+                  shard: int = 0, num_shards: int = 1, start_chunk: int = 0,
+                  byte_range: bool = False):
     """Group the chunk stream into (D, C, 2) int32 host batches, one chunk
     per device, padded with the sentinel vertex n. Yields (batch, count)."""
     from sheep_tpu.backends.tpu_backend import pad_chunk
@@ -50,7 +51,7 @@ def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
     batch = np.full((n_devices, chunk_edges, 2), n, dtype=np.int32)
     filled = 0
     for chunk in stream.chunks(chunk_edges, shard=shard, num_shards=num_shards,
-                               start_chunk=start_chunk):
+                               start_chunk=start_chunk, byte_range=byte_range):
         batch[filled] = pad_chunk(chunk, chunk_edges, n)
         filled += 1
         if filled == n_devices:
@@ -127,32 +128,72 @@ class ShardedPipeline:
         d_ = self.n_devices
         r_ = self.rounds
 
-        @partial(jax.jit,
-                 in_shardings=(self.state_sharding, self.repl_sharding,
-                               self.repl_sharding),
-                 out_shardings=self.repl_sharding)
-        def merge_all(forest_all, pos, order):
-            """Butterfly allreduce, combiner = forest merge (comm point 2)."""
-            def f(forest_local, pos_, order_):
-                forest = forest_local[0]
-                idx = lax.axis_index(SHARD_AXIS)
-                for r in range(r_):
-                    perm = [(i, i ^ (1 << r)) for i in range(d_)]
-                    perm = [(s, t) for s, t in perm if t < d_]
+        def _butterfly(forest_local, pos_, order_, cap0):
+            """Butterfly allreduce body, combiner = forest merge.
+
+            ``cap0`` = per-round payload capacity (entries); 0 means dense
+            (ship the whole O(V) minp table each round). Compact rounds
+            ship (index, value) pairs of the non-sentinel entries only —
+            SURVEY.md §7 hard part #4's O(boundary) traffic. Capacity
+            doubles per round: a merged forest has at most
+            count_A + count_B parent entries (its tree edges are a forest
+            over the union of the two trees' edge sets, and a forest of m
+            constraints has <= m edges), so cap0 >= the initial max
+            occupancy makes cap0 * 2^r sufficient for round r — checked on
+            host before selecting this path. Once 2 * cap is no smaller
+            than the table itself, rounds fall back to dense shipping.
+            """
+            forest = forest_local[0]
+            idx = lax.axis_index(SHARD_AXIS)
+            for r in range(r_):
+                perm = [(i, i ^ (1 << r)) for i in range(d_)]
+                perm = [(s, t) for s, t in perm if t < d_]
+                valid = (idx ^ (1 << r)) < d_
+                cap = min(cap0 << r, n_ + 1) if cap0 else n_ + 1
+                if 2 * cap < n_ + 1:
+                    sel = jnp.nonzero(forest[:n_] != n_, size=cap,
+                                      fill_value=n_)[0].astype(jnp.int32)
+                    # fill slots index the sentinel: forest[n] == n, and
+                    # pos/order fix n, so they are inert on both ends
+                    payload = jnp.stack([sel, forest[sel]])
+                    recv = lax.ppermute(payload, SHARD_AXIS, perm)
+                    # out-of-range XOR partners receive zeros; neutralize
+                    # to the inert (n, n) pair, same as the dense path
+                    recv = jnp.where(valid, recv, jnp.int32(n_))
+                    other = jnp.full(n_ + 1, n_, jnp.int32).at[recv[0]].min(
+                        recv[1], mode="drop")
+                else:
                     other = lax.ppermute(forest, SHARD_AXIS, perm)
-                    # devices whose XOR partner is out of range receive
-                    # zeros from ppermute; treat that as the empty forest
-                    # (all-sentinel). Device 0 is the binomial-tree root and
-                    # is complete after ceil(log2 d) rounds for any d.
-                    other = jnp.where((idx ^ (1 << r)) < d_, other, jnp.int32(n_))
-                    forest = elim_ops.merge_forests(
-                        forest, other, pos_, order_, n_, lift_levels=lift)
-                return forest[None]
-            merged = shard_map(
-                f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS, None), P(), P()),
-                out_specs=P(SHARD_AXIS, None))(forest_all, pos, order)
-            return merged[0]
+                    other = jnp.where(valid, other, jnp.int32(n_))
+                forest = elim_ops.merge_forests(
+                    forest, other, pos_, order_, n_, lift_levels=lift)
+            return forest[None]
+
+        def _make_merge(cap0):
+            @partial(jax.jit,
+                     in_shardings=(self.state_sharding, self.repl_sharding,
+                                   self.repl_sharding),
+                     out_shardings=self.repl_sharding)
+            def merge_fn(forest_all, pos, order):
+                merged = shard_map(
+                    partial(_butterfly, cap0=cap0), mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None), P(), P()),
+                    out_specs=P(SHARD_AXIS, None))(forest_all, pos, order)
+                return merged[0]
+            return merge_fn
+
+        merge_all = _make_merge(0)  # dense variant (also the d=1 no-op)
+        self._merge_cache = {0: merge_all}
+        self._make_merge = _make_merge
+
+        @partial(jax.jit, out_shardings=self.repl_sharding)
+        def max_occupancy(forest_all):
+            """Largest per-device count of non-sentinel forest entries —
+            one tiny all-reduce, used to pick the compact-merge capacity."""
+            return jnp.max(jnp.sum((forest_all[:, :n_] != n_)
+                                   .astype(jnp.int32), axis=1))
+
+        self.max_occupancy = max_occupancy
 
         @partial(jax.jit,
                  in_shardings=(self.batch_sharding, self.repl_sharding),
@@ -183,6 +224,40 @@ class ShardedPipeline:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
 
+    # -- adaptive tree merge (comm point 2) --------------------------------
+    def merge(self, forest_all, pos, order, stats: Optional[dict] = None):
+        """Merge the per-device forests into the global tree.
+
+        Picks compact (boundary-only pairs) vs dense (full table) shipping
+        from one tiny occupancy all-reduce: sparse shards move O(boundary)
+        bytes over ICI instead of O(V) per round (SURVEY.md §7 hard part
+        #4). Compiled variants are cached per power-of-2 capacity, so at
+        most log2(V) programs exist across a whole run. ``stats`` (if
+        given) accumulates the payload byte count actually shipped.
+        """
+        cap0 = 0
+        if self.rounds:
+            cnt = int(self.max_occupancy(forest_all))
+            c = max(1024, 1 << max(0, int(cnt - 1).bit_length()))
+            if 2 * c < self.n + 1:
+                cap0 = c
+        fn = self._merge_cache.get(cap0)
+        if fn is None:
+            fn = self._merge_cache[cap0] = self._make_merge(cap0)
+        merged = fn(forest_all, pos, order)
+        if stats is not None:
+            total = 0
+            for r in range(self.rounds):
+                cap = min(cap0 << r, self.n + 1) if cap0 else self.n + 1
+                words = 2 * cap if 2 * cap < self.n + 1 else self.n + 1
+                links = sum(1 for i in range(self.n_devices)
+                            if (i ^ (1 << r)) < self.n_devices)
+                total += 4 * words * links
+            stats["merge_payload_bytes"] = \
+                stats.get("merge_payload_bytes", 0) + total
+            stats["merge_mode"] = "compact" if cap0 else "dense"
+        return merged
+
     # -- state constructors ------------------------------------------------
     def init_degrees(self):
         return self._put(self.state_sharding,
@@ -198,29 +273,52 @@ class ShardedPipeline:
     def put_replicated(self, arr):
         return self._put(self.repl_sharding, np.asarray(arr))
 
+    def _use_byte_range(self, stream) -> bool:
+        """Text files in multi-process runs shard by byte span so each
+        process parses only ~file/P (VERDICT r1 item 7); binary/memory
+        formats already seek in O(1) per chunk."""
+        return (self.procs > 1 and stream.path is not None
+                and stream.fmt not in ("bin32", "bin64"))
+
     # -- lockstep batch iteration ------------------------------------------
     def iter_batches(self, stream, start_chunk: int = 0):
         """Yield (n_local, C, 2) host batches from this process's shard of
         the chunk stream. Multi-host: every process yields the SAME number
         of batches (stragglers pad with all-sentinel batches) so the
-        per-batch collectives stay in lockstep — the count is computed
-        analytically from the stream length, no communication needed."""
+        per-batch collectives stay in lockstep — the count comes from the
+        stream length (binary: O(1); text: each process counts its OWN
+        byte span, then one tiny allgather agrees on the max)."""
         rows = self.n_local
+        byte_range = self._use_byte_range(stream)
         gen = (b for b, _ in chunk_batches(
             stream, self.cs, rows, self.n, shard=self.proc,
-            num_shards=self.procs, start_chunk=start_chunk))
+            num_shards=self.procs, start_chunk=start_chunk,
+            byte_range=byte_range))
         if self.procs == 1:
             yield from gen
             return
-        # num_edges is O(1) for binary/memory formats; for text it costs
-        # one counting parse, cached on the stream (so once per run, not
-        # per pass) — use binary edge lists for huge multi-host inputs
-        total = -(-stream.num_edges // self.cs)  # total chunks in stream
+        if byte_range:
+            # per-process local chunk counts differ (spans are byte-, not
+            # edge-balanced); allgather them once to agree on the batch
+            # count. Local chunk j of process p = global chunk j*P + p, so
+            # the start_chunk skip math matches the round-robin case.
+            from jax.experimental import multihost_utils
 
-        def owned(p):  # chunks i in [start_chunk, total) with i % procs == p
-            full = max(0, (total - p + self.procs - 1) // self.procs)
-            done = max(0, (start_chunk - p + self.procs - 1) // self.procs)
-            return full - done
+            mine = -(-stream.count_edges_in_span(self.proc, self.procs)
+                     // self.cs)
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.array([mine], dtype=np.int64))).reshape(-1)
+
+            def owned(p):
+                done = max(0, (start_chunk - p + self.procs - 1) // self.procs)
+                return max(0, int(counts[p]) - done)
+        else:
+            total = -(-stream.num_edges // self.cs)  # total chunks in stream
+
+            def owned(p):  # chunks i in [start_chunk, total) with i % procs == p
+                full = max(0, (total - p + self.procs - 1) // self.procs)
+                done = max(0, (start_chunk - p + self.procs - 1) // self.procs)
+                return full - done
 
         nb = max(-(-owned(p) // rows) for p in range(self.procs))
         produced = 0
@@ -250,13 +348,20 @@ class ShardedPipeline:
         from sheep_tpu.ops.split import tree_split_host
         from sheep_tpu.utils import checkpoint as ckpt
         from sheep_tpu.utils.fault import maybe_fail
+        from sheep_tpu.utils.prefetch import prefetch
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
         meta = ckpt.stream_meta(stream, k, cs, weights=weights, alpha=alpha,
                                 comm_volume=comm_volume,
-                                state_format="sharded", devices=d)
-        state = ckpt.resume_state(checkpointer, meta, resume)
+                                state_format="sharded", devices=d,
+                                procs=self.procs,
+                                text_byte_range=self._use_byte_range(stream))
+        # multi-host: a fingerprint mismatch must NOT raise per-process
+        # here — that would strand the other processes in the reconcile
+        # allgather; the sentinel makes reconcile raise collectively
+        state = ckpt.resume_state(checkpointer, meta, resume,
+                                  raise_on_mismatch=self.procs == 1)
         if self.procs > 1 and checkpointer is not None and resume:
             # per-process manifests may be skewed by one save step; agree
             # on a common step or the collective schedules desynchronize
@@ -275,7 +380,7 @@ class ShardedPipeline:
             start = state.chunk_idx if state else 0
             deg_all = self.init_degrees()
             since = batches = 0
-            for batch in self.iter_batches(stream, start_chunk=start):
+            for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
                 deg_all = self.deg_step(deg_all, self.put_batch(batch))
                 since += 1
                 batches += 1
@@ -307,6 +412,7 @@ class ShardedPipeline:
 
         # pass 2: per-device forests, then butterfly merge (comm point 2)
         t0 = time.perf_counter()
+        merge_stats: dict = {}
         if state and from_phase >= 2:
             merged = jnp.asarray(state.arrays["merged"])
         else:
@@ -327,18 +433,19 @@ class ShardedPipeline:
                 forest_all = self.init_forest()
                 start = 0
             batches = 0
-            for batch in self.iter_batches(stream, start_chunk=start):
+            for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
                 forest_all = self.build_step(forest_all, self.put_batch(batch),
                                              pos, order)
                 batches += 1
                 maybe_fail("build", batches)
                 if checkpointer is not None and \
                         checkpointer.due_span((batches - 1) * d, batches * d):
-                    partial = np.asarray(self.merge_all(forest_all, pos, order))
+                    partial = np.asarray(
+                        self.merge(forest_all, pos, order, stats=merge_stats))
                     checkpointer.save(
                         "build", start + batches * d,
                         {"deg": deg_host, "merged_partial": partial}, meta)
-            merged = self.merge_all(forest_all, pos, order)
+            merged = self.merge(forest_all, pos, order, stats=merge_stats)
             merged.block_until_ready()
         t["build+merge"] = time.perf_counter() - t0
 
@@ -364,7 +471,7 @@ class ShardedPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         batches = 0
-        for batch in self.iter_batches(stream, start_chunk=start):
+        for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
             dev_batch = self.put_batch(batch)
             c, tt = np.asarray(self.score_step(dev_batch, assign))
             cut += int(c)
@@ -404,4 +511,5 @@ class ShardedPipeline:
             "assignment": assign_host, "parent": parent, "pos": pos_host,
             "degrees": deg_host, "edge_cut": cut, "total_edges": total,
             "balance": balance, "comm_volume": cv, "k": k,
+            "merge_stats": merge_stats,
         }

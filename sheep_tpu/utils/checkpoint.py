@@ -25,7 +25,12 @@ from typing import Dict, Optional
 
 import numpy as np
 
-FORMAT_VERSION = 1
+# v2: sharded build-phase payload changed ('forest_all' O(V*d) stack ->
+# 'merged_partial' O(V) merged forest) and the terminal 'done' phase was
+# dropped from PHASES. _read_manifest returns None on a version mismatch,
+# so v1 checkpoints degrade to a clean fresh start instead of a KeyError
+# mid-recovery.
+FORMAT_VERSION = 2
 
 # phase progression of every backend's pipeline (SURVEY.md §3.1); a
 # successful run clears its checkpoint instead of writing a terminal phase
@@ -235,6 +240,16 @@ def stream_meta(stream, k: int, chunk_edges: int, weights: str,
         e = stream._edges
         sample = np.ascontiguousarray(np.concatenate([e[:4096], e[-4096:]]))
         meta["content_sha1"] = hashlib.sha1(sample.tobytes()).hexdigest()
+    elif getattr(stream, "_factory", None) is not None:
+        # generator stream: hash the first block (factories replay
+        # deterministically, so this is a stable content fingerprint)
+        import hashlib
+
+        first = next(iter(stream._factory()), None)
+        if first is not None:
+            sample = np.ascontiguousarray(
+                np.asarray(first, dtype=np.int64)[:4096])
+            meta["content_sha1"] = hashlib.sha1(sample.tobytes()).hexdigest()
     m = stream.num_edges_cheap
     if m is not None:
         meta["num_edges"] = int(m)
@@ -264,15 +279,31 @@ def save_score_state(checkpointer: Checkpointer, chunk_idx: int, cut: int,
     return [keys] if comm_volume else []
 
 
+# Sentinel returned by resume_state(raise_on_mismatch=False): the local
+# checkpoint exists but does not fingerprint-match this run. Multi-host
+# callers pass it to reconcile_multihost_resume so the failure is raised
+# on EVERY process via the ok-allgather — raising eagerly on one process
+# would leave the others blocked in their first collective until the
+# distributed timeout (each host stats its own input copy, so a single
+# re-synced host can mismatch alone).
+MISMATCHED = object()
+
+
 def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
-                 resume: bool) -> Optional[CheckpointState]:
-    """Load-and-validate helper shared by the backends."""
+                 resume: bool, raise_on_mismatch: bool = True):
+    """Load-and-validate helper shared by the backends.
+
+    Returns the CheckpointState, None (nothing to resume), or — only when
+    ``raise_on_mismatch`` is False — the ``MISMATCHED`` sentinel.
+    """
     if checkpointer is None or not resume:
         return None
     state = checkpointer.load()
     if state is None:
         return None
     if not state.matches(meta):
+        if not raise_on_mismatch:
+            return MISMATCHED
         raise ValueError(
             "checkpoint does not match this run "
             f"(saved {state.meta}, current {meta}); "
@@ -281,7 +312,7 @@ def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
 
 
 def reconcile_multihost_resume(checkpointer: Checkpointer,
-                               state: Optional[CheckpointState],
+                               state,
                                meta: Dict) -> Optional[CheckpointState]:
     """Agree on one global resume step across processes.
 
@@ -293,13 +324,17 @@ def reconcile_multihost_resume(checkpointer: Checkpointer,
     as its retained *previous* step. No common step -> fresh start.
 
     Failure is collective: whether every process can produce the common
-    step is itself allgathered, so an unrecoverable skew raises on ALL
-    processes instead of leaving the healthy ones hanging in their first
-    collective while one process exits.
+    step is itself allgathered, so an unrecoverable skew — or a local
+    fingerprint mismatch (``state is MISMATCHED``, from
+    ``resume_state(raise_on_mismatch=False)``) — raises on ALL processes
+    instead of leaving the healthy ones hanging in their first collective
+    while one process exits.
     """
     from jax.experimental import multihost_utils
 
-    own = (phase_index(state.phase), state.chunk_idx) if state else (-1, -1)
+    mismatched = state is MISMATCHED
+    own = ((phase_index(state.phase), state.chunk_idx)
+           if state and not mismatched else (-1, -1))
     allsteps = np.asarray(multihost_utils.process_allgather(
         np.array(own, dtype=np.int64)))
     lex = sorted(map(tuple, allsteps.reshape(-1, 2).tolist()))
@@ -313,13 +348,14 @@ def reconcile_multihost_resume(checkpointer: Checkpointer,
             candidate = checkpointer.load_at(PHASES[lo_phase], lo_chunk)
         if candidate is not None and not candidate.matches(meta):
             candidate = None
-    ok = fresh or candidate is not None
+    ok = (fresh or candidate is not None) and not mismatched
     all_ok = np.asarray(multihost_utils.process_allgather(
         np.array([1 if ok else 0], dtype=np.int64)))
     if not all_ok.all():
         raise ValueError(
             f"cannot resume: common step {(lo_phase, lo_chunk)} is not "
-            f"retained (or does not match this run) on every process "
-            f"(this process has {own}, ok={ok}); checkpoints skewed by "
-            "more than one step — restart fresh")
+            f"retained, does not match this run, or a local checkpoint "
+            f"fingerprint-mismatched on some process "
+            f"(this process has {own}, ok={ok}, mismatched={mismatched}); "
+            "pass a fresh --checkpoint-dir or drop --resume")
     return None if fresh else candidate

@@ -1,0 +1,53 @@
+"""Device-memory model for the streaming build (VERDICT r1 item 4,
+SURVEY.md §7 hard part #2).
+
+All vertex-indexed state is int32[n+1]; the edge chunk contributes
+int32[C]-shaped work arrays. The model below counts the worst-case live
+set of ``build_chunk_step`` + the elimination fixpoint, which dominates
+every other phase (degrees needs 2 tables; scoring needs 1 table + the
+chunk). XLA reuses buffers aggressively, so this is an upper bound on
+steady-state HBM after warm-up; the real high-water mark is
+profiled on hardware (BASELINE.md "HBM budget").
+"""
+
+from __future__ import annotations
+
+from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
+
+
+def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
+                      descent: str = "auto") -> dict:
+    """Estimated peak device bytes for one build_chunk_step.
+
+    Live set: pos + order + carried minp (persistent, 3 tables), the
+    oriented constraint arrays lo/hi/new_lo/poshi (4 x (n+1+C)), the
+    scatter-min output (1 table), and the lifting table stack (exact
+    descent: lift_levels tables bounded by EXACT_TABLE_BYTES; stream
+    descent: 1 table).
+    """
+    if lift_levels <= 0:
+        lift_levels = max(1, int(n).bit_length())
+    table = 4 * (n + 1)
+    work = 4 * (n + 1 + 2 * chunk_edges)
+    stack = lift_levels * table
+    if descent == "auto":
+        descent = "exact" if stack <= EXACT_TABLE_BYTES else "stream"
+    lift_bytes = min(stack, EXACT_TABLE_BYTES) if descent == "exact" else table
+    persistent = 3 * table
+    transient = 4 * work + table
+    total = persistent + transient + lift_bytes
+    return {
+        "persistent_bytes": persistent,
+        "transient_bytes": transient,
+        "lift_bytes": lift_bytes,
+        "descent": descent,
+        "total_bytes": total,
+    }
+
+
+def max_vertices_for(hbm_bytes: int, chunk_edges: int) -> int:
+    """Largest power-of-2 vertex count whose build fits ``hbm_bytes``."""
+    v = 1
+    while build_phase_bytes(2 * v, chunk_edges)["total_bytes"] <= hbm_bytes:
+        v *= 2
+    return v

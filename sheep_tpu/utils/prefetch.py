@@ -1,0 +1,84 @@
+"""Host-I/O / device-compute overlap (SURVEY.md §2 parallelism table, PP
+row: "double-buffering").
+
+A bounded background-thread prefetcher for the streaming loops: while the
+device folds chunk i, the worker thread reads + parses + pads chunk i+1
+(file reads, np.fromfile and the ctypes text parser all release the GIL,
+so the overlap is real). Depth 2 is double-buffering — one item in flight
+on the device, one ready on host — which makes the build phase wall
+approximately max(io, compute) instead of their sum (VERDICT r1 item 6).
+
+The wrapper preserves item order exactly (checkpoint chunk indices and
+fault-injection counters are unaffected) and propagates worker exceptions
+to the consumer at the point of `next()`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_END = object()
+
+
+class _Raised:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Iterate ``iterable`` on a background thread, keeping up to ``depth``
+    items ready ahead of the consumer.
+
+    Early consumer exit (break / GeneratorExit) stops the worker promptly:
+    the worker checks a stop event around every bounded put.
+    """
+    if depth < 1:
+        raise ValueError("prefetch depth must be >= 1")
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in iterable:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # delivered to the consumer
+            item = _Raised(e)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+            return
+        while not stop.is_set():
+            try:
+                q.put(_END, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    t = threading.Thread(target=worker, daemon=True, name="sheep-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, _Raised):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()

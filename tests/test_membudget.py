@@ -1,0 +1,31 @@
+"""Memory-model sanity (BASELINE.md "HBM budget")."""
+
+from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
+from sheep_tpu.utils.membudget import build_phase_bytes, max_vertices_for
+
+GIB = 1 << 30
+
+
+def test_descent_auto_selection_matches_elim():
+    small = build_phase_bytes(1 << 14, 1 << 12)
+    assert small["descent"] == "exact"
+    big = build_phase_bytes(1 << 28, 1 << 24)
+    assert big["descent"] == "stream"
+    assert big["lift_bytes"] == 4 * ((1 << 28) + 1)  # one table live
+
+
+def test_exact_stack_is_capped():
+    b = build_phase_bytes(1 << 26, 1 << 20, descent="exact")
+    assert b["lift_bytes"] <= EXACT_TABLE_BYTES
+
+
+def test_single_chip_ceiling_is_2_28():
+    """16 GiB v5e chip: V=2^28 fits, V=2^29 does not (the documented
+    single-chip ceiling)."""
+    assert max_vertices_for(16 * GIB, 1 << 24) == 1 << 28
+    assert build_phase_bytes(1 << 29, 1 << 24)["total_bytes"] > 16 * GIB
+
+
+def test_model_monotone_in_v_and_chunk():
+    f = lambda v, c: build_phase_bytes(v, c)["total_bytes"]
+    assert f(1 << 20, 1 << 16) < f(1 << 24, 1 << 16) < f(1 << 24, 1 << 20)

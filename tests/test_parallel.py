@@ -66,6 +66,42 @@ def test_device_count_invariance(n_devices):
     np.testing.assert_array_equal(out["parent"], expect.parent)
 
 
+def test_compact_merge_sparse_shards():
+    """Sparse shards (few edges, big V) take the boundary-compacted merge
+    path and still reproduce the oracle exactly; payload is far below the
+    dense 8 x O(V) butterfly (SURVEY.md §7 hard part #4)."""
+    n = 1 << 14
+    e = generators.random_graph(n, 1500, seed=41)
+    out = _run(e, n, chunk_edges=256)
+    expect = pure.build_elim_tree(e, pure.elimination_order(pure.degrees(e, n)))
+    np.testing.assert_array_equal(out["parent"], expect.parent)
+    stats = out["merge_stats"]
+    assert stats["merge_mode"] == "compact"
+    dense_bytes = 3 * 8 * 4 * (n + 1)  # 3 rounds x 8 links x int32 table
+    assert stats["merge_payload_bytes"] < dense_bytes / 3
+
+
+def test_dense_merge_when_occupancy_high():
+    """Near-full forests keep the dense butterfly (compact would ship
+    more than the table itself)."""
+    e = generators.rmat(9, 8, seed=31)
+    out = _run(e, 512)
+    assert out["merge_stats"]["merge_mode"] == "dense"
+
+
+def test_compact_merge_nonpow2_devices():
+    """Out-of-range XOR partners must stay inert in the compact payload
+    path too (they arrive as zeros, which index vertex 0 if unmasked)."""
+    n = 1 << 14
+    e = generators.random_graph(n, 1200, seed=43)
+    for d in (3, 5, 6):
+        out = _run(e, n, n_devices=d, chunk_edges=256)
+        expect = pure.build_elim_tree(
+            e, pure.elimination_order(pure.degrees(e, n)))
+        np.testing.assert_array_equal(out["parent"], expect.parent)
+        assert out["merge_stats"]["merge_mode"] == "compact"
+
+
 def test_chunk_batches_cover_stream():
     e = generators.rmat(8, 8, seed=34)
     n = 256

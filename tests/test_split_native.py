@@ -1,0 +1,81 @@
+"""Native vs pure tree_split equality (VERDICT round 1 item 2).
+
+The TPU backends route their host-side split through the C++
+sheep_tree_split (sheep_tpu/ops/split.py); the numpy/heapq reference in
+core/pure.py is the executable spec. Both must produce BIT-IDENTICAL
+assignments — same stable descending child sort, same least-loaded-part
+heap tie-breaking — so that routing the TPU path through native never
+changes cross-backend equivalence results.
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core import native, pure
+from sheep_tpu.io import generators
+from sheep_tpu.ops.split import tree_split_host
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core unavailable")
+
+
+def _tree(edges, n):
+    deg = pure.degrees(edges, n)
+    pos = pure.elimination_order(deg)
+    return pure.build_elim_tree(edges, pos), deg
+
+
+GRAPHS = [
+    ("karate", generators.karate_club(), 34, 2),
+    ("karate_k5", generators.karate_club(), 34, 5),
+    ("path", generators.path_graph(257), 257, 4),
+    ("star", generators.star_graph(200), 200, 8),
+    ("grid", generators.grid_graph(17, 23), 17 * 23, 6),
+    ("random", generators.random_graph(500, 2000, seed=3), 500, 8),
+    ("random_multi", generators.random_graph(100, 5000, seed=7), 100, 16),
+    ("rmat12", generators.rmat(12, 8, seed=11), 1 << 12, 64),
+    ("rmat10_k100", generators.rmat(10, 16, seed=5), 1 << 10, 100),
+]
+
+
+@pytest.mark.parametrize("name,edges,n,k", GRAPHS, ids=[g[0] for g in GRAPHS])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_native_split_matches_pure(name, edges, n, k, weighted):
+    tree, deg = _tree(edges, n)
+    w = deg.astype(np.float64) if weighted else None
+    a_pure = pure.tree_split(tree, k, weights=w)
+    a_native = native.tree_split(tree.parent, tree.pos, k, weights=w)
+    np.testing.assert_array_equal(a_native, a_pure)
+
+
+@pytest.mark.parametrize("alpha", [0.8, 1.0, 1.5])
+def test_native_split_matches_pure_alpha(alpha):
+    edges = generators.rmat(11, 8, seed=13)
+    tree, _ = _tree(edges, 1 << 11)
+    a_pure = pure.tree_split(tree, 32, alpha=alpha)
+    a_native = native.tree_split(tree.parent, tree.pos, 32, alpha=alpha)
+    np.testing.assert_array_equal(a_native, a_pure)
+
+
+def test_dispatch_uses_native():
+    """tree_split_host must hit the native path when the lib is built —
+    this is the TPU backends' split (VERDICT: the interpreted fallback is
+    unusable at the 41M-vertex eval configs)."""
+    edges = generators.random_graph(300, 1200, seed=1)
+    tree, _ = _tree(edges, 300)
+    got = tree_split_host(tree.parent, tree.pos, 8)
+    np.testing.assert_array_equal(
+        got, native.tree_split(tree.parent, tree.pos, 8))
+    assert got.dtype == np.int32
+
+
+def test_disconnected_forest():
+    """Multiple roots (disconnected components) split identically."""
+    a = generators.random_graph(100, 300, seed=2)
+    b = generators.random_graph(100, 300, seed=4) + 100
+    edges = np.concatenate([a, b])
+    tree, _ = _tree(edges, 200)
+    assert (tree.parent < 0).sum() >= 2
+    np.testing.assert_array_equal(
+        native.tree_split(tree.parent, tree.pos, 8),
+        pure.tree_split(tree, 8))
