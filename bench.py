@@ -85,15 +85,12 @@ def main():
     platform = probe_accelerator()
     fell_back = False
     if platform is None or platform == "cpu":
-        # No accelerator: pin cpu before the first jax op in this process.
-        # NOTE the env var is NOT sufficient here — the axon platform
-        # plugin pre-imports jax at interpreter startup and ignores
-        # JAX_PLATFORMS, so only config.update reliably avoids touching
-        # the broken backend (same trick as tests/conftest.py).
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        # no accelerator: pin cpu before the first jax op in this process
+        # (env var alone is a no-op under the pre-importing TPU plugin —
+        # see sheep_tpu/utils/platform.py)
+        from sheep_tpu.utils.platform import pin_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_platform("cpu")
         fell_back = platform is None
         platform = "cpu"
         if fell_back:
@@ -168,7 +165,12 @@ def main():
 if __name__ == "__main__":
     try:
         main()
-    except Exception as e:  # the JSON contract line is emitted no matter what
+    except Exception as e:
+        # Deliberate: emit the JSON contract line and exit 0 so the
+        # driver records a PARSED result instead of rc!=0/parsed=null
+        # (round 1 lost its number exactly that way). A genuine failure
+        # is unambiguous in the parsed output — value 0.0 plus the
+        # "error" diagnostic — which is where harnesses should look.
         import traceback
 
         traceback.print_exc(file=sys.stderr)

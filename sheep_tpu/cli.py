@@ -68,17 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    # Honor JAX_PLATFORMS even though the TPU platform plugin pre-imports
+    # Honor JAX_PLATFORMS even though a TPU platform plugin may pre-import
     # jax at interpreter startup (which makes the env var a no-op on its
-    # own): re-apply it through the config, before any jax op runs.
-    # Without this, `JAX_PLATFORMS=cpu python -m sheep_tpu.cli ...` hangs
-    # trying to initialize an unreachable accelerator.
-    import os
+    # own). Without this, `JAX_PLATFORMS=cpu python -m sheep_tpu.cli ...`
+    # hangs trying to initialize an unreachable accelerator.
+    from sheep_tpu.utils.platform import pin_platform
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    pin_platform()
 
     from sheep_tpu import list_backends
     from sheep_tpu.backends.base import get_backend

@@ -1,35 +1,48 @@
 """Elimination-tree build as a data-parallel fixpoint (SURVEY.md §2 #4-6).
 
 This is the TPU answer to the reference's sequential union-find hot loop
-(SURVEY.md §7 hard part #1). Instead of pointer-chasing per edge, the build
-is a *constraint-rewriting fixpoint* over the whole edge set:
+(SURVEY.md §7 hard part #1). Instead of pointer-chasing per edge, the
+build is a *constraint-rewriting fixpoint*: the carried forest lives in a
+persistent ``minp`` table (minp[x] = elimination position of x's parent,
+n = none) and only the chunk's C edges are ever active:
 
     invariant  pos[lo] < pos[hi] for every active edge (lo, hi)
     round:
-      minp[x]  = min over active edges at lo=x of pos[hi]   (scatter-min)
-      m[x]     = order[minp[x]]   (x's current best parent candidate)
-      rewrite  every non-min edge (x, v) -> (m[x], v)       (gather)
-    at fixpoint every active edge is its lo's min edge, and
-    parent[x] = m[x] is exactly the elimination tree.
+      minp[x] <- min(minp[x], pos of hi over active edges at lo=x)
+                                                          (scatter-min)
+      an active edge (x, v) with pos[v] == minp[x] RETIRES — it is now
+      represented by the table. If it improved the table (old parent p
+      had pos[p] > pos[v]), the displaced constraint "x ~ p from
+      pos[p]" reduces to "v ~ p from pos[p]" (x~v merged strictly
+      earlier), so the retiring slot is REUSED in place for (v, p).
+      every other active edge (x, v) climbs: rewrite to (m, v) where m
+      is x's highest ancestor with pos[m] < pos[v]          (gather)
+    fixpoint: all slots dead -> the table is the elimination forest of
+    every constraint inserted so far.
 
-Soundness of the rewrite: the min edge (x, m[x]) always stays in the set,
-and given u~m[x] from time pos[m[x]] < pos[v], the constraint "u~v from
-time pos[v]" is equivalent to "m[x]~v from time pos[v]". The fixpoint is
-therefore the unique elimination forest of the inserted edge multiset,
-regardless of edge order — the same argument that makes the C++ core's
-incremental insertion (core/csrc/sheep_core.cpp) correct, vectorized.
+This is the vectorized form of the C++ core's incremental insertion
+(core/csrc/sheep_core.cpp insert_edge: climb / displace-and-reinsert);
+the represented constraint closure is preserved by every rewrite, so the
+fixpoint is the unique elimination forest of the inserted multiset,
+independent of edge order — which is what makes the build streamable and
+the per-shard forests mergeable. Termination: a slot's pos[lo] strictly
+increases on every climb AND on displacement spawn (the displaced
+constraint's lo is the new parent, later than x), so each slot changes
+at most n times; binary lifting makes it near-logarithmic in practice.
 
-Every operation is a flat gather / scatter-min over static shapes: no
-data-dependent shapes, no host round-trips; the loop is a
-``lax.while_loop``. Within each round the climb uses **binary lifting**
-(pointer doubling): the candidate-parent map is squared ``lift_levels``
+Unlike a formulation that re-materializes the carried forest's V tree
+edges as active constraints each chunk, the active set here is O(C):
+per-chunk transient memory and per-round work are independent of V
+(BASELINE.md "HBM budget": single-chip ceiling 2^29 vertices at 16 GiB).
+
+Every operation is a flat gather / scatter-min over static shapes; the
+loop is a ``lax.while_loop``. Within each round the climb uses **binary
+lifting** (pointer doubling): the parent map is squared ``lift_levels``
 times (t_{j+1} = t_j[t_j], each a 2^j-step ancestor table) and every
 edge jumps up the tables to its highest ancestor still earlier than
-``hi``. Parent chains are strictly increasing in elimination position,
-so the pos-bound predicate is monotone along a chain. This collapses the
-round count from O(tree depth) to near-logarithmic (measured: 645 -> 22
-rounds on RMAT-14), which is what makes deep scale-free elimination
-trees viable on the MXU-less gather path.
+``hi``. Parent chains strictly increase in elimination position, so the
+pos-bound predicate is monotone along a chain (measured: 645 -> 22
+rounds on RMAT-14).
 
 Two descent schedules, auto-selected by memory footprint:
 
@@ -80,7 +93,8 @@ EXACT_TABLE_BYTES = 1 << 30
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels", "max_rounds", "descent"))
-def elim_fixpoint(
+def fold_edges(
+    minp: jax.Array,
     lo: jax.Array,
     hi: jax.Array,
     pos: jax.Array,
@@ -90,9 +104,13 @@ def elim_fixpoint(
     max_rounds: int = 1 << 20,
     descent: str = "auto",
 ):
-    """Run the rewrite fixpoint; returns (minp int32[n+1], rounds int32).
+    """Fold active constraints (lo, hi) into the carried forest table.
 
-    minp[x] = elimination position of x's parent (n = root/no parent).
+    Returns (minp int32[n+1], rounds int32); minp[x] = elimination
+    position of x's parent (n = root/no parent). The active buffer is
+    fixed-size: a retiring slot is reused in place by the constraint it
+    displaces, so per-round work is O(len(lo)), independent of V.
+
     ``lift_levels`` = number of doubled ancestor tables per round
     (0 -> auto: ceil(log2(n+1)), enough to cover any chain in one round).
     ``descent`` = "exact" | "stream" | "auto" (see module docstring).
@@ -102,21 +120,19 @@ def elim_fixpoint(
     if descent == "auto":
         table_bytes = lift_levels * 4 * (n + 1)
         descent = "exact" if table_bytes <= EXACT_TABLE_BYTES else "stream"
-    inf = jnp.int32(n)
-
-    def scatter_min(lo_, poshi_):
-        return jnp.full(n + 1, inf, dtype=jnp.int32).at[lo_].min(poshi_, mode="drop")
 
     def body(state):
-        lo_, hi_, _, rounds = state
+        lo_, hi_, minp_, _, rounds = state
         poshi = pos[hi_]
-        minp = scatter_min(lo_, poshi)
-        # binary lifting: t_j[x] = x's 2^j-step ancestor under the current
-        # candidate-parent map (sentinel n is a fixpoint of every table
-        # since minp[n] = n and order[n] = n). A jump is safe iff its
-        # landing vertex is still earlier than hi (chains strictly
-        # increase in pos).
-        t = order[minp]
+        old_at_lo = minp_[lo_]  # parent position BEFORE this round
+        new_minp = minp_.at[lo_].min(poshi, mode="drop")
+        now = new_minp[lo_]
+
+        # climb for non-retiring edges. binary lifting: t_j[x] = x's
+        # 2^j-step ancestor under the updated table (sentinel n is a
+        # fixpoint of every table since minp[n] = n and order[n] = n);
+        # a jump is safe iff the landing vertex is still earlier than hi
+        t = order[new_minp]
         new_lo = lo_
         if descent == "exact":
             tables = [t]
@@ -132,25 +148,57 @@ def elim_fixpoint(
                 new_lo = jnp.where(pos[cand] < poshi, cand, new_lo)
                 if j < lift_levels - 1:
                     t = t[t]
-        # edge became its lo's min edge or a self-loop -> deactivate
-        became_loop = new_lo == hi_
-        new_lo = jnp.where(became_loop, n, new_lo)
-        new_hi = jnp.where(became_loop, n, hi_)
-        changed = jnp.any(new_lo != lo_)
-        return new_lo, new_hi, changed, rounds + 1
+        became_loop = new_lo == hi_  # constraint already implied
+        climb_lo = jnp.where(became_loop, n, new_lo)
+        climb_hi = jnp.where(became_loop, n, hi_)
+
+        # retire: this edge's target IS the min at lo (pos is injective,
+        # so only duplicates of the same edge can retire together). If it
+        # improved on an existing parent p, reuse the slot for the
+        # displaced constraint (v, p); else the slot dies.
+        retire = poshi == now
+        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
+        out_lo = jnp.where(retire,
+                           jnp.where(displaced, order[now], n),
+                           climb_lo).astype(jnp.int32)
+        out_hi = jnp.where(retire,
+                           jnp.where(displaced, order[old_at_lo], n),
+                           climb_hi).astype(jnp.int32)
+        # slots only ever change toward progress (pos[lo] strictly
+        # increases), so "no slot changed" == fixpoint (table included:
+        # the table only changes through a retiring slot)
+        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
+        return out_lo, out_hi, new_minp, changed, rounds + 1
 
     def cond(state):
-        _, _, changed, rounds = state
+        _, _, _, changed, rounds = state
         return changed & (rounds < max_rounds)
 
     # derive the initial carry scalars from `lo` so their sharding/varying
     # axes match the loop body's outputs (required under shard_map)
     changed0 = lo[0] == lo[0]  # True, with lo's varying axes
     rounds0 = (lo[0] * 0).astype(jnp.int32)
-    state = (lo, hi, changed0, rounds0)
-    lo_f, hi_f, _, rounds = lax.while_loop(cond, body, state)
-    minp = scatter_min(lo_f, pos[hi_f])
-    return minp, rounds
+    state = (lo.astype(jnp.int32), hi.astype(jnp.int32),
+             minp.astype(jnp.int32), changed0, rounds0)
+    _, _, minp_f, _, rounds = lax.while_loop(cond, body, state)
+    return minp_f, rounds
+
+
+def elim_fixpoint(
+    lo: jax.Array,
+    hi: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    max_rounds: int = 1 << 20,
+    descent: str = "auto",
+):
+    """Elimination forest of an oriented constraint set, from scratch —
+    :func:`fold_edges` seeded with the empty table."""
+    return fold_edges(jnp.full(n + 1, n, dtype=jnp.int32), lo, hi, pos,
+                      order, n, lift_levels=lift_levels,
+                      max_rounds=max_rounds, descent=descent)
 
 
 def tree_edges_from_parent(parent_pos: jax.Array, order: jax.Array, n: int):
@@ -174,19 +222,17 @@ def build_chunk_step(
 ):
     """One streaming step: fold a (C, 2) edge chunk into the carried forest.
 
-    parent_pos is the minp encoding (int32[n+1], n = no parent). By the
-    merge identity T(G1 ∪ G2) = T(T(G1) ∪ T(G2)), folding the chunk into
-    the existing forest's edges yields the forest of all edges seen so far.
-    Device memory is O(V + C) plus a bounded lifting-table stack (at most
-    ``EXACT_TABLE_BYTES``; past that the stream descent keeps it O(V)) —
-    the edge stream never materializes.
+    parent_pos is the minp encoding (int32[n+1], n = no parent). The
+    carried forest stays in the table — only the chunk's C edges are
+    active (plus in-place displacement reuse), so per-chunk transients
+    are O(C) and per-round work is independent of V. Device memory is
+    O(V) tables + O(C) actives plus a bounded lifting-table stack (at
+    most ``EXACT_TABLE_BYTES``; past that the stream descent keeps it
+    one table) — the edge stream never materializes.
     """
-    tlo, thi = tree_edges_from_parent(parent_pos, order, n)
     clo, chi = orient_edges(chunk, pos, n)
-    lo = jnp.concatenate([tlo, clo])
-    hi = jnp.concatenate([thi, chi])
-    minp, rounds = elim_fixpoint(lo, hi, pos, order, n, lift_levels=lift_levels)
-    return minp, rounds
+    return fold_edges(parent_pos, clo, chi, pos, order, n,
+                      lift_levels=lift_levels)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels"))
@@ -194,15 +240,15 @@ def merge_forests(
     a_pos: jax.Array, b_pos: jax.Array, pos: jax.Array, order: jax.Array,
     n: int, lift_levels: int = 0,
 ):
-    """Associative merge of two forests in minp encoding (SURVEY.md §2 #6).
+    """Associative merge of two forests in minp encoding (SURVEY.md §2 #6):
+    fold B's tree edges into A's table — T(A ∪ B) = T(T(A) ∪ T(B)).
 
-    This is the cross-shard/device reduction: each forest is O(V), so a
-    log2(D) ppermute reduction moves O(V log D) bytes over ICI."""
-    alo, ahi = tree_edges_from_parent(a_pos, order, n)
+    This is the cross-shard/device reduction combiner; the butterfly in
+    ``parallel/pipeline.py`` ships each forest as either the O(V) table
+    or compacted boundary pairs."""
     blo, bhi = tree_edges_from_parent(b_pos, order, n)
-    lo = jnp.concatenate([alo, blo])
-    hi = jnp.concatenate([ahi, bhi])
-    minp, _ = elim_fixpoint(lo, hi, pos, order, n, lift_levels=lift_levels)
+    minp, _ = fold_edges(a_pos, blo, bhi, pos, order, n,
+                         lift_levels=lift_levels)
     return minp
 
 

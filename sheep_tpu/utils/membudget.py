@@ -19,22 +19,24 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
                       descent: str = "auto") -> dict:
     """Estimated peak device bytes for one build_chunk_step.
 
-    Live set: pos + order + carried minp (persistent, 3 tables), the
-    oriented constraint arrays lo/hi/new_lo/poshi (4 x (n+1+C)), the
-    scatter-min output (1 table), and the lifting table stack (exact
-    descent: lift_levels tables bounded by EXACT_TABLE_BYTES; stream
-    descent: 1 table).
+    The displacement fixpoint (ops/elim.py fold_edges) keeps the carried
+    forest in the persistent minp table and only the chunk's C edges
+    active, so transients are O(C), not O(V + C). Live set: pos + order
+    (persistent, 2 tables), the minp table double-buffered across the
+    while_loop carry (2 tables), ~6 C-sized active/work arrays
+    (lo/hi/poshi/old_at_lo/now/new_lo), and the lifting table stack
+    (exact descent: lift_levels tables bounded by EXACT_TABLE_BYTES;
+    stream descent: 1 table).
     """
     if lift_levels <= 0:
         lift_levels = max(1, int(n).bit_length())
     table = 4 * (n + 1)
-    work = 4 * (n + 1 + 2 * chunk_edges)
     stack = lift_levels * table
     if descent == "auto":
         descent = "exact" if stack <= EXACT_TABLE_BYTES else "stream"
     lift_bytes = min(stack, EXACT_TABLE_BYTES) if descent == "exact" else table
-    persistent = 3 * table
-    transient = 4 * work + table
+    persistent = 4 * table  # pos, order, minp x2 (loop carry)
+    transient = 6 * 4 * chunk_edges
     total = persistent + transient + lift_bytes
     return {
         "persistent_bytes": persistent,

@@ -1,0 +1,28 @@
+"""Platform pinning that survives the TPU plugin's jax pre-import.
+
+In environments where a TPU platform plugin pre-imports jax at
+interpreter startup, the JAX_PLATFORMS env var is read before user code
+runs and becomes a no-op — merely setting it does NOT stop jax from
+initializing (and hanging on) an unreachable accelerator. The only
+reliable pin is ``jax.config.update("jax_platforms", ...)`` applied
+before the first jax operation. One helper so the workaround lives in
+one place (used by bench.py and the CLI; tests/conftest.py does the
+same dance inline because it must also set XLA_FLAGS pre-import).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform(platform: str | None = None) -> None:
+    """Force ``platform`` (default: the JAX_PLATFORMS env var, if set)
+    as the jax platform, in a way that works even when jax was already
+    imported by a platform plugin. No-op when neither is given."""
+    value = platform or os.environ.get("JAX_PLATFORMS")
+    if not value:
+        return
+    os.environ["JAX_PLATFORMS"] = value
+    import jax
+
+    jax.config.update("jax_platforms", value)

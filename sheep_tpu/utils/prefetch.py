@@ -43,32 +43,26 @@ def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
 
+    def put_until_stop(item) -> bool:
+        """Bounded put that gives up when the consumer signalled stop;
+        returns True when the item was enqueued."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def worker():
         try:
             for item in iterable:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
+                if not put_until_stop(item):
                     return
         except BaseException as e:  # delivered to the consumer
-            item = _Raised(e)
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return
-                except queue.Full:
-                    continue
+            put_until_stop(_Raised(e))
             return
-        while not stop.is_set():
-            try:
-                q.put(_END, timeout=0.1)
-                return
-            except queue.Full:
-                continue
+        put_until_stop(_END)
 
     t = threading.Thread(target=worker, daemon=True, name="sheep-prefetch")
     t.start()

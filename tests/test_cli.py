@@ -76,13 +76,16 @@ def test_profile_dir_writes_trace(karate_file, tmp_path, capsys):
     assert found, f"no trace files written under {prof}"
 
 
-def test_sharded_backend_comm_volume_default_matches(karate_file, capsys):
-    """All backends default comm_volume on (VERDICT r1 weak #5)."""
-    rc = run_cli("--input", karate_file, "--k", "2",
-                 "--backend", "tpu-sharded", "--json")
-    assert rc == 0
-    s = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert s.get("comm_volume") is not None
+def test_sharded_backend_comm_volume_default_matches(karate_file):
+    """All backends default comm_volume on (VERDICT r1 weak #5) — call
+    partition() without the kwarg so the backend's own default is what
+    is under test (the CLI always passes it explicitly)."""
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    with EdgeStream.open(karate_file) as es:
+        res = get_backend("tpu-sharded").partition(es, 2)
+    assert res.comm_volume is not None
 
 
 def test_missing_required_args():

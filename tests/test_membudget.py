@@ -19,11 +19,11 @@ def test_exact_stack_is_capped():
     assert b["lift_bytes"] <= EXACT_TABLE_BYTES
 
 
-def test_single_chip_ceiling_is_2_28():
-    """16 GiB v5e chip: V=2^28 fits, V=2^29 does not (the documented
-    single-chip ceiling)."""
-    assert max_vertices_for(16 * GIB, 1 << 24) == 1 << 28
-    assert build_phase_bytes(1 << 29, 1 << 24)["total_bytes"] > 16 * GIB
+def test_single_chip_ceiling_is_2_29():
+    """16 GiB v5e chip: V=2^29 fits, V=2^30 does not (the documented
+    single-chip ceiling with the O(C)-transient displacement fixpoint)."""
+    assert max_vertices_for(16 * GIB, 1 << 24) == 1 << 29
+    assert build_phase_bytes(1 << 30, 1 << 24)["total_bytes"] > 16 * GIB
 
 
 def test_model_monotone_in_v_and_chunk():

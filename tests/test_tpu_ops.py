@@ -93,6 +93,44 @@ def test_streaming_chunks_match_batch(graph):
     np.testing.assert_array_equal(np.asarray(minp), np.asarray(whole))
 
 
+def test_streaming_worst_case_displacement_order(graph):
+    """Stream edges in DESCENDING pos[hi] order: every later chunk offers
+    earlier parents, maximizing in-place displacement chains in
+    fold_edges (the slot-reuse path of the displacement fixpoint)."""
+    e, n = graph
+    pos_np = pure.elimination_order(pure.degrees(e, n))
+    key = np.maximum(pos_np[e[:, 0]], pos_np[e[:, 1]])
+    e_desc = e[np.argsort(-key, kind="stable")]
+    pos, order = _device_order(e, n)
+    minp = jnp.full(n + 1, n, dtype=jnp.int32)
+    size = 23
+    for off in range(0, len(e_desc), size):
+        minp, _ = elim_ops.build_chunk_step(
+            minp, pad_chunk(e_desc[off:off + size], size, n), pos, order, n)
+    parent = elim_ops.minp_to_parent(minp, order, n)
+    expect = pure.build_elim_tree(e, pos_np).parent
+    np.testing.assert_array_equal(parent, expect)
+
+
+def test_duplicate_heavy_multigraph_streaming():
+    """Many duplicate edges retire simultaneously; their duplicate
+    displacements must stay harmless."""
+    base = generators.random_graph(50, 120, seed=17)
+    e = np.concatenate([base] * 5)  # 5 copies of every edge
+    rng = np.random.default_rng(3)
+    e = e[rng.permutation(len(e))]
+    n = 50
+    pos, order = _device_order(e, n)
+    minp = jnp.full(n + 1, n, dtype=jnp.int32)
+    for off in range(0, len(e), 41):
+        minp, _ = elim_ops.build_chunk_step(
+            minp, pad_chunk(e[off:off + 41], 41, n), pos, order, n)
+    parent = elim_ops.minp_to_parent(minp, order, n)
+    expect = pure.build_elim_tree(
+        e, pure.elimination_order(pure.degrees(e, n))).parent
+    np.testing.assert_array_equal(parent, expect)
+
+
 def test_merge_forests_matches_whole(graph):
     e, n = graph
     pos, order = _device_order(e, n)
