@@ -19,3 +19,8 @@ try:
     from sheep_tpu.backends import tpu_sharded_backend  # noqa: F401
 except Exception:  # pragma: no cover - jax absent/broken
     pass
+
+try:
+    from sheep_tpu.backends import tpu_bigv_backend  # noqa: F401
+except Exception:  # pragma: no cover - jax absent/broken
+    pass

@@ -1,0 +1,57 @@
+"""Vertex-sharded big-V backend, registered as ``tpu-bigv``.
+
+For graphs whose vertex tables exceed one chip's HBM (BASELINE.md eval
+config 5, RMAT-30 class): pos/order/minp are block-sharded over the
+device mesh and the displacement fixpoint runs as ONE distributed forest
+with routed collectives (``parallel/bigv.py``). Per-device table memory
+is O(V/D); the standard ``tpu-sharded`` backend is faster whenever the
+replicated tables fit (V <= 2^29 single-chip), so pick this one only
+beyond that.
+"""
+
+from __future__ import annotations
+
+from sheep_tpu.backends.base import Partitioner, register
+from sheep_tpu.parallel.bigv import BigVPipeline
+from sheep_tpu.parallel.mesh import shards_mesh
+from sheep_tpu.types import PartitionResult
+
+
+@register
+class TpuBigVBackend(Partitioner):
+    name = "tpu-bigv"
+    supports_multidevice = True
+
+    def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
+                 jumps: int = 4, n_devices: int | None = None):
+        self.chunk_edges = chunk_edges
+        self.alpha = alpha
+        self.jumps = jumps
+        self.n_devices = n_devices
+
+    def partition(self, stream, k: int, weights: str = "unit",
+                  comm_volume: bool = True, checkpointer=None,
+                  resume: bool = False, **opts) -> PartitionResult:
+        if checkpointer is not None:
+            raise NotImplementedError(
+                "tpu-bigv does not checkpoint yet; use tpu-sharded "
+                "(V <= 2^29) or run without --checkpoint-dir")
+        n = stream.num_vertices
+        mesh = shards_mesh(self.n_devices)
+        cs = self.chunk_edges
+        m_cheap = stream.num_edges_cheap
+        if m_cheap is not None:
+            cs = min(cs, max(1024, -(-m_cheap // mesh.devices.size)))
+        pipe = BigVPipeline(n, cs, mesh, jumps=self.jumps)
+
+        timings: dict = {}
+        out = pipe.run(stream, k, alpha=self.alpha, weights=weights,
+                       comm_volume=comm_volume, timings=timings)
+        return PartitionResult(
+            assignment=out["assignment"], k=k, edge_cut=out["edge_cut"],
+            total_edges=out["total_edges"],
+            cut_ratio=out["edge_cut"] / max(out["total_edges"], 1),
+            balance=out["balance"], comm_volume=out["comm_volume"],
+            phase_times=timings, backend=self.name,
+            diagnostics={"fixpoint_rounds": float(out["fixpoint_rounds"])},
+        )

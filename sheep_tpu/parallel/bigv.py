@@ -1,0 +1,354 @@
+"""Vertex-sharded build for graphs whose tables exceed one chip
+(SURVEY.md §7 hard part #2; BASELINE.md eval config 5, RMAT-30 class).
+
+The standard sharded pipeline replicates the O(V) pos/order tables and
+keeps one forest per device, so 8 chips raise edge throughput but not the
+vertex ceiling (2^29 on 16 GiB). This pipeline shards every vertex-indexed
+table into contiguous blocks of B = ceil((V+1)/D) rows — device d owns
+global rows [dB, (d+1)B) — cutting per-device table memory to O(V/D):
+RMAT-30 (V=2^30) fits a v5e-8 slice at ~2.6 GiB/chip.
+
+With the displacement fixpoint (ops/elim.py) the build needs no partial
+trees and no merge at all: there is ONE distributed forest table, and all
+devices' active constraints fold into it concurrently through routed
+collective ops. Per fixpoint round (inside shard_map over the ``shards``
+axis):
+
+  1. routed scatter-min  — all_gather the (lo, pos[hi]) requests; each
+     owner folds the requests hitting its block into its minp shard and
+     answers (pre-round, post-round) parent positions; answers ride one
+     all_to_all back and combine with jnp.min (non-owners answer the
+     sentinel n = +inf).
+  2. routed gather       — order[p] / minp[x] lookups for the climb and
+     for displaced-constraint construction, same gather/answer/min
+     pattern (``jumps`` single-step climbs per round instead of the
+     single-chip path's binary-lifting tables, which would be V-sized).
+  3. local rewrite       — retire / displace-in-place / climb, exactly
+     the single-chip displacement rules; liveness is a psum, so the
+     while_loop terminates collectively.
+
+The elimination order is computed on HOST (numpy argsort over the int64
+degree table — hosts hold hundreds of GB; one sort per run, amortized
+over the whole stream) and only the pos/order block shards are pushed to
+devices. The split likewise runs on host over the O(V) parent array
+(native C++), and scoring reuses a replicated assignment table (int32[V]
+fits any chip that can hold a chunk).
+
+Everything is static-shape: routing buffers are (D, Q) for Q actives, so
+there are no per-destination capacity constants and no overflow paths —
+the cost is shipping D*Q words per collective, the standard trade for
+hub-skewed (power-law) graphs where per-owner request counts are
+unboundedly uneven.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from sheep_tpu.parallel.mesh import SHARD_AXIS
+
+
+class BigVPipeline:
+    """Compiled vertex-sharded pipeline for a fixed (n, chunk_edges, mesh).
+
+    ``jumps`` = single-step parent climbs per fixpoint round (the routed
+    substitute for binary lifting); more jumps = fewer rounds but more
+    collectives per round.
+    """
+
+    def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 4,
+                 max_rounds: int = 1 << 20):
+        d = mesh.devices.size
+        self.n = n
+        self.cs = chunk_edges
+        self.mesh = mesh
+        self.n_devices = d
+        self.jumps = jumps
+        self.B = -(-(n + 1) // d)  # owned rows per device
+        self.rows = d * self.B      # padded global table length
+        self.procs = len({dev.process_index for dev in mesh.devices.flat})
+        if self.procs != 1:
+            # multi-host works through the same collectives; per-process
+            # batch lockstep is inherited from ShardedPipeline if needed
+            raise NotImplementedError(
+                "bigv multi-host driving loop not wired yet; use one "
+                "process per slice")
+
+        self.shard = NamedSharding(mesh, P(SHARD_AXIS))        # (rows,)
+        self.batch_sharding = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        self.repl = NamedSharding(mesh, P())
+
+        n_, B, D, jumps_ = self.n, self.B, d, jumps
+
+        # ---- routed primitives (shard_map bodies) ------------------------
+
+        def _lookup(table_local, q):
+            """table[q] for arbitrary global ids q (Q,) against a
+            block-sharded table; sentinel-safe (answers n for q >= rows
+            handled by the ownership mask; q == n hits the padded
+            sentinel row, which every shard keeps at value n)."""
+            gq = lax.all_gather(q, SHARD_AXIS)          # (D, Q)
+            me = lax.axis_index(SHARD_AXIS)
+            local = gq - me * B
+            ok = (local >= 0) & (local < B)
+            part = jnp.where(ok, table_local[jnp.clip(local, 0, B - 1)],
+                             jnp.int32(n_))
+            mine = lax.all_to_all(part, SHARD_AXIS, 0, 0)
+            return jnp.min(mine, axis=0)                # (Q,)
+
+        def _scatter_min(minp_local, lo, val):
+            """Fold (lo -> val) requests from EVERY device into the
+            distributed table; returns (new_minp_local, old, new) where
+            old/new are the pre-/post-round parent positions at each of
+            THIS device's requests."""
+            glo = lax.all_gather(lo, SHARD_AXIS)        # (D, Q)
+            gval = lax.all_gather(val, SHARD_AXIS)
+            me = lax.axis_index(SHARD_AXIS)
+            local = glo - me * B
+            ok = (local >= 0) & (local < B)
+            idx = jnp.where(ok, local, B)               # B = dropped
+            new_local = minp_local.at[idx.ravel()].min(
+                gval.ravel(), mode="drop")
+            lidx = jnp.clip(local, 0, B - 1)
+            old_part = jnp.where(ok, minp_local[lidx], jnp.int32(n_))
+            new_part = jnp.where(ok, new_local[lidx], jnp.int32(n_))
+            old = jnp.min(lax.all_to_all(old_part, SHARD_AXIS, 0, 0), axis=0)
+            new = jnp.min(lax.all_to_all(new_part, SHARD_AXIS, 0, 0), axis=0)
+            return new_local, old, new
+
+        # ---- degrees (replicated accumulator; the table alone is O(V),
+        # fine on-device — the ceiling problem is the 4-table build) ------
+        @partial(jax.jit,
+                 in_shardings=(NamedSharding(mesh, P(SHARD_AXIS, None)),
+                               self.batch_sharding),
+                 out_shardings=NamedSharding(mesh, P(SHARD_AXIS, None)))
+        def deg_step(deg_all, batch):
+            from sheep_tpu.ops import degrees as degrees_ops
+
+            def f(deg_local, chunk_local):
+                return degrees_ops.degree_chunk(
+                    deg_local[0], chunk_local[0], n_)[None]
+            return shard_map(f, mesh=mesh,
+                             in_specs=(P(SHARD_AXIS, None),
+                                       P(SHARD_AXIS, None, None)),
+                             out_specs=P(SHARD_AXIS, None))(deg_all, batch)
+
+        @partial(jax.jit, out_shardings=self.repl)
+        def deg_reduce(deg_all):
+            return jnp.sum(deg_all, axis=0, dtype=jnp.int32)
+
+        # ---- the routed displacement fixpoint ---------------------------
+        @partial(jax.jit,
+                 in_shardings=(self.shard, self.shard, self.shard,
+                               self.batch_sharding),
+                 out_shardings=(self.shard, self.repl))
+        def build_step(minp_sh, pos_sh, order_sh, batch):
+            def f(minp_local, pos_local, order_local, chunk_local):
+                chunk = chunk_local[0]
+                u = jnp.clip(chunk[:, 0], 0, n_)
+                v = jnp.clip(chunk[:, 1], 0, n_)
+                pu = _lookup(pos_local, u)
+                pv = _lookup(pos_local, v)
+                # active constraint = (lo, polo, poshi): carrying lo's own
+                # position makes loop detection local (polo == poshi)
+                lo = jnp.where(pu <= pv, u, v).astype(jnp.int32)
+                polo = jnp.minimum(pu, pv).astype(jnp.int32)
+                poshi = jnp.maximum(pu, pv).astype(jnp.int32)
+                bad = (pu == pv) | (pu == n_) | (pv == n_)
+                lo = jnp.where(bad, n_, lo)
+                polo = jnp.where(bad, n_, polo)
+                poshi = jnp.where(bad, n_, poshi)
+
+                def body(state):
+                    lo_, polo_, poshi_, minp_l, _, rounds = state
+                    minp_l, old, new = _scatter_min(minp_l, lo_, poshi_)
+                    # one order[] lookup answers the climb target
+                    # order[new]; the displaced constraint reuses it too
+                    m_vtx = _lookup(order_local, new)
+
+                    retire = poshi_ == new
+                    displaced = retire & (new < old) & (old < n_)
+
+                    # climb: first step from the scatter reply, further
+                    # single steps via routed minp/order lookups
+                    can0 = new < poshi_
+                    cur_lo = jnp.where(can0, m_vtx, lo_)
+                    cur_po = jnp.where(can0, new, polo_)
+                    for _ in range(jumps_ - 1):
+                        p_next = _lookup(minp_l, cur_lo)
+                        v_next = _lookup(order_local, p_next)
+                        can = p_next < poshi_
+                        cur_lo = jnp.where(can, v_next, cur_lo)
+                        cur_po = jnp.where(can, p_next, cur_po)
+                    became_loop = cur_po == poshi_
+                    climb_lo = jnp.where(became_loop, n_, cur_lo)
+                    climb_po = jnp.where(became_loop, n_, cur_po)
+                    climb_ph = jnp.where(became_loop, n_, poshi_)
+
+                    # displaced constraint (order[new] ~ old-parent from
+                    # time old): lo = m_vtx at position new, poshi = old
+                    out_lo = jnp.where(
+                        retire, jnp.where(displaced, m_vtx, n_),
+                        climb_lo).astype(jnp.int32)
+                    out_po = jnp.where(
+                        retire, jnp.where(displaced, new, n_),
+                        climb_po).astype(jnp.int32)
+                    out_ph = jnp.where(
+                        retire, jnp.where(displaced, old, n_),
+                        climb_ph).astype(jnp.int32)
+                    live = lax.psum(jnp.sum(out_lo != n_), SHARD_AXIS)
+                    return out_lo, out_po, out_ph, minp_l, live, rounds + 1
+
+                def cond(state):
+                    _, _, _, _, live, rounds = state
+                    return (live > 0) & (rounds < max_rounds)
+
+                live0 = lax.psum(jnp.sum(lo != n_), SHARD_AXIS)
+                state = (lo, polo, poshi, minp_local, live0,
+                         (live0 * 0).astype(jnp.int32))
+                _, _, _, minp_f, _, rounds = lax.while_loop(
+                    cond, body, state)
+                return minp_f, lax.pmax(rounds, SHARD_AXIS)
+
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                          P(SHARD_AXIS, None, None)),
+                out_specs=(P(SHARD_AXIS), P()))(
+                    minp_sh, pos_sh, order_sh, batch)
+
+        # ---- scoring (replicated assignment; chunk stays sharded) -------
+        @partial(jax.jit,
+                 in_shardings=(self.batch_sharding, self.repl),
+                 out_shardings=self.repl)
+        def score_step(batch, assign):
+            from sheep_tpu.ops import score as score_ops
+
+            def f(chunk_local, assign_):
+                c, t = score_ops.score_chunk(chunk_local[0], assign_, n_)
+                return lax.psum(jnp.stack([c, t])[None], SHARD_AXIS)
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None, None), P()),
+                out_specs=P(SHARD_AXIS, None))(batch, assign)[0]
+
+        self.deg_step = deg_step
+        self.deg_reduce = deg_reduce
+        self.build_step = build_step
+        self.score_step = score_step
+
+    # ---- host-side helpers ----------------------------------------------
+    def _shard_table(self, host_table: np.ndarray):
+        """Pad an int32[n+1] host table to (rows,) with the sentinel and
+        place it block-sharded."""
+        padded = np.full(self.rows, self.n, np.int32)
+        padded[: self.n + 1] = host_table
+        return jax.device_put(padded, self.shard)
+
+    def run(self, stream, k: int, alpha: float = 1.0,
+            weights: Optional[str] = "unit", comm_volume: bool = False,
+            timings: Optional[dict] = None):
+        """Full vertex-sharded partition run (single process)."""
+        from sheep_tpu.core import pure
+        from sheep_tpu.ops import score as score_ops
+        from sheep_tpu.ops.split import tree_split_host
+        from sheep_tpu.parallel.pipeline import chunk_batches
+        from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils.prefetch import prefetch
+
+        t = timings if timings is not None else {}
+        n, cs, d = self.n, self.cs, self.n_devices
+
+        def batches():
+            return prefetch(b for b, _ in chunk_batches(
+                stream, cs, d, n))
+
+        # pass 1: degrees (replicated int32 accumulator + int64 host fold)
+        t0 = time.perf_counter()
+        flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
+        deg_host = np.zeros(n, dtype=np.int64)
+        deg_all = jax.device_put(
+            np.zeros((d, n + 1), np.int32),
+            NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+        since = 0
+        for batch in batches():
+            deg_all = self.deg_step(deg_all, jax.device_put(
+                batch, self.batch_sharding))
+            since += 1
+            if since >= flush_every:
+                deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
+                                       dtype=np.int64)
+                deg_all = jax.device_put(
+                    np.zeros((d, n + 1), np.int32),
+                    NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+                since = 0
+        deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+
+        # host-side elimination order: one argsort over (deg, id); hosts
+        # hold hundreds of GB, and the sort is once per run
+        pos_np = pure.elimination_order(deg_host)
+        order_np = np.full(n + 1, n, dtype=np.int64)
+        order_np[pos_np] = np.arange(n)
+        pos_sh = self._shard_table(
+            np.concatenate([pos_np, [n]]).astype(np.int32))
+        order_sh = self._shard_table(order_np.astype(np.int32))
+        t["degrees+sort"] = time.perf_counter() - t0
+
+        # pass 2: the single distributed forest
+        t0 = time.perf_counter()
+        minp_sh = self._shard_table(np.full(n + 1, n, np.int32))
+        total_rounds = 0
+        for batch in batches():
+            minp_sh, rounds = self.build_step(
+                minp_sh, pos_sh, order_sh,
+                jax.device_put(batch, self.batch_sharding))
+            total_rounds += int(rounds)
+        minp_host = np.asarray(minp_sh)[: n + 1]
+        t["build"] = time.perf_counter() - t0
+
+        # split on host over O(V) state (native C++)
+        t0 = time.perf_counter()
+        minp_v = minp_host[:n]
+        parent = np.where(minp_v < n, order_np[np.minimum(minp_v, n)], -1)
+        w = deg_host.astype(np.float64) if weights == "degree" else None
+        assign_host = tree_split_host(parent, pos_np, k, weights=w,
+                                      alpha=alpha)
+        assign = jax.device_put(
+            np.concatenate([assign_host.astype(np.int32),
+                            np.zeros(1, np.int32)]), self.repl)
+        t["split"] = time.perf_counter() - t0
+
+        # pass 3: scoring (sharded chunks, psum counters)
+        t0 = time.perf_counter()
+        cut = total = 0
+        cv_chunks = []
+        for batch in batches():
+            c, tt = np.asarray(self.score_step(
+                jax.device_put(batch, self.batch_sharding), assign))
+            cut += int(c)
+            total += int(tt)
+            if comm_volume:
+                cv_chunks.append(
+                    score_ops.cut_pair_keys_host(batch, assign, n, k))
+        cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
+        balance = pure.part_balance(
+            assign_host, k, deg_host if weights == "degree" else None)
+        t["score"] = time.perf_counter() - t0
+
+        return {
+            "assignment": assign_host, "parent": parent.astype(np.int64),
+            "pos": pos_np, "degrees": deg_host, "edge_cut": cut,
+            "total_edges": total, "balance": balance, "comm_volume": cv,
+            "k": k, "fixpoint_rounds": total_rounds,
+        }

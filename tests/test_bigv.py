@@ -1,0 +1,133 @@
+"""Vertex-sharded big-V pipeline vs the sequential oracle (SURVEY.md §7
+hard part #2; BASELINE.md eval config 5 class).
+
+Tables are block-sharded (no replicated O(V) state) and the displacement
+fixpoint runs as one distributed forest through routed collectives; the
+elimination tree is order-determined, so results must match the oracle
+EXACTLY on every shape — including the ones that stress routing (hubs
+concentrating requests on one owner) and displacement chains.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from sheep_tpu.core import pure
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.parallel.bigv import BigVPipeline
+from sheep_tpu.parallel.mesh import shards_mesh
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs 8 virtual devices")
+
+
+def _run(e, n, k=8, n_devices=8, chunk_edges=128, jumps=4):
+    mesh = shards_mesh(n_devices)
+    pipe = BigVPipeline(n, chunk_edges, mesh, jumps=jumps)
+    return pipe.run(EdgeStream.from_array(e, n_vertices=n), k=k,
+                    comm_volume=True)
+
+
+def _oracle(e, n, k=8):
+    ref = pure.partition_arrays(e, k, n=n)
+    tree = pure.build_elim_tree(e, pure.elimination_order(pure.degrees(e, n)))
+    return ref, tree.parent
+
+
+CASES = {
+    "karate": (generators.karate_club(), 34),
+    "rmat9": (generators.rmat(9, 8, seed=21), 512),
+    "grid": (generators.grid_graph(16, 16), 256),
+    "path": (generators.path_graph(200), 200),
+    "star_hub": (generators.star_graph(300), 300),  # all requests -> 1 owner
+    "two_components": (
+        np.concatenate([generators.path_graph(40),
+                        40 + generators.star_graph(50)]), 90),
+}
+
+
+@pytest.fixture(params=list(CASES))
+def graph(request):
+    return CASES[request.param]
+
+
+def test_bigv_matches_oracle_exactly(graph):
+    e, n = graph
+    out = _run(e, n)
+    ref, expect_parent = _oracle(e, n)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+    assert out["total_edges"] == ref.total_edges
+    assert out["edge_cut"] == ref.edge_cut
+    assert out["comm_volume"] == ref.comm_volume
+    np.testing.assert_array_equal(out["assignment"], ref.assignment)
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 3, 5, 8])
+def test_bigv_device_count_invariance(n_devices):
+    e = generators.rmat(8, 8, seed=33)
+    n = 256
+    out = _run(e, n, n_devices=n_devices)
+    _, expect_parent = _oracle(e, n)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+
+
+@pytest.mark.parametrize("jumps", [1, 2, 8])
+def test_bigv_jumps_invariance(jumps):
+    """The climb depth per round is a performance knob, never a
+    correctness one."""
+    e = generators.rmat(8, 8, seed=34)
+    n = 256
+    out = _run(e, n, jumps=jumps)
+    _, expect_parent = _oracle(e, n)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+
+
+def test_bigv_worst_case_displacement_order():
+    """Descending pos[hi] streaming maximizes displacement chains through
+    the routed scatter replies."""
+    e, n = generators.rmat(9, 4, seed=7), 512
+    pos_np = pure.elimination_order(pure.degrees(e, n))
+    key = np.maximum(pos_np[e[:, 0]], pos_np[e[:, 1]])
+    out = _run(e[np.argsort(-key, kind="stable")], n, chunk_edges=64)
+    _, expect_parent = _oracle(e, n)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+
+
+def test_bigv_duplicates_and_self_loops():
+    base = generators.random_graph(60, 150, seed=17)
+    loops = np.stack([np.arange(10), np.arange(10)], axis=1)
+    e = np.concatenate([base, base, loops, base])
+    rng = np.random.default_rng(5)
+    e = e[rng.permutation(len(e))]
+    out = _run(e, 60)
+    ref, expect_parent = _oracle(e, 60)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+    assert out["edge_cut"] == ref.edge_cut
+
+
+def test_bigv_backend_registration():
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    assert "tpu-bigv" in list_backends()
+    e = generators.rmat(8, 8, seed=35)
+    n = 256
+    res = get_backend("tpu-bigv", chunk_edges=300).partition(
+        EdgeStream.from_array(e, n_vertices=n), 8)
+    ref = pure.partition_arrays(e, 8, n=n)
+    assert res.edge_cut == ref.edge_cut
+    assert res.comm_volume == ref.comm_volume
+    np.testing.assert_array_equal(res.assignment, ref.assignment)
+
+
+def test_bigv_per_device_tables_are_sharded():
+    """The whole point: no device holds a full O(V) table. Check the
+    placed shards' per-device byte footprint."""
+    n = 1 << 12
+    mesh = shards_mesh(8)
+    pipe = BigVPipeline(n, 128, mesh)
+    sharded = pipe._shard_table(np.full(n + 1, n, np.int32))
+    shard_shapes = {s.data.shape for s in sharded.addressable_shards}
+    assert shard_shapes == {(pipe.B,)}
+    assert pipe.B < (n + 1) / 4  # 8 devices -> each holds ~1/8
