@@ -54,10 +54,14 @@ class TpuBackend(Partitioner):
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
-                 alpha: float = 1.0):
+                 alpha: float = 1.0, segment_rounds: int = 32):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
+        # fixpoint rounds per device execution; bounding each call keeps
+        # accelerator executions short (long single executions tripped the
+        # TPU worker watchdog) while staying bit-identical to monolithic
+        self.segment_rounds = segment_rounds
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -130,9 +134,10 @@ class TpuBackend(Partitioner):
             idx = start
             for padded in prefetch(pad_chunk(c, cs, n)
                                    for c in stream.chunks(cs, start_chunk=start)):
-                minp, rounds = elim_ops.build_chunk_step(
+                minp, rounds = elim_ops.build_chunk_step_segmented(
                     minp, padded, pos, order, n,
-                    lift_levels=self.lift_levels)
+                    lift_levels=self.lift_levels,
+                    segment_rounds=self.segment_rounds)
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)

@@ -31,8 +31,18 @@ The elimination order is computed on HOST (numpy argsort over the int64
 degree table — hosts hold hundreds of GB; one sort per run, amortized
 over the whole stream) and only the pos/order block shards are pushed to
 devices. The split likewise runs on host over the O(V) parent array
-(native C++), and scoring reuses a replicated assignment table (int32[V]
-fits any chip that can hold a chunk).
+(native C++). Degrees accumulate into a block-sharded table via the same
+routed scatter pattern, and scoring resolves part lookups against a
+block-sharded assignment table with the routed gather — NO vertex-indexed
+device state is replicated anywhere in the pipeline, so per-device memory
+really is O(V/D) tables + O(D * chunk) routing buffers. (Host memory is
+O(V): the degree fold, sort, and split run there by design.)
+
+The fixpoint loop is driven from the HOST in bounded segments
+(``segment_rounds`` rounds per device execution): long single accelerator
+executions are what crash TPU worker watchdogs, and the collective
+``live`` count makes every device (and every process) agree on the
+segment boundary, so the lockstep host loop is safe under shard_map.
 
 Everything is static-shape: routing buffers are (D, Q) for Q actives, so
 there are no per-destination capacity constants and no overflow paths —
@@ -43,7 +53,6 @@ unboundedly uneven.
 
 from __future__ import annotations
 
-import math
 import time
 from functools import partial
 from typing import Optional
@@ -68,7 +77,7 @@ class BigVPipeline:
     """
 
     def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 4,
-                 max_rounds: int = 1 << 20):
+                 max_rounds: int = 1 << 20, segment_rounds: int = 16):
         d = mesh.devices.size
         self.n = n
         self.cs = chunk_edges
@@ -77,6 +86,7 @@ class BigVPipeline:
         self.jumps = jumps
         self.B = -(-(n + 1) // d)  # owned rows per device
         self.rows = d * self.B      # padded global table length
+        self.segment_rounds = segment_rounds
         self.procs = len({dev.process_index for dev in mesh.devices.flat})
         if self.procs != 1:
             # multi-host works through the same collectives; per-process
@@ -127,41 +137,47 @@ class BigVPipeline:
             new = jnp.min(lax.all_to_all(new_part, SHARD_AXIS, 0, 0), axis=0)
             return new_local, old, new
 
-        # ---- degrees (replicated accumulator; the table alone is O(V),
-        # fine on-device — the ceiling problem is the 4-table build) ------
+        # ---- degrees: block-sharded accumulator, routed scatter-add -----
+        # (same ownership routing as _scatter_min; semantics match
+        # ops/degrees.degree_chunk: clip to [0, n], slot n absorbs padding,
+        # self-loops count twice)
+        @partial(jax.jit, out_shardings=self.shard)
+        def deg_zeros():
+            return jnp.zeros(self.rows, jnp.int32)
+
         @partial(jax.jit,
-                 in_shardings=(NamedSharding(mesh, P(SHARD_AXIS, None)),
-                               self.batch_sharding),
-                 out_shardings=NamedSharding(mesh, P(SHARD_AXIS, None)))
-        def deg_step(deg_all, batch):
-            from sheep_tpu.ops import degrees as degrees_ops
-
+                 in_shardings=(self.shard, self.batch_sharding),
+                 out_shardings=self.shard)
+        def deg_step(deg_sh, batch):
             def f(deg_local, chunk_local):
-                return degrees_ops.degree_chunk(
-                    deg_local[0], chunk_local[0], n_)[None]
+                ids = jnp.clip(chunk_local[0].reshape(-1), 0, n_) \
+                    .astype(jnp.int32)
+                gids = lax.all_gather(ids, SHARD_AXIS)      # (D, 2C)
+                me = lax.axis_index(SHARD_AXIS)
+                local = gids - me * B
+                idx = jnp.where((local >= 0) & (local < B), local, B)
+                return deg_local.at[idx.ravel()].add(1, mode="drop")
             return shard_map(f, mesh=mesh,
-                             in_specs=(P(SHARD_AXIS, None),
+                             in_specs=(P(SHARD_AXIS),
                                        P(SHARD_AXIS, None, None)),
-                             out_specs=P(SHARD_AXIS, None))(deg_all, batch)
-
-        @partial(jax.jit, out_shardings=self.repl)
-        def deg_reduce(deg_all):
-            return jnp.sum(deg_all, axis=0, dtype=jnp.int32)
+                             out_specs=P(SHARD_AXIS))(deg_sh, batch)
 
         # ---- the routed displacement fixpoint ---------------------------
+        act = NamedSharding(mesh, P(SHARD_AXIS, None))  # (D, Q) actives
+
         @partial(jax.jit,
-                 in_shardings=(self.shard, self.shard, self.shard,
-                               self.batch_sharding),
-                 out_shardings=(self.shard, self.repl))
-        def build_step(minp_sh, pos_sh, order_sh, batch):
-            def f(minp_local, pos_local, order_local, chunk_local):
+                 in_shardings=(self.shard, self.batch_sharding),
+                 out_shardings=(act, act, act))
+        def orient_step(pos_sh, batch):
+            """Resolve a batch's endpoints to oriented active constraints
+            (lo, polo, poshi); carrying lo's own position makes loop
+            detection local (polo == poshi)."""
+            def f(pos_local, chunk_local):
                 chunk = chunk_local[0]
                 u = jnp.clip(chunk[:, 0], 0, n_)
                 v = jnp.clip(chunk[:, 1], 0, n_)
                 pu = _lookup(pos_local, u)
                 pv = _lookup(pos_local, v)
-                # active constraint = (lo, polo, poshi): carrying lo's own
-                # position makes loop detection local (polo == poshi)
                 lo = jnp.where(pu <= pv, u, v).astype(jnp.int32)
                 polo = jnp.minimum(pu, pv).astype(jnp.int32)
                 poshi = jnp.maximum(pu, pv).astype(jnp.int32)
@@ -169,6 +185,25 @@ class BigVPipeline:
                 lo = jnp.where(bad, n_, lo)
                 polo = jnp.where(bad, n_, polo)
                 poshi = jnp.where(bad, n_, poshi)
+                return lo[None], polo[None], poshi[None]
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None, None)),
+                out_specs=(P(SHARD_AXIS, None),) * 3)(pos_sh, batch)
+
+        seg_ = self.segment_rounds
+
+        @partial(jax.jit,
+                 in_shardings=(self.shard, self.shard, act, act, act),
+                 out_shardings=(self.shard, act, act, act, self.repl,
+                                self.repl))
+        def fold_seg_step(minp_sh, order_sh, lo_all, polo_all, poshi_all):
+            """At most ``segment_rounds`` routed fixpoint rounds in one
+            device execution; the psum'd live count is the collective
+            continue signal, identical on every device/process, so the
+            host loop segment boundaries stay in lockstep."""
+            def f(minp_local, order_local, lo_l, polo_l, poshi_l):
+                lo0, polo0, poshi0 = lo_l[0], polo_l[0], poshi_l[0]
 
                 def body(state):
                     lo_, polo_, poshi_, minp_l, _, rounds = state
@@ -212,41 +247,67 @@ class BigVPipeline:
 
                 def cond(state):
                     _, _, _, _, live, rounds = state
-                    return (live > 0) & (rounds < max_rounds)
+                    return (live > 0) & (rounds < seg_)
 
-                live0 = lax.psum(jnp.sum(lo != n_), SHARD_AXIS)
-                state = (lo, polo, poshi, minp_local, live0,
+                live0 = lax.psum(jnp.sum(lo0 != n_), SHARD_AXIS)
+                state = (lo0, polo0, poshi0, minp_local, live0,
                          (live0 * 0).astype(jnp.int32))
-                _, _, _, minp_f, _, rounds = lax.while_loop(
-                    cond, body, state)
-                return minp_f, lax.pmax(rounds, SHARD_AXIS)
+                lo_f, polo_f, poshi_f, minp_f, live_f, rounds = \
+                    lax.while_loop(cond, body, state)
+                return (minp_f, lo_f[None], polo_f[None], poshi_f[None],
+                        live_f, lax.pmax(rounds, SHARD_AXIS))
 
             return shard_map(
                 f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                          P(SHARD_AXIS, None, None)),
-                out_specs=(P(SHARD_AXIS), P()))(
-                    minp_sh, pos_sh, order_sh, batch)
+                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS),
+                          P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                          P(SHARD_AXIS, None)),
+                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None),
+                           P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                           P(), P()))(
+                    minp_sh, order_sh, lo_all, polo_all, poshi_all)
 
-        # ---- scoring (replicated assignment; chunk stays sharded) -------
+        # ---- scoring (block-sharded assignment, routed part lookups;
+        # chunk stays sharded — no replicated O(V) state here either) ----
         @partial(jax.jit,
-                 in_shardings=(self.batch_sharding, self.repl),
+                 in_shardings=(self.batch_sharding, self.shard),
                  out_shardings=self.repl)
-        def score_step(batch, assign):
-            from sheep_tpu.ops import score as score_ops
-
-            def f(chunk_local, assign_):
-                c, t = score_ops.score_chunk(chunk_local[0], assign_, n_)
-                return lax.psum(jnp.stack([c, t])[None], SHARD_AXIS)
+        def score_step(batch, assign_sh):
+            def f(chunk_local, assign_local):
+                chunk = chunk_local[0]
+                u = chunk[:, 0].astype(jnp.int32)
+                v = chunk[:, 1].astype(jnp.int32)
+                valid = (u >= 0) & (u < n_) & (v >= 0) & (v < n_) & (u != v)
+                au = _lookup(assign_local, jnp.clip(u, 0, n_))
+                av = _lookup(assign_local, jnp.clip(v, 0, n_))
+                cut = jnp.sum(valid & (au != av), dtype=jnp.int32)
+                total = jnp.sum(valid, dtype=jnp.int32)
+                return lax.psum(jnp.stack([cut, total])[None], SHARD_AXIS)
             return shard_map(
                 f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS, None, None), P()),
-                out_specs=P(SHARD_AXIS, None))(batch, assign)[0]
+                in_specs=(P(SHARD_AXIS, None, None), P(SHARD_AXIS)),
+                out_specs=P(SHARD_AXIS, None))(batch, assign_sh)[0]
 
+        self.deg_zeros = deg_zeros
         self.deg_step = deg_step
-        self.deg_reduce = deg_reduce
-        self.build_step = build_step
+        self.orient_step = orient_step
+        self.fold_seg_step = fold_seg_step
         self.score_step = score_step
+        self.max_rounds = max_rounds
+
+    def build_step(self, minp_sh, pos_sh, order_sh, batch_dev):
+        """Fold one sharded batch into the distributed forest via
+        host-bounded segments. Returns (minp_sh, total_rounds) — identical
+        to running the whole fixpoint in one execution, but no single
+        device call exceeds ``segment_rounds`` rounds."""
+        lo_a, polo_a, poshi_a = self.orient_step(pos_sh, batch_dev)
+        total = 0
+        while True:
+            minp_sh, lo_a, polo_a, poshi_a, live, r = self.fold_seg_step(
+                minp_sh, order_sh, lo_a, polo_a, poshi_a)
+            total += int(r)
+            if int(live) == 0 or total >= self.max_rounds:
+                return minp_sh, total
 
     # ---- host-side helpers ----------------------------------------------
     def _shard_table(self, host_table: np.ndarray):
@@ -274,26 +335,22 @@ class BigVPipeline:
             return prefetch(b for b, _ in chunk_batches(
                 stream, cs, d, n))
 
-        # pass 1: degrees (replicated int32 accumulator + int64 host fold)
+        # pass 1: degrees (block-sharded int32 accumulator + int64 host
+        # fold; resets are jitted on-device zeros, no host zero uploads)
         t0 = time.perf_counter()
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
         deg_host = np.zeros(n, dtype=np.int64)
-        deg_all = jax.device_put(
-            np.zeros((d, n + 1), np.int32),
-            NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+        deg_sh = self.deg_zeros()
         since = 0
         for batch in batches():
-            deg_all = self.deg_step(deg_all, jax.device_put(
+            deg_sh = self.deg_step(deg_sh, jax.device_put(
                 batch, self.batch_sharding))
             since += 1
             if since >= flush_every:
-                deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
-                                       dtype=np.int64)
-                deg_all = jax.device_put(
-                    np.zeros((d, n + 1), np.int32),
-                    NamedSharding(self.mesh, P(SHARD_AXIS, None)))
+                deg_host += np.asarray(deg_sh)[:n].astype(np.int64)
+                deg_sh = self.deg_zeros()
                 since = 0
-        deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+        deg_host += np.asarray(deg_sh)[:n].astype(np.int64)
 
         # host-side elimination order: one argsort over (deg, id); hosts
         # hold hundreds of GB, and the sort is once per run
@@ -313,7 +370,7 @@ class BigVPipeline:
             minp_sh, rounds = self.build_step(
                 minp_sh, pos_sh, order_sh,
                 jax.device_put(batch, self.batch_sharding))
-            total_rounds += int(rounds)
+            total_rounds += rounds
         minp_host = np.asarray(minp_sh)[: n + 1]
         t["build"] = time.perf_counter() - t0
 
@@ -324,23 +381,24 @@ class BigVPipeline:
         w = deg_host.astype(np.float64) if weights == "degree" else None
         assign_host = tree_split_host(parent, pos_np, k, weights=w,
                                       alpha=alpha)
-        assign = jax.device_put(
-            np.concatenate([assign_host.astype(np.int32),
-                            np.zeros(1, np.int32)]), self.repl)
+        assign_np = np.concatenate([assign_host.astype(np.int32),
+                                    np.zeros(1, np.int32)])
+        assign_sh = self._shard_table(assign_np)
         t["split"] = time.perf_counter() - t0
 
-        # pass 3: scoring (sharded chunks, psum counters)
+        # pass 3: scoring (sharded chunks, routed lookups into the
+        # block-sharded assignment, psum counters)
         t0 = time.perf_counter()
         cut = total = 0
         cv_chunks = []
         for batch in batches():
             c, tt = np.asarray(self.score_step(
-                jax.device_put(batch, self.batch_sharding), assign))
+                jax.device_put(batch, self.batch_sharding), assign_sh))
             cut += int(c)
             total += int(tt)
             if comm_volume:
                 cv_chunks.append(
-                    score_ops.cut_pair_keys_host(batch, assign, n, k))
+                    score_ops.cut_pair_keys_host(batch, assign_np, n, k))
         cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
         balance = pure.part_balance(
             assign_host, k, deg_host if weights == "degree" else None)

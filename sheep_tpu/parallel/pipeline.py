@@ -65,11 +65,16 @@ def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
 class ShardedPipeline:
     """Compiled sharded pipeline for a fixed (n, chunk_edges, mesh)."""
 
-    def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0):
+    def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0,
+                 segment_rounds: int = 32):
         self.n = n
         self.cs = chunk_edges
         self.mesh = mesh
         self.lift_levels = lift_levels
+        # fixpoint rounds per device execution in the build phase; the
+        # host loops bounded segments so no single accelerator call runs
+        # unboundedly long (the TPU worker watchdog kills those)
+        self.segment_rounds = segment_rounds
         d = mesh.devices.size
         self.n_devices = d
         self.rounds = max(1, math.ceil(math.log2(d))) if d > 1 else 0
@@ -110,20 +115,48 @@ class ShardedPipeline:
         def make_order(deg_total):
             return order_ops.elimination_order(deg_total, n_)
 
+        seg_ = self.segment_rounds
+
         @partial(jax.jit,
-                 in_shardings=(self.state_sharding, self.batch_sharding,
-                               self.repl_sharding, self.repl_sharding),
-                 out_shardings=self.state_sharding)
-        def build_step(forest_all, batch, pos, order):
-            def f(forest_local, chunk_local, pos_, order_):
-                minp, _ = elim_ops.build_chunk_step(
-                    forest_local[0], chunk_local[0], pos_, order_, n_,
-                    lift_levels=lift)
-                return minp[None]
+                 in_shardings=(self.batch_sharding, self.repl_sharding),
+                 out_shardings=(self.state_sharding, self.state_sharding))
+        def orient_step(batch, pos):
+            def f(chunk_local, pos_):
+                lo, hi = elim_ops.orient_edges(chunk_local[0], pos_, n_)
+                return lo[None], hi[None]
             return shard_map(
                 f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None, None), P(), P()),
-                out_specs=P(SHARD_AXIS, None))(forest_all, batch, pos, order)
+                in_specs=(P(SHARD_AXIS, None, None), P()),
+                out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
+                    batch, pos)
+
+        @partial(jax.jit,
+                 in_shardings=(self.state_sharding, self.state_sharding,
+                               self.state_sharding, self.repl_sharding,
+                               self.repl_sharding),
+                 out_shardings=(self.state_sharding, self.state_sharding,
+                                self.state_sharding, self.repl_sharding))
+        def fold_seg_step(forest_all, lo_all, hi_all, pos, order):
+            """At most ``segment_rounds`` fixpoint rounds per device in ONE
+            execution; returns the carried state plus a replicated
+            any-device-still-changing flag (pmax) so the host loop stays in
+            lockstep across devices and processes."""
+            def f(forest_local, lo_local, hi_local, pos_, order_):
+                lo2, hi2, minp, changed, _ = elim_ops.fold_edges_segment(
+                    forest_local[0], lo_local[0], hi_local[0], pos_, order_,
+                    n_, lift_levels=lift, segment_rounds=seg_)
+                any_changed = lax.pmax(changed.astype(jnp.int32), SHARD_AXIS)
+                return minp[None], lo2[None], hi2[None], any_changed
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                          P(SHARD_AXIS, None), P(), P()),
+                out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                           P(SHARD_AXIS, None), P()))(
+                    forest_all, lo_all, hi_all, pos, order)
+
+        self.orient_step = orient_step
+        self.fold_seg_step = fold_seg_step
 
         d_ = self.n_devices
         r_ = self.rounds
@@ -211,9 +244,19 @@ class ShardedPipeline:
         self.deg_step = deg_step
         self.deg_reduce = deg_reduce
         self.make_order = make_order
-        self.build_step = build_step
         self.merge_all = merge_all
         self.score_step = score_step
+
+    def build_step(self, forest_all, batch_dev, pos, order):
+        """Fold one sharded batch into the per-device forests via
+        host-bounded segments (same fixpoint as the monolithic while_loop,
+        bit-identical results — see ops/elim.py fold_edges_segment)."""
+        lo_all, hi_all = self.orient_step(batch_dev, pos)
+        while True:
+            forest_all, lo_all, hi_all, changed = self.fold_seg_step(
+                forest_all, lo_all, hi_all, pos, order)
+            if not int(changed):
+                return forest_all
 
     # -- host->device placement (multi-host aware) -------------------------
     def _put(self, sharding, arr: np.ndarray):

@@ -80,6 +80,23 @@ def test_fixpoint_descent_modes_match_oracle(graph, descent):
     np.testing.assert_array_equal(parent, expect)
 
 
+@pytest.mark.parametrize("segment_rounds", [1, 3, 32])
+def test_segmented_fixpoint_bit_identical(graph, segment_rounds):
+    """Host-driven bounded segments (the watchdog-safe device path) must
+    reproduce the monolithic while_loop fixpoint bit-for-bit, including
+    the total round count."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    padded = pad_chunk(e, len(e), n)
+    whole, rounds_mono = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), padded, pos, order, n)
+    seg, rounds_seg = elim_ops.build_chunk_step_segmented(
+        jnp.full(n + 1, n, dtype=jnp.int32), padded, pos, order, n,
+        segment_rounds=segment_rounds)
+    np.testing.assert_array_equal(np.asarray(seg), np.asarray(whole))
+    assert rounds_seg == int(rounds_mono)
+
+
 def test_streaming_chunks_match_batch(graph):
     e, n = graph
     pos, order = _device_order(e, n)
