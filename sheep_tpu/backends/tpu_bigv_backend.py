@@ -1,12 +1,15 @@
 """Vertex-sharded big-V backend, registered as ``tpu-bigv``.
 
 For graphs whose vertex tables exceed one chip's HBM (BASELINE.md eval
-config 5, RMAT-30 class): pos/order/minp are block-sharded over the
-device mesh and the displacement fixpoint runs as ONE distributed forest
-with routed collectives (``parallel/bigv.py``). Per-device table memory
-is O(V/D); the standard ``tpu-sharded`` backend is faster whenever the
-replicated tables fit (V <= 2^29 single-chip), so pick this one only
-beyond that.
+config 5, RMAT-30 class): every vertex-indexed table (pos/order/minp,
+degrees, assignment) is block-sharded over the device mesh and the
+displacement fixpoint runs as ONE distributed forest with routed
+collectives (``parallel/bigv.py``). Per-device table memory is O(V/D);
+the standard ``tpu-sharded`` backend is faster whenever the replicated
+tables fit (V <= 2^29 single-chip), so pick this one only beyond that.
+Multi-host works the same way (the mesh spans all processes' devices and
+the routed collectives ride DCN); tested against the sequential oracle in
+``tests/test_multihost.py``.
 """
 
 from __future__ import annotations

@@ -87,13 +87,17 @@ class BigVPipeline:
         self.B = -(-(n + 1) // d)  # owned rows per device
         self.rows = d * self.B      # padded global table length
         self.segment_rounds = segment_rounds
+        # multi-host: same collectives ride DCN; this process owns
+        # n_local contiguous mesh rows (jax.devices() orders by process),
+        # so its local span of any block-sharded table is
+        # [proc * n_local * B, (proc+1) * n_local * B)
         self.procs = len({dev.process_index for dev in mesh.devices.flat})
-        if self.procs != 1:
-            # multi-host works through the same collectives; per-process
-            # batch lockstep is inherited from ShardedPipeline if needed
-            raise NotImplementedError(
-                "bigv multi-host driving loop not wired yet; use one "
-                "process per slice")
+        self.proc = jax.process_index() if self.procs > 1 else 0
+        self.n_local = (sum(1 for dev in mesh.devices.flat
+                            if dev.process_index == jax.process_index())
+                        if self.procs > 1 else d)
+        if self.procs > 1 and self.n_local * self.procs != d:
+            raise ValueError("uneven devices per process not supported")
 
         self.shard = NamedSharding(mesh, P(SHARD_AXIS))        # (rows,)
         self.batch_sharding = NamedSharding(mesh, P(SHARD_AXIS, None, None))
@@ -310,12 +314,45 @@ class BigVPipeline:
                 return minp_sh, total
 
     # ---- host-side helpers ----------------------------------------------
+    def _put(self, sharding, arr: np.ndarray):
+        """Single process: plain device_put. Multi-host: every process
+        passes its process-local rows and JAX assembles the global array."""
+        if self.procs == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(sharding, arr)
+
+    def _local_span(self):
+        """This process's row span of a (rows,) block-sharded table."""
+        w = self.n_local * self.B
+        return self.proc * w, (self.proc + 1) * w
+
+    def _local_block(self, arr) -> np.ndarray:
+        """Host copy of this process's rows of a (rows,) sharded array."""
+        if self.procs == 1:
+            return np.asarray(arr)
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def _allgather_table(self, local: np.ndarray) -> np.ndarray:
+        """Assemble the full (rows,) host table from per-process local
+        blocks (one DCN allgather; identical result on every process)."""
+        if self.procs == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(local)).reshape(-1)
+
     def _shard_table(self, host_table: np.ndarray):
         """Pad an int32[n+1] host table to (rows,) with the sentinel and
-        place it block-sharded."""
+        place it block-sharded (every process holds the full host table;
+        each contributes its local span)."""
         padded = np.full(self.rows, self.n, np.int32)
         padded[: self.n + 1] = host_table
-        return jax.device_put(padded, self.shard)
+        a, b = self._local_span()
+        return self._put(self.shard,
+                         padded if self.procs == 1 else padded[a:b])
 
     def run(self, stream, k: int, alpha: float = 1.0,
             weights: Optional[str] = "unit", comm_volume: bool = False,
@@ -324,7 +361,8 @@ class BigVPipeline:
         from sheep_tpu.core import pure
         from sheep_tpu.ops import score as score_ops
         from sheep_tpu.ops.split import tree_split_host
-        from sheep_tpu.parallel.pipeline import chunk_batches
+        from sheep_tpu.parallel.pipeline import (iter_batches_lockstep,
+                                                 use_byte_range)
         from sheep_tpu.utils import checkpoint as ckpt
         from sheep_tpu.utils.prefetch import prefetch
 
@@ -332,25 +370,28 @@ class BigVPipeline:
         n, cs, d = self.n, self.cs, self.n_devices
 
         def batches():
-            return prefetch(b for b, _ in chunk_batches(
-                stream, cs, d, n))
+            return prefetch(iter_batches_lockstep(
+                stream, cs, self.n_local, n, self.proc, self.procs,
+                byte_range=use_byte_range(stream, self.procs)))
 
         # pass 1: degrees (block-sharded int32 accumulator + int64 host
-        # fold; resets are jitted on-device zeros, no host zero uploads)
+        # fold of the LOCAL block; resets are jitted on-device zeros, no
+        # host zero uploads; one final allgather assembles the table)
         t0 = time.perf_counter()
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
-        deg_host = np.zeros(n, dtype=np.int64)
+        deg_local = np.zeros(self.n_local * self.B, dtype=np.int64)
         deg_sh = self.deg_zeros()
         since = 0
         for batch in batches():
-            deg_sh = self.deg_step(deg_sh, jax.device_put(
-                batch, self.batch_sharding))
+            deg_sh = self.deg_step(deg_sh, self._put(
+                self.batch_sharding, batch))
             since += 1
             if since >= flush_every:
-                deg_host += np.asarray(deg_sh)[:n].astype(np.int64)
+                deg_local += self._local_block(deg_sh).astype(np.int64)
                 deg_sh = self.deg_zeros()
                 since = 0
-        deg_host += np.asarray(deg_sh)[:n].astype(np.int64)
+        deg_local += self._local_block(deg_sh).astype(np.int64)
+        deg_host = self._allgather_table(deg_local)[:n]
 
         # host-side elimination order: one argsort over (deg, id); hosts
         # hold hundreds of GB, and the sort is once per run
@@ -369,9 +410,10 @@ class BigVPipeline:
         for batch in batches():
             minp_sh, rounds = self.build_step(
                 minp_sh, pos_sh, order_sh,
-                jax.device_put(batch, self.batch_sharding))
+                self._put(self.batch_sharding, batch))
             total_rounds += rounds
-        minp_host = np.asarray(minp_sh)[: n + 1]
+        minp_host = self._allgather_table(
+            self._local_block(minp_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
 
         # split on host over O(V) state (native C++)
@@ -393,13 +435,28 @@ class BigVPipeline:
         cv_chunks = []
         for batch in batches():
             c, tt = np.asarray(self.score_step(
-                jax.device_put(batch, self.batch_sharding), assign_sh))
+                self._put(self.batch_sharding, batch), assign_sh))
             cut += int(c)
             total += int(tt)
             if comm_volume:
                 cv_chunks.append(
                     score_ops.cut_pair_keys_host(batch, assign_np, n, k))
-        cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
+        cv = None
+        if comm_volume:
+            keys = ckpt.compact_cv_keys(cv_chunks)
+            if self.procs > 1:
+                # each process saw only its shard's cut edges: union the
+                # per-host key sets (padded allgather, then host unique)
+                from jax.experimental import multihost_utils
+
+                lens = multihost_utils.process_allgather(
+                    np.array([len(keys)], np.int64))
+                mx = max(1, int(lens.max()))
+                pad = np.full(mx, -1, np.int64)
+                pad[:len(keys)] = keys
+                allk = multihost_utils.process_allgather(pad)
+                keys = np.unique(allk[allk >= 0])
+            cv = int(len(keys))
         balance = pure.part_balance(
             assign_host, k, deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
