@@ -35,10 +35,6 @@ class TpuBigVBackend(Partitioner):
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
                   resume: bool = False, **opts) -> PartitionResult:
-        if checkpointer is not None:
-            raise NotImplementedError(
-                "tpu-bigv does not checkpoint yet; use tpu-sharded "
-                "(V <= 2^29) or run without --checkpoint-dir")
         n = stream.num_vertices
         mesh = shards_mesh(self.n_devices)
         cs = self.chunk_edges
@@ -49,7 +45,8 @@ class TpuBigVBackend(Partitioner):
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,
-                       comm_volume=comm_volume, timings=timings)
+                       comm_volume=comm_volume, timings=timings,
+                       checkpointer=checkpointer, resume=resume)
         return PartitionResult(
             assignment=out["assignment"], k=k, edge_cut=out["edge_cut"],
             total_edges=out["total_edges"],

@@ -356,41 +356,72 @@ class BigVPipeline:
 
     def run(self, stream, k: int, alpha: float = 1.0,
             weights: Optional[str] = "unit", comm_volume: bool = False,
-            timings: Optional[dict] = None):
-        """Full vertex-sharded partition run (single process)."""
+            timings: Optional[dict] = None, checkpointer=None,
+            resume: bool = False):
+        """Full vertex-sharded partition run.
+
+        Checkpoint state is the per-process LOCAL block (deg_local int64,
+        minp_local int32 — O(V/P) per process, the bigv scaling story
+        carried through to recovery); the cadence/fingerprint/reconcile
+        machinery is shared with the other backends (utils/checkpoint)."""
         from sheep_tpu.core import pure
         from sheep_tpu.ops import score as score_ops
         from sheep_tpu.ops.split import tree_split_host
         from sheep_tpu.parallel.pipeline import (iter_batches_lockstep,
                                                  use_byte_range)
         from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils.fault import maybe_fail
         from sheep_tpu.utils.prefetch import prefetch
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
 
-        def batches():
+        def batches(start_chunk=0):
             return prefetch(iter_batches_lockstep(
                 stream, cs, self.n_local, n, self.proc, self.procs,
+                start_chunk=start_chunk,
                 byte_range=use_byte_range(stream, self.procs)))
+
+        meta = ckpt.stream_meta(stream, k, cs, weights=weights, alpha=alpha,
+                                comm_volume=comm_volume, state_format="bigv",
+                                devices=d, procs=self.procs,
+                                text_byte_range=use_byte_range(
+                                    stream, self.procs))
+        state = ckpt.resume_state(checkpointer, meta, resume,
+                                  raise_on_mismatch=self.procs == 1)
+        if self.procs > 1 and checkpointer is not None and resume:
+            state = ckpt.reconcile_multihost_resume(checkpointer, state, meta)
+        from_phase = ckpt.phase_index(state.phase) if state else 0
 
         # pass 1: degrees (block-sharded int32 accumulator + int64 host
         # fold of the LOCAL block; resets are jitted on-device zeros, no
         # host zero uploads; one final allgather assembles the table)
         t0 = time.perf_counter()
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
-        deg_local = np.zeros(self.n_local * self.B, dtype=np.int64)
-        deg_sh = self.deg_zeros()
-        since = 0
-        for batch in batches():
-            deg_sh = self.deg_step(deg_sh, self._put(
-                self.batch_sharding, batch))
-            since += 1
-            if since >= flush_every:
-                deg_local += self._local_block(deg_sh).astype(np.int64)
-                deg_sh = self.deg_zeros()
-                since = 0
-        deg_local += self._local_block(deg_sh).astype(np.int64)
+        if state:
+            deg_local = state.arrays["deg_local"].copy()
+        else:
+            deg_local = np.zeros(self.n_local * self.B, dtype=np.int64)
+        if from_phase == 0:
+            start = state.chunk_idx if state else 0
+            deg_sh = self.deg_zeros()
+            since = nb = 0
+            for batch in batches(start):
+                deg_sh = self.deg_step(deg_sh, self._put(
+                    self.batch_sharding, batch))
+                since += 1
+                nb += 1
+                maybe_fail("degrees", nb)
+                at_ckpt = (checkpointer is not None and
+                           checkpointer.due_span((nb - 1) * d, nb * d))
+                if since >= flush_every or at_ckpt:
+                    deg_local += self._local_block(deg_sh).astype(np.int64)
+                    deg_sh = self.deg_zeros()
+                    since = 0
+                if at_ckpt:
+                    checkpointer.save("degrees", start + nb * d,
+                                      {"deg_local": deg_local}, meta)
+            deg_local += self._local_block(deg_sh).astype(np.int64)
         deg_host = self._allgather_table(deg_local)[:n]
 
         # host-side elimination order: one argsort over (deg, id); hosts
@@ -405,13 +436,32 @@ class BigVPipeline:
 
         # pass 2: the single distributed forest
         t0 = time.perf_counter()
-        minp_sh = self._shard_table(np.full(n + 1, n, np.int32))
         total_rounds = 0
-        for batch in batches():
-            minp_sh, rounds = self.build_step(
-                minp_sh, pos_sh, order_sh,
-                self._put(self.batch_sharding, batch))
-            total_rounds += rounds
+        if state and from_phase >= 2:
+            minp_local = state.arrays["minp_local"]
+            minp_sh = self._put(self.shard, minp_local)
+        else:
+            if state and state.phase == "build":
+                minp_sh = self._put(self.shard,
+                                    state.arrays["minp_local"])
+                start = state.chunk_idx
+            else:
+                minp_sh = self._shard_table(np.full(n + 1, n, np.int32))
+                start = 0
+            nb = 0
+            for batch in batches(start):
+                minp_sh, rounds = self.build_step(
+                    minp_sh, pos_sh, order_sh,
+                    self._put(self.batch_sharding, batch))
+                total_rounds += rounds
+                nb += 1
+                maybe_fail("build", nb)
+                if checkpointer is not None and \
+                        checkpointer.due_span((nb - 1) * d, nb * d):
+                    checkpointer.save(
+                        "build", start + nb * d,
+                        {"deg_local": deg_local,
+                         "minp_local": self._local_block(minp_sh)}, meta)
         minp_host = self._allgather_table(
             self._local_block(minp_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
@@ -433,7 +483,15 @@ class BigVPipeline:
         t0 = time.perf_counter()
         cut = total = 0
         cv_chunks = []
-        for batch in batches():
+        start = 0
+        if state and state.phase == "score":
+            start = state.chunk_idx
+            cut = int(state.arrays["cut"])
+            total = int(state.arrays["total"])
+            if comm_volume:
+                cv_chunks.append(state.arrays["cv_keys"])
+        nb = 0
+        for batch in batches(start):
             c, tt = np.asarray(self.score_step(
                 self._put(self.batch_sharding, batch), assign_sh))
             cut += int(c)
@@ -441,6 +499,15 @@ class BigVPipeline:
             if comm_volume:
                 cv_chunks.append(
                     score_ops.cut_pair_keys_host(batch, assign_np, n, k))
+            nb += 1
+            maybe_fail("score", nb)
+            if checkpointer is not None and \
+                    checkpointer.due_span((nb - 1) * d, nb * d):
+                cv_chunks = ckpt.save_score_state(
+                    checkpointer, start + nb * d, cut, total, cv_chunks,
+                    {"deg_local": deg_local,
+                     "minp_local": self._local_block(minp_sh)}, meta,
+                    comm_volume)
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)
@@ -460,6 +527,8 @@ class BigVPipeline:
         balance = pure.part_balance(
             assign_host, k, deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        if checkpointer is not None:
+            checkpointer.clear()
 
         return {
             "assignment": assign_host, "parent": parent.astype(np.int64),

@@ -26,7 +26,7 @@ def graph():
     return EdgeStream.from_array(e, n_vertices=1 << 10)
 
 
-STREAMING_BACKENDS = [b for b in ("cpu", "tpu", "tpu-sharded")
+STREAMING_BACKENDS = [b for b in ("cpu", "tpu", "tpu-sharded", "tpu-bigv")
                       if b in list_backends()]
 
 

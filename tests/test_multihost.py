@@ -181,14 +181,18 @@ def test_bigv_multihost_matches_oracle(tmp_path, nprocs):
     _check(outs, ref, expect_parent)
 
 
-def test_multihost_fault_then_resume(tmp_path):
+@pytest.mark.parametrize("kind", ["sharded", "bigv"])
+def test_multihost_fault_then_resume(tmp_path, kind):
     """Kill both workers mid-build via fault injection, then resume; the
-    result must match the uninterrupted oracle exactly."""
+    result must match the uninterrupted oracle exactly. For bigv the
+    checkpoint state is each process's O(V/P) local block."""
     ckdir = str(tmp_path / "ck")
-    rcs, _, errs = _spawn(2, tmp_path, "fault", ckdir=ckdir, fault="build:2")
+    rcs, _, errs = _spawn(2, tmp_path, "fault", ckdir=ckdir, fault="build:2",
+                          kind=kind)
     assert rcs == [42, 42], errs
 
-    rcs, outs, errs = _spawn(2, tmp_path, "resume", ckdir=ckdir, resume="1")
+    rcs, outs, errs = _spawn(2, tmp_path, "resume", ckdir=ckdir, resume="1",
+                             kind=kind)
     assert rcs == [0, 0], errs
     ref, expect_parent = _oracle()
     _check(outs, ref, expect_parent)
