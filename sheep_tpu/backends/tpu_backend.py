@@ -54,7 +54,7 @@ class TpuBackend(Partitioner):
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
-                 alpha: float = 1.0, segment_rounds: int = 32):
+                 alpha: float = 1.0, segment_rounds: int = 4):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -132,12 +132,14 @@ class TpuBackend(Partitioner):
                 start = 0
             total_rounds = 0
             idx = start
+            pos_host_cache = np.asarray(pos[:n])  # host tail reuses it
             for padded in prefetch(pad_chunk(c, cs, n)
                                    for c in stream.chunks(cs, start_chunk=start)):
-                minp, rounds = elim_ops.build_chunk_step_segmented(
+                minp, rounds = elim_ops.build_chunk_step_adaptive(
                     minp, padded, pos, order, n,
                     lift_levels=self.lift_levels,
-                    segment_rounds=self.segment_rounds)
+                    segment_rounds=self.segment_rounds,
+                    pos_host=pos_host_cache)
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)
@@ -176,7 +178,9 @@ class TpuBackend(Partitioner):
             cut += int(c)
             total += int(tt)
             if comm_volume:
-                cv_chunks.append(score_ops.cut_pair_keys_host(padded, assign, n, k))
+                score_ops.accumulate_cv_keys(
+                    cv_chunks,
+                    score_ops.cut_pair_keys_host(padded, assign, n, k))
             idx += 1
             maybe_fail("score", idx - start)
             if checkpointer is not None and checkpointer.due(idx - start):

@@ -236,6 +236,187 @@ def fold_edges_segment(
     return lax.while_loop(cond, body, state)
 
 
+def _small_round_body(pos, order, n: int, jumps: int):
+    """Jump-mode round body for SMALL active buffers: identical
+    retire/displace semantics to :func:`_round_body`, but the climb is
+    ``jumps`` single parent steps via per-element gathers — O(C') work per
+    round with NO O(V) lifting-table rebuild. Used for the fixpoint tail,
+    where a handful of displacement-chain constraints would otherwise pay
+    the full-buffer, full-table cost every round."""
+
+    def body(state):
+        lo_, hi_, minp_, _, rounds = state
+        poshi = pos[hi_]
+        old_at_lo = minp_[lo_]
+        new_minp = minp_.at[lo_].min(poshi, mode="drop")
+        now = new_minp[lo_]
+
+        cur = lo_
+        for _ in range(jumps):
+            cand_pos = new_minp[cur]
+            cand = order[cand_pos]
+            cur = jnp.where(cand_pos < poshi, cand, cur)
+        became_loop = cur == hi_
+        climb_lo = jnp.where(became_loop, n, cur)
+        climb_hi = jnp.where(became_loop, n, hi_)
+
+        retire = poshi == now
+        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
+        out_lo = jnp.where(retire,
+                           jnp.where(displaced, order[now], n),
+                           climb_lo).astype(jnp.int32)
+        out_hi = jnp.where(retire,
+                           jnp.where(displaced, order[old_at_lo], n),
+                           climb_hi).astype(jnp.int32)
+        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
+        return out_lo, out_hi, new_minp, changed, rounds + 1
+
+    return body
+
+
+@partial(jax.jit, static_argnames=("n", "jumps", "segment_rounds"))
+def fold_edges_segment_small(
+    minp: jax.Array,
+    lo: jax.Array,
+    hi: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    jumps: int = 8,
+    segment_rounds: int = 64,
+):
+    """Bounded segment of jump-mode rounds (see _small_round_body)."""
+    body = _small_round_body(pos, order, n, jumps)
+
+    def cond(state):
+        _, _, _, changed, rounds = state
+        return changed & (rounds < segment_rounds)
+
+    return lax.while_loop(cond, body, _init_state(minp, lo, hi))
+
+
+@partial(jax.jit, static_argnames=("n", "size"))
+def compact_actives(lo: jax.Array, hi: jax.Array, n: int, size: int):
+    """Pack the live constraints into a (size,) buffer, padding with the
+    inert sentinel (n, n). Valid only when the live count <= size (the
+    caller checks); slot identity is meaningless — only the multiset of
+    active constraints matters to the fixpoint, so compaction is exact."""
+    c = lo.shape[0]
+    # fill slots index an appended sentinel row, so padding is inert
+    sel = jnp.nonzero(lo != n, size=size, fill_value=c)[0]
+    lo_ext = jnp.concatenate([lo, jnp.full(1, n, lo.dtype)])
+    hi_ext = jnp.concatenate([hi, jnp.full(1, n, hi.dtype)])
+    return lo_ext[sel], hi_ext[sel]
+
+
+def count_live(lo: jax.Array, n: int) -> int:
+    return int(jnp.sum(lo != n))
+
+
+def _host_tail_finish(minp, lo, hi, pos, order, n: int, size: int,
+                      pos_host=None):
+    """Finish the fixpoint on HOST via the native core's Liu pass.
+
+    The fixpoint tail is a displacement cascade — inherently sequential
+    pointer-chasing that a vector machine resolves one link per round
+    (measured: 6.8k tail rounds at RMAT-20 streamed in 4 chunks). The
+    native C++ insertion resolves the whole cascade in O(total chain
+    length) on host, so once the live count is small we pull the O(V)
+    table + the compacted live constraints, extend the forest there, and
+    push the table back. Same unique forest (cross-backend bit-identity
+    is an existing test invariant)."""
+    import numpy as np
+
+    from sheep_tpu.core import native
+
+    clo, chi = compact_actives(lo, hi, n, size)
+    lo_np = np.asarray(clo)
+    hi_np = np.asarray(chi)
+    mask = lo_np != n
+    edges = np.stack([lo_np[mask], hi_np[mask]], axis=1)
+    if pos_host is None:
+        pos_host = np.asarray(pos[:n])
+    parent = minp_to_parent(minp, order, n)
+    parent = native.build_elim_tree(edges, pos_host, parent)
+    return parent_to_minp(parent, pos_host, n)
+
+
+def fold_edges_adaptive(
+    minp: jax.Array,
+    lo: jax.Array,
+    hi: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 4,
+    descent: str = "auto",
+    max_rounds: int = 1 << 20,
+    small_size: int = 1 << 14,
+    small_jumps: int = 16,
+    host_tail: bool = True,
+    host_tail_threshold: int = 0,
+    pos_host=None,
+):
+    """Host-driven fixpoint with active-set compaction and a host-finished
+    tail — same unique forest as :func:`fold_edges`, far less work.
+
+    Measured motivation (RMAT-18, cpu-jax): 106 of 122 rounds had < 4k
+    live constraints out of a 4.2M buffer, so >85% of build time was
+    climbing dead slots and rebuilding lifting tables for them; at
+    RMAT-20 the tail cascade alone was 6.8k rounds. Schedule:
+
+    - full mode: lifting-table segments on the current buffer
+    - after each segment, if live count <= size/4, compact the buffer to
+      max(small_size, 2*live) rounded up to a power of two (each size is
+      one extra compiled program; sizes shrink geometrically, so at most
+      ~log16(C) programs exist)
+    - once live <= ``host_tail_threshold`` and the native core is
+      available, finish on host (:func:`_host_tail_finish`): the
+      displacement cascade is sequential work the CPU does in O(chain),
+      for one O(V) table round-trip per chunk
+    - fallback (no native core): jump-mode rounds at ``small_size`` —
+      O(C') gathers per round, independent of V
+    """
+    from sheep_tpu.core import native
+
+    use_host_tail = host_tail and native.available()
+    total = 0
+    size = int(lo.shape[0])
+    if host_tail_threshold <= 0:
+        # auto: hand off once <= size/8 constraints remain (min 2^16) —
+        # the cpu-jax sweet spot; on a real chip device rounds are far
+        # cheaper relative to the host pass, so callers may lower it
+        host_tail_threshold = max(1 << 16, size // 8)
+    while True:
+        if size > small_size:
+            seg = min(segment_rounds, max_rounds - total)
+            lo, hi, minp, changed, r = fold_edges_segment(
+                minp, lo, hi, pos, order, n, lift_levels=lift_levels,
+                segment_rounds=seg, descent=descent)
+        else:
+            seg = min(max(segment_rounds, 64), max_rounds - total)
+            lo, hi, minp, changed, r = fold_edges_segment_small(
+                minp, lo, hi, pos, order, n, jumps=small_jumps,
+                segment_rounds=seg)
+        total += int(r)
+        if not bool(changed) or total >= max_rounds:
+            return minp, total
+        live = count_live(lo, n)
+        if use_host_tail and live <= host_tail_threshold:
+            # fixed compact size -> one compiled compaction per input size
+            return (_host_tail_finish(minp, lo, hi, pos, order, n,
+                                      min(host_tail_threshold, size),
+                                      pos_host=pos_host),
+                    total)
+        if size > small_size and live <= size // 4:
+            new_size = max(small_size, 1 << max(1, (2 * live - 1)
+                                                .bit_length()))
+            if new_size < size:
+                lo, hi = compact_actives(lo, hi, n, new_size)
+                size = new_size
+
+
 def fold_edges_segmented(
     minp: jax.Array,
     lo: jax.Array,
@@ -336,6 +517,28 @@ def build_chunk_step_segmented(
     return fold_edges_segmented(parent_pos, clo, chi, pos, order, n,
                                 lift_levels=lift_levels,
                                 segment_rounds=segment_rounds)
+
+
+def build_chunk_step_adaptive(
+    parent_pos: jax.Array,
+    chunk: jax.Array,
+    pos: jax.Array,
+    order: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 4,
+    pos_host=None,
+):
+    """:func:`build_chunk_step` via :func:`fold_edges_adaptive`
+    (compaction + host-finished tail) — the single-device streaming
+    path's production fold: same unique forest, bounded device
+    executions, and the sequential displacement cascade runs on host
+    instead of one link per device round."""
+    clo, chi = orient_edges(chunk, pos, n)
+    return fold_edges_adaptive(parent_pos, clo, chi, pos, order, n,
+                               lift_levels=lift_levels,
+                               segment_rounds=segment_rounds,
+                               pos_host=pos_host)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels"))

@@ -497,7 +497,8 @@ class BigVPipeline:
             cut += int(c)
             total += int(tt)
             if comm_volume:
-                cv_chunks.append(
+                score_ops.accumulate_cv_keys(
+                    cv_chunks,
                     score_ops.cut_pair_keys_host(batch, assign_np, n, k))
             nb += 1
             maybe_fail("score", nb)

@@ -529,7 +529,9 @@ class ShardedPipeline:
             cut += int(c)
             total += int(tt)
             if comm_volume:
-                cv_chunks.append(score_ops.cut_pair_keys_host(batch, assign, n, k))
+                score_ops.accumulate_cv_keys(
+                    cv_chunks,
+                    score_ops.cut_pair_keys_host(batch, assign, n, k))
             batches += 1
             maybe_fail("score", batches)
             if checkpointer is not None and \

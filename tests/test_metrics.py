@@ -50,3 +50,22 @@ def test_cli_metrics_out(tmp_path):
     recs = [json.loads(l) for l in open(mpath)]
     events = {r["event"] for r in recs}
     assert {"run", "phase", "scores", "part_loads"} <= events
+
+
+def test_accumulate_cv_keys_compacts_past_cap(monkeypatch):
+    """cv-key host memory must stay bounded: past the cap the pending
+    chunks are compacted (sort+unique) in place (VERDICT r1 weak #5)."""
+    import numpy as np
+
+    from sheep_tpu.ops import score as score_ops
+
+    monkeypatch.setattr(score_ops, "CV_COMPACT_ENTRIES", 10)
+    acc = []
+    for i in range(8):
+        score_ops.accumulate_cv_keys(
+            acc, np.array([1, 2, 3, i], dtype=np.int64))
+    assert sum(len(c) for c in acc) <= 10 + 4, \
+        "accumulator grew past cap + one chunk"
+    from sheep_tpu.utils.checkpoint import compact_cv_keys
+
+    assert set(compact_cv_keys(acc)) == {1, 2, 3, 0, 4, 5, 6, 7}

@@ -97,6 +97,44 @@ def test_segmented_fixpoint_bit_identical(graph, segment_rounds):
     assert rounds_seg == int(rounds_mono)
 
 
+def test_adaptive_fixpoint_matches_monolithic(graph):
+    """Compaction + jump-mode tail must produce the identical forest (the
+    elimination forest is unique given the order; compaction preserves the
+    active multiset and jump-mode rounds are closure-preserving rewrites).
+    small_size=8 forces the compaction path and jump-mode tail even on
+    tiny graphs; streaming in two chunks also exercises a non-empty
+    carried table."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    padded = pad_chunk(e, len(e), n)
+    whole, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), padded, pos, order, n)
+    clo, chi = elim_ops.orient_edges(jnp.asarray(padded), pos, n)
+    got, _ = elim_ops.fold_edges_adaptive(
+        jnp.full(n + 1, n, dtype=jnp.int32), clo, chi, pos, order, n,
+        segment_rounds=4, small_size=8, small_jumps=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(whole))
+
+    half = len(e) // 2
+    minp = jnp.full(n + 1, n, dtype=jnp.int32)
+    for part in (e[:half], e[half:]):
+        c = pad_chunk(part, max(half, len(e) - half), n)
+        clo, chi = elim_ops.orient_edges(jnp.asarray(c), pos, n)
+        minp, _ = elim_ops.fold_edges_adaptive(
+            minp, clo, chi, pos, order, n,
+            segment_rounds=4, small_size=8, small_jumps=2)
+    np.testing.assert_array_equal(np.asarray(minp), np.asarray(whole))
+
+
+def test_compact_actives_preserves_multiset():
+    lo = jnp.asarray(np.array([5, 3, 5, 3, 1, 5], np.int32))
+    hi = jnp.asarray(np.array([2, 4, 2, 4, 0, 2], np.int32))
+    n = 5  # treat vertex id 5 as the sentinel
+    clo, chi = elim_ops.compact_actives(lo, hi, n, 4)
+    pairs = sorted(zip(np.asarray(clo).tolist(), np.asarray(chi).tolist()))
+    assert pairs == [(1, 0), (3, 4), (3, 4), (5, 5)]
+
+
 def test_streaming_chunks_match_batch(graph):
     e, n = graph
     pos, order = _device_order(e, n)
