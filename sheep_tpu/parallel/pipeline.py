@@ -185,33 +185,69 @@ class ShardedPipeline:
                 out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
                     batch, pos)
 
-        @partial(jax.jit,
-                 in_shardings=(self.state_sharding, self.state_sharding,
-                               self.state_sharding, self.repl_sharding,
-                               self.repl_sharding),
-                 out_shardings=(self.state_sharding, self.state_sharding,
-                                self.state_sharding, self.repl_sharding))
-        def fold_seg_step(forest_all, lo_all, hi_all, pos, order):
-            """At most ``segment_rounds`` fixpoint rounds per device in ONE
-            execution; returns the carried state plus a replicated
-            any-device-still-changing flag (pmax) so the host loop stays in
-            lockstep across devices and processes."""
-            def f(forest_local, lo_local, hi_local, pos_, order_):
-                lo2, hi2, minp, changed, _ = elim_ops.fold_edges_segment(
-                    forest_local[0], lo_local[0], hi_local[0], pos_, order_,
-                    n_, lift_levels=lift, segment_rounds=seg_)
-                any_changed = lax.pmax(changed.astype(jnp.int32), SHARD_AXIS)
-                return minp[None], lo2[None], hi2[None], any_changed
-            return shard_map(
-                f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                          P(SHARD_AXIS, None), P(), P()),
-                out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                           P(SHARD_AXIS, None), P()))(
-                    forest_all, lo_all, hi_all, pos, order)
+        def _make_fold_seg(small: bool):
+            """Segment step over whatever active-buffer width the inputs
+            have (one compiled program per width). ``small`` selects
+            jump-mode rounds (no O(V) lifting-table rebuild) for the
+            compacted tail. Returns carried state + pmax'd
+            any-device-changed flag and max live count, replicated, so
+            every device AND process makes the same host decision."""
+            @partial(jax.jit,
+                     in_shardings=(self.state_sharding, self.state_sharding,
+                                   self.state_sharding, self.repl_sharding,
+                                   self.repl_sharding),
+                     out_shardings=(self.state_sharding, self.state_sharding,
+                                    self.state_sharding, self.repl_sharding,
+                                    self.repl_sharding))
+            def fold_seg_step(forest_all, lo_all, hi_all, pos, order):
+                def f(forest_local, lo_local, hi_local, pos_, order_):
+                    if small:
+                        lo2, hi2, minp, changed, _ = \
+                            elim_ops.fold_edges_segment_small(
+                                forest_local[0], lo_local[0], hi_local[0],
+                                pos_, order_, n_,
+                                segment_rounds=max(seg_, 64))
+                    else:
+                        lo2, hi2, minp, changed, _ = \
+                            elim_ops.fold_edges_segment(
+                                forest_local[0], lo_local[0], hi_local[0],
+                                pos_, order_, n_, lift_levels=lift,
+                                segment_rounds=seg_)
+                    any_changed = lax.pmax(changed.astype(jnp.int32),
+                                           SHARD_AXIS)
+                    max_live = lax.pmax(jnp.sum(lo2 != n_), SHARD_AXIS)
+                    return (minp[None], lo2[None], hi2[None], any_changed,
+                            max_live)
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                              P(SHARD_AXIS, None), P(), P()),
+                    out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
+                               P(SHARD_AXIS, None), P(), P()))(
+                        forest_all, lo_all, hi_all, pos, order)
+            return fold_seg_step
+
+        def _make_compact(to_size: int):
+            @partial(jax.jit,
+                     in_shardings=(self.state_sharding, self.state_sharding),
+                     out_shardings=(self.state_sharding, self.state_sharding))
+            def compact_step(lo_all, hi_all):
+                def f(lo_local, hi_local):
+                    lo2, hi2 = elim_ops.compact_actives(
+                        lo_local[0], hi_local[0], n_, to_size)
+                    return lo2[None], hi2[None]
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
+                    out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
+                        lo_all, hi_all)
+            return compact_step
 
         self.orient_step = orient_step
-        self.fold_seg_step = fold_seg_step
+        self._fold_full = _make_fold_seg(False)
+        self._fold_small = _make_fold_seg(True)
+        self._make_compact = _make_compact
+        self._compact_cache: dict = {}
 
         d_ = self.n_devices
         r_ = self.rounds
@@ -302,16 +338,39 @@ class ShardedPipeline:
         self.merge_all = merge_all
         self.score_step = score_step
 
+    SMALL_SIZE = 1 << 14
+
     def build_step(self, forest_all, batch_dev, pos, order):
         """Fold one sharded batch into the per-device forests via
-        host-bounded segments (same fixpoint as the monolithic while_loop,
-        bit-identical results — see ops/elim.py fold_edges_segment)."""
+        host-bounded segments with the adaptive schedule (same unique
+        forests as the monolithic while_loop): compact every device's
+        active buffer to the same smaller power-of-2 width when the pmax
+        live count collapses, and run the compacted tail in jump-mode
+        (O(C') per round, no O(V) lifting-table rebuild). The pmax'd
+        flags keep all devices and processes in lockstep; a host tail is
+        not used here because the forests are per-device (pulling D of
+        them would cost O(V*D) transfers) — the jump-mode tail is the
+        sharded equivalent."""
         lo_all, hi_all = self.orient_step(batch_dev, pos)
+        size = self.cs
         while True:
-            forest_all, lo_all, hi_all, changed = self.fold_seg_step(
+            step = self._fold_small if size <= self.SMALL_SIZE \
+                else self._fold_full
+            forest_all, lo_all, hi_all, changed, max_live = step(
                 forest_all, lo_all, hi_all, pos, order)
             if not int(changed):
                 return forest_all
+            live = int(max_live)
+            if size > self.SMALL_SIZE and live <= size // 4:
+                new_size = max(self.SMALL_SIZE,
+                               1 << max(1, (2 * live - 1).bit_length()))
+                if new_size < size:
+                    fn = self._compact_cache.get(new_size)
+                    if fn is None:
+                        fn = self._compact_cache[new_size] = \
+                            self._make_compact(new_size)
+                    lo_all, hi_all = fn(lo_all, hi_all)
+                    size = new_size
 
     # -- host->device placement (multi-host aware) -------------------------
     def _put(self, sharding, arr: np.ndarray):
