@@ -252,63 +252,69 @@ class ShardedPipeline:
         d_ = self.n_devices
         r_ = self.rounds
 
-        def _butterfly(forest_local, pos_, order_, cap0):
-            """Butterfly allreduce body, combiner = forest merge.
+        def _make_exchange(cap0: int, r: int):
+            """One butterfly exchange round, as its own jitted step: each
+            device ships its forest to its XOR partner and receives the
+            partner's as an ACTIVE CONSTRAINT buffer (lo, hi) for the
+            host-driven adaptive fold — a minp entry x -> p IS the
+            constraint "x ~ order[p] from time p".
 
             ``cap0`` = per-round payload capacity (entries); 0 means dense
-            (ship the whole O(V) minp table each round). Compact rounds
-            ship (index, value) pairs of the non-sentinel entries only —
+            (ship the whole O(V) minp table). Compact rounds ship
+            (index, value) pairs of the non-sentinel entries only —
             SURVEY.md §7 hard part #4's O(boundary) traffic. Capacity
             doubles per round: a merged forest has at most
-            count_A + count_B parent entries (its tree edges are a forest
-            over the union of the two trees' edge sets, and a forest of m
-            constraints has <= m edges), so cap0 >= the initial max
-            occupancy makes cap0 * 2^r sufficient for round r — checked on
-            host before selecting this path. Once 2 * cap is no smaller
-            than the table itself, rounds fall back to dense shipping.
-            """
-            forest = forest_local[0]
-            idx = lax.axis_index(SHARD_AXIS)
-            for r in range(r_):
-                perm = [(i, i ^ (1 << r)) for i in range(d_)]
-                perm = [(s, t) for s, t in perm if t < d_]
-                valid = (idx ^ (1 << r)) < d_
-                cap = min(cap0 << r, n_ + 1) if cap0 else n_ + 1
-                if 2 * cap < n_ + 1:
-                    sel = jnp.nonzero(forest[:n_] != n_, size=cap,
-                                      fill_value=n_)[0].astype(jnp.int32)
-                    # fill slots index the sentinel: forest[n] == n, and
-                    # pos/order fix n, so they are inert on both ends
-                    payload = jnp.stack([sel, forest[sel]])
-                    recv = lax.ppermute(payload, SHARD_AXIS, perm)
-                    # out-of-range XOR partners receive zeros; neutralize
-                    # to the inert (n, n) pair, same as the dense path
-                    recv = jnp.where(valid, recv, jnp.int32(n_))
-                    other = jnp.full(n_ + 1, n_, jnp.int32).at[recv[0]].min(
-                        recv[1], mode="drop")
-                else:
-                    other = lax.ppermute(forest, SHARD_AXIS, perm)
-                    other = jnp.where(valid, other, jnp.int32(n_))
-                forest = elim_ops.merge_forests(
-                    forest, other, pos_, order_, n_, lift_levels=lift)
-            return forest[None]
+            count_A + count_B parent entries, so cap0 >= the initial max
+            occupancy makes cap0 * 2^r sufficient for round r — checked
+            on host before selecting this path. Once 2 * cap is no
+            smaller than the table itself, the round ships dense."""
+            perm = [(i, i ^ (1 << r)) for i in range(d_)
+                    if (i ^ (1 << r)) < d_]
+            cap = min(cap0 << r, n_ + 1) if cap0 else n_ + 1
+            compact = 2 * cap < n_ + 1
 
-        def _make_merge(cap0):
             @partial(jax.jit,
-                     in_shardings=(self.state_sharding, self.repl_sharding,
-                                   self.repl_sharding),
-                     out_shardings=self.repl_sharding)
-            def merge_fn(forest_all, pos, order):
-                merged = shard_map(
-                    partial(_butterfly, cap0=cap0), mesh=mesh,
-                    in_specs=(P(SHARD_AXIS, None), P(), P()),
-                    out_specs=P(SHARD_AXIS, None))(forest_all, pos, order)
-                return merged[0]
-            return merge_fn
+                     in_shardings=(self.state_sharding, self.repl_sharding),
+                     out_shardings=(self.state_sharding, self.state_sharding))
+            def exchange(forest_all, order):
+                def f(forest_local, order_):
+                    forest = forest_local[0]
+                    idx = lax.axis_index(SHARD_AXIS)
+                    valid = (idx ^ (1 << r)) < d_
+                    if compact:
+                        sel = jnp.nonzero(forest[:n_] != n_, size=cap,
+                                          fill_value=n_)[0].astype(jnp.int32)
+                        # fill slots index the sentinel: forest[n] == n
+                        payload = jnp.stack([sel, forest[sel]])
+                        recv = lax.ppermute(payload, SHARD_AXIS, perm)
+                        # out-of-range XOR partners receive zeros;
+                        # neutralize to the inert (n, n) pair
+                        recv = jnp.where(valid, recv, jnp.int32(n_))
+                        lo, val = recv[0], recv[1]
+                        bad = (lo >= n_) | (val >= n_)
+                        lo = jnp.where(bad, n_, lo)
+                        hi = jnp.where(bad, n_,
+                                       order_[jnp.clip(val, 0, n_)])
+                    else:
+                        other = lax.ppermute(forest, SHARD_AXIS, perm)
+                        other = jnp.where(valid, other, jnp.int32(n_))
+                        lo, hi = elim_ops.tree_edges_from_parent(
+                            other, order_, n_)
+                    return lo[None], hi[None].astype(jnp.int32)
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None), P()),
+                    out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
+                        forest_all, order)
+            return exchange
 
-        merge_all = _make_merge(0)  # dense variant (also the d=1 no-op)
-        self._merge_cache = {0: merge_all}
-        self._make_merge = _make_merge
+        @partial(jax.jit, out_shardings=self.repl_sharding)
+        def extract_merged(forest_all):
+            return forest_all[0]
+
+        self._make_exchange = _make_exchange
+        self._exchange_cache: dict = {}
+        self._extract_merged = extract_merged
 
         @partial(jax.jit, out_shardings=self.repl_sharding)
         def max_occupancy(forest_all):
@@ -335,24 +341,21 @@ class ShardedPipeline:
         self.deg_step = deg_step
         self.deg_reduce = deg_reduce
         self.make_order = make_order
-        self.merge_all = merge_all
         self.score_step = score_step
 
     SMALL_SIZE = 1 << 14
 
-    def build_step(self, forest_all, batch_dev, pos, order):
-        """Fold one sharded batch into the per-device forests via
-        host-bounded segments with the adaptive schedule (same unique
-        forests as the monolithic while_loop): compact every device's
-        active buffer to the same smaller power-of-2 width when the pmax
-        live count collapses, and run the compacted tail in jump-mode
-        (O(C') per round, no O(V) lifting-table rebuild). The pmax'd
-        flags keep all devices and processes in lockstep; a host tail is
-        not used here because the forests are per-device (pulling D of
-        them would cost O(V*D) transfers) — the jump-mode tail is the
-        sharded equivalent."""
-        lo_all, hi_all = self.orient_step(batch_dev, pos)
-        size = self.cs
+    def _fold_actives(self, forest_all, lo_all, hi_all, pos, order):
+        """Adaptive host-driven fold of (D, W) active-constraint buffers
+        into the per-device forests (same unique forests as a monolithic
+        while_loop): compact every device's buffer to the same smaller
+        power-of-2 width when the pmax live count collapses, and run the
+        compacted tail in jump-mode (O(C') per round, no O(V)
+        lifting-table rebuild). The pmax'd flags keep all devices and
+        processes in lockstep; a host tail is not used here because the
+        forests are per-device (pulling D of them would cost O(V*D)
+        transfers) — the jump-mode tail is the sharded equivalent."""
+        size = int(lo_all.shape[-1])
         while True:
             step = self._fold_small if size <= self.SMALL_SIZE \
                 else self._fold_full
@@ -372,6 +375,11 @@ class ShardedPipeline:
                     lo_all, hi_all = fn(lo_all, hi_all)
                     size = new_size
 
+    def build_step(self, forest_all, batch_dev, pos, order):
+        """Fold one sharded batch into the per-device forests."""
+        lo_all, hi_all = self.orient_step(batch_dev, pos)
+        return self._fold_actives(forest_all, lo_all, hi_all, pos, order)
+
     # -- host->device placement (multi-host aware) -------------------------
     def _put(self, sharding, arr: np.ndarray):
         """Single process: plain device_put. Multi-host: every process
@@ -385,11 +393,19 @@ class ShardedPipeline:
     def merge(self, forest_all, pos, order, stats: Optional[dict] = None):
         """Merge the per-device forests into the global tree.
 
+        Host-driven butterfly: log2(D) rounds, each one jitted exchange
+        step (ppermute of the forest — compact boundary pairs or the
+        dense table) followed by the shared adaptive fold of the received
+        constraints. No unbounded device execution anywhere (the old
+        all-in-one-program butterfly ran log2(D) full fixpoints in a
+        single call — exactly the long-execution shape that crashes TPU
+        worker watchdogs).
+
         Picks compact (boundary-only pairs) vs dense (full table) shipping
         from one tiny occupancy all-reduce: sparse shards move O(boundary)
         bytes over ICI instead of O(V) per round (SURVEY.md §7 hard part
-        #4). Compiled variants are cached per power-of-2 capacity, so at
-        most log2(V) programs exist across a whole run. ``stats`` (if
+        #4). Exchange programs are cached per (capacity, round), so at
+        most log2(V) * log2(D) exist across a whole run. ``stats`` (if
         given) accumulates the payload byte count actually shipped.
         """
         cap0 = 0
@@ -398,10 +414,15 @@ class ShardedPipeline:
             c = max(1024, 1 << max(0, int(cnt - 1).bit_length()))
             if 2 * c < self.n + 1:
                 cap0 = c
-        fn = self._merge_cache.get(cap0)
-        if fn is None:
-            fn = self._merge_cache[cap0] = self._make_merge(cap0)
-        merged = fn(forest_all, pos, order)
+        for r in range(self.rounds):
+            fn = self._exchange_cache.get((cap0, r))
+            if fn is None:
+                fn = self._exchange_cache[(cap0, r)] = \
+                    self._make_exchange(cap0, r)
+            lo_all, hi_all = fn(forest_all, order)
+            forest_all = self._fold_actives(forest_all, lo_all, hi_all,
+                                            pos, order)
+        merged = self._extract_merged(forest_all)
         if stats is not None:
             total = 0
             for r in range(self.rounds):
