@@ -200,7 +200,7 @@ class BigVPipeline:
         @partial(jax.jit,
                  in_shardings=(self.shard, self.shard, act, act, act),
                  out_shardings=(self.shard, act, act, act, self.repl,
-                                self.repl))
+                                self.repl, self.repl))
         def fold_seg_step(minp_sh, order_sh, lo_all, polo_all, poshi_all):
             """At most ``segment_rounds`` routed fixpoint rounds in one
             device execution; the psum'd live count is the collective
@@ -258,8 +258,9 @@ class BigVPipeline:
                          (live0 * 0).astype(jnp.int32))
                 lo_f, polo_f, poshi_f, minp_f, live_f, rounds = \
                     lax.while_loop(cond, body, state)
+                max_live = lax.pmax(jnp.sum(lo_f != n_), SHARD_AXIS)
                 return (minp_f, lo_f[None], polo_f[None], poshi_f[None],
-                        live_f, lax.pmax(rounds, SHARD_AXIS))
+                        live_f, lax.pmax(rounds, SHARD_AXIS), max_live)
 
             return shard_map(
                 f, mesh=mesh,
@@ -268,8 +269,36 @@ class BigVPipeline:
                           P(SHARD_AXIS, None)),
                 out_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None),
                            P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                           P(), P()))(
+                           P(), P(), P()))(
                     minp_sh, order_sh, lo_all, polo_all, poshi_all)
+
+        def _make_compact(to_size: int):
+            """Pack each device's live (lo, polo, poshi) actives into a
+            (D, to_size) buffer (valid when every device's live count <=
+            to_size — the caller checks the pmax). Shrinking Q directly
+            shrinks every routed collective: all_gather/all_to_all ship
+            D * Q words per round."""
+            act = NamedSharding(mesh, P(SHARD_AXIS, None))
+
+            @partial(jax.jit,
+                     in_shardings=(act, act, act),
+                     out_shardings=(act, act, act))
+            def compact_step(lo_all, polo_all, poshi_all):
+                def f(lo_l, polo_l, poshi_l):
+                    lo0 = lo_l[0]
+                    c = lo0.shape[0]
+                    sel = jnp.nonzero(lo0 != n_, size=to_size,
+                                      fill_value=c)[0]
+                    ext = lambda a: jnp.concatenate(
+                        [a, jnp.full(1, n_, a.dtype)])[sel]
+                    return (ext(lo0)[None], ext(polo_l[0])[None],
+                            ext(poshi_l[0])[None])
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None),) * 3,
+                    out_specs=(P(SHARD_AXIS, None),) * 3)(
+                        lo_all, polo_all, poshi_all)
+            return compact_step
 
         # ---- scoring (block-sharded assignment, routed part lookups;
         # chunk stays sharded — no replicated O(V) state here either) ----
@@ -298,20 +327,39 @@ class BigVPipeline:
         self.fold_seg_step = fold_seg_step
         self.score_step = score_step
         self.max_rounds = max_rounds
+        self._make_compact = _make_compact
+        self._compact_cache: dict = {}
+
+    MIN_Q = 1 << 12
 
     def build_step(self, minp_sh, pos_sh, order_sh, batch_dev):
         """Fold one sharded batch into the distributed forest via
         host-bounded segments. Returns (minp_sh, total_rounds) — identical
         to running the whole fixpoint in one execution, but no single
-        device call exceeds ``segment_rounds`` rounds."""
+        device call exceeds ``segment_rounds`` rounds, and the active
+        buffers compact to the pmax live width as the set collapses (every
+        routed collective ships D*Q words, so smaller Q = proportionally
+        less ICI/DCN traffic per tail round)."""
         lo_a, polo_a, poshi_a = self.orient_step(pos_sh, batch_dev)
+        size = int(lo_a.shape[-1])
         total = 0
         while True:
-            minp_sh, lo_a, polo_a, poshi_a, live, r = self.fold_seg_step(
-                minp_sh, order_sh, lo_a, polo_a, poshi_a)
+            minp_sh, lo_a, polo_a, poshi_a, live, r, max_live = \
+                self.fold_seg_step(minp_sh, order_sh, lo_a, polo_a, poshi_a)
             total += int(r)
             if int(live) == 0 or total >= self.max_rounds:
                 return minp_sh, total
+            ml = int(max_live)
+            if size > self.MIN_Q and ml <= size // 4:
+                new_size = max(self.MIN_Q,
+                               1 << max(1, (2 * ml - 1).bit_length()))
+                if new_size < size:
+                    fn = self._compact_cache.get(new_size)
+                    if fn is None:
+                        fn = self._compact_cache[new_size] = \
+                            self._make_compact(new_size)
+                    lo_a, polo_a, poshi_a = fn(lo_a, polo_a, poshi_a)
+                    size = new_size
 
     # ---- host-side helpers ----------------------------------------------
     def _put(self, sharding, arr: np.ndarray):
