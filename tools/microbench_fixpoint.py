@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Fixpoint micro-roofline: measure the primitive ops that bound the
+build phase, on whatever platform initializes (real TPU or cpu-jax).
+
+The build fixpoint has no MXU work — it is bound by random int32
+gathers, scatter-min, and streaming bandwidth (BASELINE.md roofline
+note). This tool times each primitive at partition-realistic shapes and
+reports effective bytes/sec vs the HBM roofline (v5e ~ 820 GB/s), which
+is the data SURVEY.md §7 step 7 requires before deciding XLA-vs-Pallas
+for the inner loop: if XLA's gather sustains a healthy fraction of HBM
+bandwidth, a hand-written kernel has nothing to win (Pallas TPU has no
+vectorized arbitrary-index gather primitive to beat it with — the VPU
+is an 8x128 elementwise engine).
+
+Usage:
+    python tools/microbench_fixpoint.py [--scale 22] [--chunk-log 24]
+        [--profile-dir DIR] [--platform cpu]
+
+One JSON line per measurement on stdout; human summary on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def timeit(fn, *args, reps=5):
+    """Median wall seconds of fn(*args).block_until_ready() over reps."""
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) \
+        else fn(*args).block_until_ready()  # warm-up/compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        (out[0] if isinstance(out, tuple) else out).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=22, help="V = 2^scale")
+    ap.add_argument("--chunk-log", type=int, default=24, help="C = 2^this")
+    ap.add_argument("--profile-dir", default=None,
+                    help="also capture a jax.profiler trace of one "
+                         "full fixpoint round")
+    ap.add_argument("--platform", default=None,
+                    help="pin a platform (e.g. cpu) before jax init")
+    ap.add_argument("--hbm-gbps", type=float, default=820.0,
+                    help="roofline bandwidth for the ratio column")
+    args = ap.parse_args()
+
+    if args.platform:
+        from sheep_tpu.utils.platform import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    plat = jax.default_backend()
+    n = 1 << args.scale
+    c = 1 << args.chunk_log
+    log(f"platform={plat}  V=2^{args.scale}={n:,}  C=2^{args.chunk_log}={c:,}")
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    table = jax.random.randint(k1, (n + 1,), 0, n, dtype=jnp.int32)
+    idx_c = jax.random.randint(k2, (c,), 0, n, dtype=jnp.int32)
+    vals = jax.random.randint(k3, (c,), 0, n, dtype=jnp.int32)
+
+    def report(name, seconds, bytes_moved, extra=None):
+        gbps = bytes_moved / seconds / 1e9
+        line = {"bench": name, "seconds": round(seconds, 6),
+                "effective_GBps": round(gbps, 2),
+                "vs_hbm_roofline": round(gbps / args.hbm_gbps, 4),
+                "platform": plat}
+        if extra:
+            line.update(extra)
+        emit(**line)
+        log(f"{name:28s} {seconds * 1e3:9.2f} ms   {gbps:8.1f} GB/s "
+            f"({100 * gbps / args.hbm_gbps:5.1f}% of roofline)")
+
+    # 1. random gather, C indices into a V-table (the climb's dominant op)
+    g = jax.jit(lambda t, i: t[i])
+    s = timeit(g, table, idx_c)
+    # bytes: C index reads + C random table reads + C writes
+    report("gather_C_from_V", s, 4 * (3 * c))
+
+    # 2. table self-gather t[t] (lifting-table squaring, V-sized)
+    g2 = jax.jit(lambda t: t[t])
+    s = timeit(g2, table)
+    report("gather_V_from_V", s, 4 * (3 * (n + 1)))
+
+    # 3. scatter-min, C updates into a V-table
+    sm = jax.jit(lambda t, i, v: t.at[i].min(v, mode="drop"))
+    s = timeit(sm, table, idx_c, vals)
+    report("scatter_min_C_into_V", s, 4 * (2 * c + 2 * (n + 1)))
+
+    # 4. streaming copy baseline (pure-bandwidth reference point)
+    cp = jax.jit(lambda t: t + 1)
+    big = jnp.zeros(max(n + 1, c), jnp.int32)
+    s = timeit(cp, big)
+    report("stream_add_V", s, 4 * 2 * big.shape[0])
+
+    # 5. one full lifting fixpoint round at partition-realistic shapes
+    from sheep_tpu.ops import elim as elim_ops
+
+    pos = jnp.concatenate([jax.random.permutation(
+        k1, jnp.arange(n, dtype=jnp.int32)), jnp.full(1, n, jnp.int32)])
+    order = jnp.zeros(n + 1, jnp.int32).at[pos].set(
+        jnp.arange(n + 1, dtype=jnp.int32)).at[n].set(n)
+    minp = jnp.full(n + 1, n, dtype=jnp.int32)
+    lo = jnp.minimum(idx_c, vals)
+    hi = jnp.maximum(idx_c, vals)
+    lo = jnp.where(lo == hi, n, lo)
+    hi = jnp.where(lo == n, n, hi)
+
+    def one_round(minp_, lo_, hi_):
+        out = elim_ops.fold_edges_segment(minp_, lo_, hi_, pos, order, n,
+                                          segment_rounds=1)
+        return out[2]
+
+    s = timeit(jax.jit(one_round), minp, lo, hi)
+    levels = max(1, int(n).bit_length())
+    # bytes model from BASELINE.md: ~4*L*(V+C) gathered per round
+    report("full_fixpoint_round", s, 4 * levels * (n + 1 + c),
+           {"lift_levels": levels})
+
+    # 6. one jump-mode round at tail shapes (16k actives)
+    small = 1 << 14
+    s = timeit(jax.jit(lambda m, l, h: elim_ops.fold_edges_segment_small(
+        m, l, h, pos, order, n, segment_rounds=1)[2]),
+        minp, lo[:small], hi[:small])
+    report("jump_round_16k", s, 4 * 16 * 2 * small)
+
+    if args.profile_dir:
+        with jax.profiler.trace(args.profile_dir):
+            for _ in range(3):
+                one_round(minp, lo, hi).block_until_ready()
+        log(f"trace written to {args.profile_dir}")
+
+
+if __name__ == "__main__":
+    main()
