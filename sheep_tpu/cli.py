@@ -106,17 +106,29 @@ def main(argv=None) -> int:
     if backend is None:
         avail = list_backends()
         backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
-
-    ctor = {"alpha": args.alpha}
-    if args.chunk_edges:
-        ctor["chunk_edges"] = args.chunk_edges
-    try:
-        be = get_backend(backend, **ctor)
-    except TypeError:
-        be = get_backend(backend, **({"chunk_edges": args.chunk_edges} if args.chunk_edges else {}))
+        auto = True
+    else:
+        auto = False
 
     t0 = time.perf_counter()
     with EdgeStream.open(args.input, n_vertices=args.num_vertices) as es:
+        if auto and backend.startswith("tpu") and "tpu-bigv" in list_backends():
+            # replicated vertex tables past the single-chip ceiling need
+            # the vertex-sharded mode (BASELINE.md HBM budget; 16 GiB v5e)
+            from sheep_tpu.utils.membudget import max_vertices_for
+
+            cs = args.chunk_edges or (1 << 22)
+            if es.num_vertices > max_vertices_for(int(0.9 * (16 << 30)), cs):
+                backend = "tpu-bigv"
+
+        ctor = {"alpha": args.alpha}
+        if args.chunk_edges:
+            ctor["chunk_edges"] = args.chunk_edges
+        try:
+            be = get_backend(backend, **ctor)
+        except TypeError:
+            be = get_backend(backend, **({"chunk_edges": args.chunk_edges}
+                                         if args.chunk_edges else {}))
         ckpt_kw = {}
         if args.checkpoint_dir:
             from sheep_tpu.utils.checkpoint import Checkpointer
