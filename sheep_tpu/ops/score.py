@@ -67,15 +67,57 @@ def accumulate_cv_keys(cv_chunks: list, keys) -> list:
     return cv_chunks
 
 
+@partial(jax.jit, static_argnames=("n", "cap"))
+def cut_pair_rows_compact(edges: jax.Array, assign: jax.Array, n: int,
+                          cap: int):
+    """Device-side sorted-unique cut rows, compacted to (cap, 2).
+
+    Returns (rows, distinct_count): rows are the chunk's DISTINCT
+    (vertex, foreign_part) pairs padded with the sentinel (n, 0); the
+    compaction is valid only when distinct_count <= cap — past that the
+    caller falls back to the dense pull. Power-law chunks repeat the same
+    hub/part pairs constantly, so the device dedup shrinks the
+    host transfer from 2C rows to min(distinct, cap) rows."""
+    rows = cut_pairs(edges, assign, n)
+    v, p = rows[:, 0], rows[:, 1]
+    idx = jnp.lexsort((p, v))
+    v2, p2 = v[idx], p[idx]
+    first = jnp.concatenate([
+        jnp.ones(1, bool), (v2[1:] != v2[:-1]) | (p2[1:] != p2[:-1])])
+    keep = first & (v2 < n)
+    count = jnp.sum(keep, dtype=jnp.int32)
+    # fill slots index an appended sentinel row (same trick as
+    # elim.compact_actives), so padding is inert
+    sel = jnp.nonzero(keep, size=cap, fill_value=v2.shape[0])[0]
+    v3 = jnp.concatenate([v2, jnp.full(1, n, v2.dtype)])[sel]
+    p3 = jnp.concatenate([p2, jnp.zeros(1, p2.dtype)])[sel]
+    return jnp.stack([v3, p3], axis=1), count
+
+
+def _compact_cap(c_rows: int) -> int:
+    """Device-compaction capacity for a chunk producing c_rows rows."""
+    return min(c_rows, max(1 << 16, 1 << (max(c_rows >> 3, 1) - 1)
+                           .bit_length()))
+
+
 def cut_pair_keys_host(chunk, assign, n: int, k: int):
     """Run cut_pairs on a (C, 2) or (D, C, 2) chunk and return the encoded
     int64 keys (vertex * k + foreign_part) on host — the shared comm-volume
-    accumulation used by every backend."""
+    accumulation used by every backend. Pulls the device-deduped compact
+    rows when they fit the capacity, the dense row dump otherwise."""
     import numpy as np
 
     arr = np.asarray(chunk)
     rows_all = []
     for c in arr.reshape(-1, arr.shape[-2], 2) if arr.ndim == 3 else [arr]:
+        cap = _compact_cap(2 * c.shape[0])
+        if cap < 2 * c.shape[0]:
+            compact, count = cut_pair_rows_compact(c, assign, n, cap)
+            if int(count) <= cap:
+                rows = np.asarray(compact)
+                rows = rows[rows[:, 0] < n]
+                rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
+                continue
         rows = np.asarray(cut_pairs(c, assign, n))
         rows = rows[rows[:, 0] < n]
         rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])

@@ -135,6 +135,37 @@ def test_compact_actives_preserves_multiset():
     assert pairs == [(1, 0), (3, 4), (3, 4), (5, 5)]
 
 
+def test_cut_pair_compact_matches_dense(graph):
+    """Device-deduped cv rows must yield the same distinct key set as the
+    dense pull, and the tiny-cap overflow path must fall back cleanly."""
+    e, n = graph
+    k = 4
+    rng = np.random.default_rng(5)
+    assign = jnp.asarray(
+        np.concatenate([rng.integers(0, k, n), [0]]).astype(np.int32))
+    padded = jnp.asarray(pad_chunk(e, len(e), n))
+    dense = np.asarray(score_ops.cut_pairs(padded, assign, n))
+    dense = dense[dense[:, 0] < n]
+    expect = np.unique(dense[:, 0].astype(np.int64) * k + dense[:, 1])
+
+    compact, count = score_ops.cut_pair_rows_compact(padded, assign, n,
+                                                     cap=2 * len(e))
+    rows = np.asarray(compact)
+    rows = rows[rows[:, 0] < n]
+    got = rows[:, 0].astype(np.int64) * k + rows[:, 1]
+    assert int(count) == len(expect)
+    np.testing.assert_array_equal(np.sort(got), expect)
+
+    # overflow: cap smaller than the distinct count -> count says so
+    if len(expect) > 2:
+        _, count2 = score_ops.cut_pair_rows_compact(padded, assign, n,
+                                                    cap=2)
+        assert int(count2) == len(expect) > 2
+
+    keys = score_ops.cut_pair_keys_host(np.asarray(padded), assign, n, k)
+    np.testing.assert_array_equal(np.unique(keys), expect)
+
+
 def test_streaming_chunks_match_batch(graph):
     e, n = graph
     pos, order = _device_order(e, n)
