@@ -120,6 +120,7 @@ class TpuBackend(Partitioner):
         t["sort"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        build_stats: dict = {}
         if state and from_phase >= 2:
             minp = jnp.asarray(state.arrays["minp"])
             total_rounds = 0
@@ -139,7 +140,7 @@ class TpuBackend(Partitioner):
                     minp, padded, pos, order, n,
                     lift_levels=self.lift_levels,
                     segment_rounds=self.segment_rounds,
-                    pos_host=pos_host_cache)
+                    pos_host=pos_host_cache, stats=build_stats)
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)
@@ -201,5 +202,6 @@ class TpuBackend(Partitioner):
             assignment=assign_host, k=k, edge_cut=cut, total_edges=total,
             cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
             phase_times=t, backend=self.name,
-            diagnostics={"fixpoint_rounds": float(total_rounds)},
+            diagnostics={"fixpoint_rounds": float(total_rounds),
+                         **{k: float(v) for k, v in build_stats.items()}},
         )

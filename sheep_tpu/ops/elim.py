@@ -357,6 +357,7 @@ def fold_edges_adaptive(
     host_tail: bool = True,
     host_tail_threshold: int = 0,
     pos_host=None,
+    stats=None,
 ):
     """Host-driven fixpoint with active-set compaction and a host-finished
     tail — same unique forest as :func:`fold_edges`, far less work.
@@ -381,6 +382,8 @@ def fold_edges_adaptive(
     from sheep_tpu.core import native
 
     use_host_tail = host_tail and native.available()
+    if stats is None:
+        stats = {}
     total = 0
     size = int(lo.shape[0])
     if host_tail_threshold <= 0:
@@ -394,17 +397,22 @@ def fold_edges_adaptive(
             lo, hi, minp, changed, r = fold_edges_segment(
                 minp, lo, hi, pos, order, n, lift_levels=lift_levels,
                 segment_rounds=seg, descent=descent)
+            stats["full_segments"] = stats.get("full_segments", 0) + 1
         else:
             seg = min(max(segment_rounds, 64), max_rounds - total)
             lo, hi, minp, changed, r = fold_edges_segment_small(
                 minp, lo, hi, pos, order, n, jumps=small_jumps,
                 segment_rounds=seg)
+            stats["small_segments"] = stats.get("small_segments", 0) + 1
         total += int(r)
+        stats["device_rounds"] = stats.get("device_rounds", 0) + int(r)
         if not bool(changed) or total >= max_rounds:
             return minp, total
         live = count_live(lo, n)
         if use_host_tail and live <= host_tail_threshold:
             # fixed compact size -> one compiled compaction per input size
+            stats["host_tails"] = stats.get("host_tails", 0) + 1
+            stats["host_tail_live"] = stats.get("host_tail_live", 0) + live
             return (_host_tail_finish(minp, lo, hi, pos, order, n,
                                       min(host_tail_threshold, size),
                                       pos_host=pos_host),
@@ -415,6 +423,7 @@ def fold_edges_adaptive(
             if new_size < size:
                 lo, hi = compact_actives(lo, hi, n, new_size)
                 size = new_size
+                stats["compactions"] = stats.get("compactions", 0) + 1
 
 
 def fold_edges_segmented(
@@ -528,6 +537,7 @@ def build_chunk_step_adaptive(
     lift_levels: int = 0,
     segment_rounds: int = 4,
     pos_host=None,
+    stats=None,
 ):
     """:func:`build_chunk_step` via :func:`fold_edges_adaptive`
     (compaction + host-finished tail) — the single-device streaming
@@ -538,7 +548,7 @@ def build_chunk_step_adaptive(
     return fold_edges_adaptive(parent_pos, clo, chi, pos, order, n,
                                lift_levels=lift_levels,
                                segment_rounds=segment_rounds,
-                               pos_host=pos_host)
+                               pos_host=pos_host, stats=stats)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels"))
