@@ -97,6 +97,25 @@ def test_segmented_fixpoint_bit_identical(graph, segment_rounds):
     assert rounds_seg == int(rounds_mono)
 
 
+def test_segmented_honors_max_rounds_exactly(graph):
+    """A binding max_rounds must stop the segmented fixpoint at the same
+    round as the monolithic one (review r2: the tail segment used to
+    overshoot by up to segment_rounds-1)."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    padded = pad_chunk(e, len(e), n)
+    clo, chi = elim_ops.orient_edges(jnp.asarray(padded), pos, n)
+    for cap in (1, 3, 7):
+        mono, r_mono = elim_ops.fold_edges(
+            jnp.full(n + 1, n, dtype=jnp.int32), clo, chi, pos, order, n,
+            max_rounds=cap)
+        seg, r_seg = elim_ops.fold_edges_segmented(
+            jnp.full(n + 1, n, dtype=jnp.int32), clo, chi, pos, order, n,
+            segment_rounds=2, max_rounds=cap)
+        assert r_seg == int(r_mono)
+        np.testing.assert_array_equal(np.asarray(seg), np.asarray(mono))
+
+
 def test_adaptive_fixpoint_matches_monolithic(graph):
     """Compaction + jump-mode tail must produce the identical forest (the
     elimination forest is unique given the order; compaction preserves the
