@@ -84,3 +84,31 @@ def test_soak_livejournal_scale():
     assert res.balance < 1.6
     # every vertex with degree > 0 got a part in [0, 8)
     assert res.assignment.min() >= 0 and res.assignment.max() < 8
+
+
+@pytest.mark.skipif(os.environ.get("SHEEP_SOAK") != "1",
+                    reason="set SHEEP_SOAK=1 for the big-V soak")
+def test_soak_big_v_stream_descent():
+    """Big-V soak: V=2^26 vertex tables through the jax streaming build.
+
+    At this V the exact-descent lifting stack (27 tables x 268 MB) blows
+    the EXACT_TABLE_BYTES budget, so fold_edges auto-selects the STREAM
+    descent (one live table) — the path RMAT-30-class configs rely on —
+    while the edge count stays small enough to run in CI-minutes. The
+    tree must still match the oracle exactly."""
+    scale, ef = 26, 1  # 67M vertices, 67M edges would be heavy; ef=1
+    n = 1 << scale
+    m = 1 << 22  # 4M edges over 67M vertices
+    rng = np.random.default_rng(7)
+    e = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+    es = EdgeStream.from_array(e, n_vertices=n)
+    from sheep_tpu.backends.base import get_backend
+
+    res = get_backend("tpu", chunk_edges=1 << 21).partition(
+        es, 8, comm_volume=False)
+    assert res.assignment.min() >= 0 and res.assignment.max() < 8
+    if native.available():
+        ref = get_backend("cpu", chunk_edges=1 << 21).partition(
+            es, 8, comm_volume=False)
+        assert res.edge_cut == ref.edge_cut
+        np.testing.assert_array_equal(res.assignment, ref.assignment)
