@@ -22,7 +22,7 @@ from sheep_tpu.types import ElimTree, PartitionResult  # noqa: F401
 from sheep_tpu.backends.base import get_backend, list_backends  # noqa: F401
 
 
-def partition(path, k, backend=None, **opts):
+def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     """One-call API: partition the graph stored at *path* into *k* parts.
 
     ``backend=None`` auto-selects the best registered backend
@@ -30,6 +30,11 @@ def partition(path, k, backend=None, **opts):
     ``chunk_edges``, ``alpha``, ``lift_levels``) and partition options
     (e.g. ``weights``, ``comm_volume``) are both accepted; unknown options
     raise TypeError rather than being silently dropped.
+
+    ``refine=N`` runs up to N rounds of capacity-constrained label
+    propagation after the backend finishes (``ops/refine.py``) — an
+    extension beyond the reference's surface; the refined cut is
+    guaranteed <= the unrefined cut (non-improving rounds roll back).
     """
     import inspect
 
@@ -60,4 +65,43 @@ def partition(path, k, backend=None, **opts):
     part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
     be = cls(**ctor_opts)
     with EdgeStream.open(path) as es:
-        return be.partition(es, k, **part_opts)
+        res = be.partition(es, k, **part_opts)
+        if refine:
+            res = refine_result(res, es, rounds=refine, alpha=refine_alpha)
+        return res
+
+
+def refine_result(res, stream, rounds=3, alpha=1.10):
+    """Apply the post-pass refinement to a PartitionResult (shared by the
+    library API and the CLI's --refine flag); rescores cut/balance (and
+    comm volume when the input carried one)."""
+    import dataclasses
+
+    import numpy as np
+
+    from sheep_tpu.core import pure
+    from sheep_tpu.ops.refine import refine_assignment
+
+    n = stream.num_vertices
+    new_assign, rstats = refine_assignment(
+        res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha)
+    cv = res.comm_volume
+    if cv is not None:
+        import jax.numpy as jnp
+
+        from sheep_tpu.ops import score as score_ops
+        from sheep_tpu.utils.checkpoint import compact_cv_keys
+
+        a_dev = jnp.asarray(np.concatenate(
+            [new_assign.astype(np.int32), np.zeros(1, np.int32)]))
+        chunks = [score_ops.cut_pair_keys_host(c, a_dev, n, res.k)
+                  for c in stream.chunks(1 << 22)]
+        cv = int(len(compact_cv_keys(chunks)))
+    return dataclasses.replace(
+        res, assignment=new_assign,
+        edge_cut=rstats["refine_cut_after"],
+        cut_ratio=rstats["refine_cut_after"] / max(res.total_edges, 1),
+        balance=pure.part_balance(new_assign, res.k, None),
+        comm_volume=cv,
+        diagnostics={**(res.diagnostics or {}),
+                     **{kk: float(vv) for kk, vv in rstats.items()}})

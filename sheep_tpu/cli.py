@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bag capacity factor for the tree split (default 1.0)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
+    p.add_argument("--refine", type=int, default=0, metavar="N",
+                   help="post-pass: up to N rounds of capacity-constrained "
+                        "label propagation (cut never regresses; extension "
+                        "beyond the reference)")
+    p.add_argument("--refine-alpha", type=float, default=1.10,
+                   help="refinement balance cap (x ceil(V/k) per part)")
     p.add_argument("--no-comm-volume", action="store_true",
                    help="skip communication-volume computation (saves a pass of memory)")
     p.add_argument("--num-vertices", type=int, default=None,
@@ -148,6 +154,11 @@ def main(argv=None) -> int:
         try:
             res = be.partition(es, args.k, weights=args.weights,
                                comm_volume=not args.no_comm_volume, **ckpt_kw)
+            if args.refine and is_main:
+                from sheep_tpu import refine_result
+
+                res = refine_result(res, es, rounds=args.refine,
+                                    alpha=args.refine_alpha)
         finally:
             if profile is not None:
                 profile.__exit__(None, None, None)

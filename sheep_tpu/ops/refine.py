@@ -1,0 +1,141 @@
+"""Optional partition refinement: capacity-constrained label propagation
+on device.
+
+An EXTENSION beyond the reference's capability surface (SURVEY.md §2 has
+no refinement component — the reference stops at the tree split): after
+any backend produces an assignment, a few refinement rounds move
+vertices to the neighbor-majority part under a balance cap, typically
+cutting the edge cut further. Off by default so every cross-backend
+parity test and the reference-equivalent pipeline are untouched; enable
+with ``--refine N`` / ``sheep_tpu.partition(..., refine=N)``.
+
+TPU shape: each half-round is one streamed scatter-add pass over the
+edges into a (V, k) neighbor-part histogram, one argmax, and one
+lexsorted capacity ranking — all static shapes, no data-dependent
+control flow on device. Parallel moves are interleaved by vertex parity
+(two half-rounds) to damp oscillation, and each full round is scored; a
+round that does not improve the cut is ROLLED BACK and refinement stops,
+so the refined cut is never worse than the input (guaranteed, not
+heuristic).
+
+Memory: the histogram is the only big buffer — 4*V*k bytes (int32).
+``refine_assignment`` refuses politely when that exceeds ``budget_bytes``
+(driver eval configs: LiveJournal k=8 = 128 MB fits; twitter-2010 k=64 =
+10.5 GB does not on one 16 GB chip — refinement is a small-k feature
+until a vertex-blocked histogram variant is needed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n", "k"))
+def neighbor_hist_chunk(hist: jax.Array, chunk: jax.Array,
+                        assign: jax.Array, n: int, k: int) -> jax.Array:
+    """Accumulate one (C, 2) edge chunk into the (n+1, k) neighbor-part
+    histogram (row n absorbs padding/self-loops)."""
+    e = chunk.astype(jnp.int32)
+    u, v = e[:, 0], e[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
+    pu = assign[jnp.clip(u, 0, n)]
+    pv = assign[jnp.clip(v, 0, n)]
+    iu = jnp.where(valid, u, n)
+    iv = jnp.where(valid, v, n)
+    hist = hist.at[iu, pv].add(1, mode="drop")
+    return hist.at[iv, pu].add(1, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("n", "k"))
+def plan_moves(hist: jax.Array, assign: jax.Array, cap: jax.Array,
+               parity, n: int, k: int):
+    """One half-round of capacity-constrained moves.
+
+    A vertex of the active parity wants to move to its neighbor-majority
+    part when that strictly beats its current part's neighbor count.
+    Movers are ranked per target part by descending gain (one lexsort);
+    only the top ``cap - load`` movers per part are accepted, so no part
+    ever grows past the cap (departures only free more room). Returns the
+    updated assignment.
+    """
+    vid = jnp.arange(n + 1, dtype=jnp.int32)
+    cur_part = assign[:n + 1]
+    cur = jnp.take_along_axis(hist, cur_part[:, None].astype(jnp.int32),
+                              axis=1)[:, 0]
+    best = jnp.argmax(hist, axis=1).astype(jnp.int32)
+    bestv = jnp.max(hist, axis=1)
+    gain = bestv - cur
+    want = (gain > 0) & (vid < n) & ((vid % 2) == parity)
+
+    loads = jnp.zeros(k, jnp.int32).at[cur_part[:n]].add(1, mode="drop")
+    head = jnp.maximum(cap - loads, 0)
+
+    part_key = jnp.where(want, best, k)  # k = "not moving", sorts last
+    order = jnp.lexsort((-gain, part_key))
+    pk_sorted = part_key[order]
+    starts = jnp.searchsorted(pk_sorted, jnp.arange(k, dtype=pk_sorted.dtype))
+    pk_c = jnp.clip(pk_sorted, 0, k - 1)
+    rank = jnp.arange(n + 1, dtype=jnp.int32) - starts[pk_c].astype(jnp.int32)
+    ok_sorted = (pk_sorted < k) & (rank < head[pk_c])
+    allowed = jnp.zeros(n + 1, bool).at[order].set(ok_sorted)
+    return jnp.where(allowed, best, cur_part).astype(jnp.int32)
+
+
+def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
+                      rounds: int = 3, alpha: float = 1.10,
+                      chunk_edges: int = 1 << 22,
+                      budget_bytes: int = 4 << 30):
+    """Refine a host assignment in place-semantics; returns
+    (new_assign, refine_stats).
+
+    Each round: two parity half-rounds of histogram + capped moves, then
+    a scoring pass; a non-improving round is rolled back and refinement
+    stops. The balance cap is ``alpha * ceil(n / k)`` vertices per part —
+    parts already above it only shrink.
+    """
+    from sheep_tpu.backends.tpu_backend import pad_chunk
+    from sheep_tpu.ops import score as score_ops
+
+    hist_bytes = 4 * (n + 1) * k
+    if hist_bytes > budget_bytes:
+        raise ValueError(
+            f"refinement histogram needs {hist_bytes / 2**30:.1f} GiB "
+            f"(V={n:,}, k={k}) > budget {budget_bytes / 2**30:.1f} GiB; "
+            "refine is a small-k feature — rerun without --refine")
+
+    def score(a_dev):
+        cut = total = 0
+        for c in stream.chunks(chunk_edges):
+            cc, tt = score_ops.score_chunk(
+                jnp.asarray(pad_chunk(c, chunk_edges, n)), a_dev, n)
+            cut += int(cc)
+            total += int(tt)
+        return cut, total
+
+    a_dev = jnp.asarray(np.concatenate(
+        [np.asarray(assign, np.int32), np.zeros(1, np.int32)]))
+    cap = jnp.int32(int(alpha * (-(-n // k))))
+    best_cut, total = score(a_dev)
+    stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut}
+    best = a_dev
+    for _ in range(rounds):
+        a_try = best
+        for parity in (0, 1):
+            hist = jnp.zeros((n + 1, k), jnp.int32)
+            for c in stream.chunks(chunk_edges):
+                hist = neighbor_hist_chunk(
+                    hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
+                    a_try, n, k)
+            a_try = plan_moves(hist, a_try, cap, parity, n, k)
+        cut, _ = score(a_try)
+        if cut >= best_cut:
+            break  # roll back this round; refined result never regresses
+        best_cut, best = cut, a_try
+        stats["refine_rounds_run"] += 1
+    stats["refine_cut_after"] = best_cut
+    return np.asarray(best[:n]), stats
