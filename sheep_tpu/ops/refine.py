@@ -19,10 +19,13 @@ so the refined cut is never worse than the input (guaranteed, not
 heuristic).
 
 Memory: the histogram is the only big buffer — 4*V*k bytes (int32).
-``refine_assignment`` refuses politely when that exceeds ``budget_bytes``
-(driver eval configs: LiveJournal k=8 = 128 MB fits; twitter-2010 k=64 =
-10.5 GB does not on one 16 GB chip — refinement is a small-k feature
-until a vertex-blocked histogram variant is needed).
+When that exceeds ``budget_bytes`` the pass switches to VERTEX-BLOCKED
+histograms: vertices are processed in contiguous blocks of Vb rows
+(4*Vb*k <= budget), each block re-streaming the edges once — B =
+ceil(V/Vb) edge passes per half-round instead of one, trading streams
+for memory exactly like the build phase trades them (driver eval
+configs: LiveJournal k=8 = 128 MB, one pass; twitter-2010 k=64 =
+10.5 GB -> 3 blocked passes at a 4 GB budget).
 """
 
 from __future__ import annotations
@@ -51,25 +54,50 @@ def neighbor_hist_chunk(hist: jax.Array, chunk: jax.Array,
     return hist.at[iv, pu].add(1, mode="drop")
 
 
+@partial(jax.jit, static_argnames=("n", "k", "vb"))
+def neighbor_hist_block(hist: jax.Array, chunk: jax.Array,
+                        assign: jax.Array, base, n: int, k: int, vb: int):
+    """Blocked variant: accumulate only rows [base, base+vb) of the
+    global histogram into a (vb+1, k) buffer (row vb absorbs everything
+    outside the block)."""
+    e = chunk.astype(jnp.int32)
+    u, v = e[:, 0], e[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
+    pu = assign[jnp.clip(u, 0, n)]
+    pv = assign[jnp.clip(v, 0, n)]
+
+    def upd(h, i, p):
+        local = jnp.where(valid, i, n) - base
+        idx = jnp.where((local >= 0) & (local < vb), local, vb)
+        return h.at[idx, p].add(1, mode="drop")
+
+    return upd(upd(hist, u, pv), v, pu)
+
+
+@partial(jax.jit, static_argnames=())
+def hist_stats(hist: jax.Array, cur_part: jax.Array):
+    """(rows, k) histogram -> (best part, best count, current count)."""
+    best = jnp.argmax(hist, axis=1).astype(jnp.int32)
+    bestv = jnp.max(hist, axis=1)
+    cur = jnp.take_along_axis(hist, cur_part[:, None].astype(jnp.int32),
+                              axis=1)[:, 0]
+    return best, bestv, cur
+
+
 @partial(jax.jit, static_argnames=("n", "k"))
-def plan_moves(hist: jax.Array, assign: jax.Array, cap: jax.Array,
-               parity, n: int, k: int):
+def plan_moves(best: jax.Array, gain: jax.Array, assign: jax.Array,
+               cap: jax.Array, parity, n: int, k: int):
     """One half-round of capacity-constrained moves.
 
     A vertex of the active parity wants to move to its neighbor-majority
-    part when that strictly beats its current part's neighbor count.
-    Movers are ranked per target part by descending gain (one lexsort);
-    only the top ``cap - load`` movers per part are accepted, so no part
-    ever grows past the cap (departures only free more room). Returns the
-    updated assignment.
+    part (``best``) when the ``gain`` (majority count minus current-part
+    count) is strictly positive. Movers are ranked per target part by
+    descending gain (one lexsort); only the top ``cap - load`` movers per
+    part are accepted, so no part ever grows past the cap (departures
+    only free more room). Returns the updated assignment.
     """
     vid = jnp.arange(n + 1, dtype=jnp.int32)
     cur_part = assign[:n + 1]
-    cur = jnp.take_along_axis(hist, cur_part[:, None].astype(jnp.int32),
-                              axis=1)[:, 0]
-    best = jnp.argmax(hist, axis=1).astype(jnp.int32)
-    bestv = jnp.max(hist, axis=1)
-    gain = bestv - cur
     want = (gain > 0) & (vid < n) & ((vid % 2) == parity)
 
     loads = jnp.zeros(k, jnp.int32).at[cur_part[:n]].add(1, mode="drop")
@@ -89,7 +117,8 @@ def plan_moves(hist: jax.Array, assign: jax.Array, cap: jax.Array,
 def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
                       rounds: int = 3, alpha: float = 1.10,
                       chunk_edges: int = 1 << 22,
-                      budget_bytes: int = 4 << 30):
+                      budget_bytes: int = 4 << 30,
+                      min_block: int = 1 << 16):
     """Refine a host assignment in place-semantics; returns
     (new_assign, refine_stats).
 
@@ -102,11 +131,11 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
     from sheep_tpu.ops import score as score_ops
 
     hist_bytes = 4 * (n + 1) * k
+    vb = 0  # 0 = single full-width histogram
     if hist_bytes > budget_bytes:
-        raise ValueError(
-            f"refinement histogram needs {hist_bytes / 2**30:.1f} GiB "
-            f"(V={n:,}, k={k}) > budget {budget_bytes / 2**30:.1f} GiB; "
-            "refine is a small-k feature — rerun without --refine")
+        vb = max(min_block, budget_bytes // (4 * k))
+        if vb >= n + 1:
+            vb = 0
 
     def score(a_dev):
         cut = total = 0
@@ -117,21 +146,47 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
             total += int(tt)
         return cut, total
 
-    a_dev = jnp.asarray(np.concatenate(
-        [np.asarray(assign, np.int32), np.zeros(1, np.int32)]))
-    cap = jnp.int32(int(alpha * (-(-n // k))))
-    best_cut, total = score(a_dev)
-    stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut}
-    best = a_dev
-    for _ in range(rounds):
-        a_try = best
-        for parity in (0, 1):
+    def gains(a_try):
+        """(best, gain) over all vertices — one histogram pass, or
+        ceil(V/vb) blocked passes when the full table exceeds budget."""
+        if not vb:
             hist = jnp.zeros((n + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
                 hist = neighbor_hist_chunk(
                     hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
                     a_try, n, k)
-            a_try = plan_moves(hist, a_try, cap, parity, n, k)
+            b, bv, cur = hist_stats(hist, a_try)
+            return b, bv - cur
+        best_h = np.zeros(n + 1, np.int32)
+        gain_h = np.zeros(n + 1, np.int32)
+        for base in range(0, n + 1, vb):
+            hist = jnp.zeros((vb + 1, k), jnp.int32)
+            for c in stream.chunks(chunk_edges):
+                hist = neighbor_hist_block(
+                    hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
+                    a_try, jnp.int32(base), n, k, vb)
+            rows = a_try[base:base + vb]
+            pad = vb - rows.shape[0]
+            if pad:
+                rows = jnp.concatenate([rows, jnp.zeros(pad, rows.dtype)])
+            b, bv, cur = hist_stats(hist[:vb], rows)
+            span = min(vb, n + 1 - base)
+            best_h[base:base + span] = np.asarray(b)[:span]
+            gain_h[base:base + span] = np.asarray(bv - cur)[:span]
+        return jnp.asarray(best_h), jnp.asarray(gain_h)
+
+    a_dev = jnp.asarray(np.concatenate(
+        [np.asarray(assign, np.int32), np.zeros(1, np.int32)]))
+    cap = jnp.int32(int(alpha * (-(-n // k))))
+    best_cut, total = score(a_dev)
+    stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut,
+             "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1}
+    best = a_dev
+    for _ in range(rounds):
+        a_try = best
+        for parity in (0, 1):
+            b, g = gains(a_try)
+            a_try = plan_moves(b, g, a_try, cap, parity, n, k)
         cut, _ = score(a_try)
         if cut >= best_cut:
             break  # roll back this round; refined result never regresses

@@ -60,12 +60,22 @@ def test_refine_improves_rmat_cut():
     assert stats["refine_cut_after"] < stats["refine_cut_before"]
 
 
-def test_refine_budget_refusal():
-    e, n, k = CASES["karate"]
+def test_refine_blocked_histogram_matches_full():
+    """A histogram over budget switches to vertex-blocked passes; the
+    result must be identical to the single full-width histogram (the
+    blocks partition the same rows)."""
+    e, n, k = CASES["rmat"]
     es = EdgeStream.from_array(e, n_vertices=n)
-    with pytest.raises(ValueError, match="budget"):
-        refine_assignment(np.zeros(n, np.int32), es, n, k,
-                          budget_bytes=8)
+    res = get_backend("pure").partition(es, k, comm_volume=False)
+    full, fs = refine_assignment(res.assignment, es, n, k, rounds=3,
+                                 chunk_edges=1 << 12)
+    blocked, bs = refine_assignment(res.assignment, es, n, k, rounds=3,
+                                    chunk_edges=1 << 12,
+                                    budget_bytes=4 * 64 * k,
+                                    min_block=64)
+    assert bs["refine_hist_blocks"] > 1 and fs["refine_hist_blocks"] == 1
+    np.testing.assert_array_equal(blocked, full)
+    assert bs["refine_cut_after"] == fs["refine_cut_after"]
 
 
 def test_partition_api_refine(tmp_path):
