@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-shot real-TPU capture: run the moment the axon tunnel is healthy.
+# Produces, under tools/out/: the headline bench JSON + stderr, the
+# micro-roofline JSON (XLA-vs-Pallas decision data, SURVEY.md §7 step 7),
+# and an xprof trace of the fixpoint round — everything VERDICT r1 item 3
+# asked for. Safe to re-run; each artifact is timestamped.
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%S)
+out="tools/out/$ts"
+mkdir -p "$out"
+
+echo "== probe ==" | tee "$out/session.log"
+timeout 120 python -c "
+import jax, jax.numpy as jnp
+(jnp.arange(8)+1).block_until_ready()
+print('platform:', jax.default_backend())
+" 2>&1 | tail -2 | tee -a "$out/session.log"
+if ! grep -q "platform: tpu" "$out/session.log"; then
+  echo "TPU not reachable; aborting (artifacts in $out)" | tee -a "$out/session.log"
+  exit 1
+fi
+
+echo "== microbench (scale 22) ==" | tee -a "$out/session.log"
+timeout 900 python tools/microbench_fixpoint.py --scale 22 --chunk-log 24 \
+  --profile-dir "$out/xprof" >"$out/microbench.jsonl" 2>>"$out/session.log"
+
+echo "== headline bench ==" | tee -a "$out/session.log"
+timeout 3000 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
+cat "$out/bench.json" | tee -a "$out/session.log"
+tail -5 "$out/bench.stderr" | tee -a "$out/session.log"
+
+echo "artifacts in $out" | tee -a "$out/session.log"
