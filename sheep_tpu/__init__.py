@@ -64,6 +64,9 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
     part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
     be = cls(**ctor_opts)
+    if refine and opts.get("weights", "unit") != "unit":
+        raise ValueError("refine currently balances vertex counts; "
+                         "combine it with weights='unit' only")
     with EdgeStream.open(path) as es:
         res = be.partition(es, k, **part_opts)
         if refine:

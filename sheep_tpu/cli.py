@@ -72,7 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.refine and args.weights != "unit":
+        parser.error("--refine currently balances vertex counts; "
+                     "drop it or use --weights unit")
 
     # Honor JAX_PLATFORMS even though a TPU platform plugin may pre-import
     # jax at interpreter startup (which makes the env var a no-op on its
