@@ -54,7 +54,7 @@ class TpuBackend(Partitioner):
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
-                 alpha: float = 1.0, segment_rounds: int = 4):
+                 alpha: float = 1.0, segment_rounds: int = 2):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha

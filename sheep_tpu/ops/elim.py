@@ -349,7 +349,7 @@ def fold_edges_adaptive(
     order: jax.Array,
     n: int,
     lift_levels: int = 0,
-    segment_rounds: int = 4,
+    segment_rounds: int = 2,
     descent: str = "auto",
     max_rounds: int = 1 << 20,
     small_size: int = 1 << 14,
@@ -535,7 +535,7 @@ def build_chunk_step_adaptive(
     order: jax.Array,
     n: int,
     lift_levels: int = 0,
-    segment_rounds: int = 4,
+    segment_rounds: int = 2,
     pos_host=None,
     stats=None,
 ):
