@@ -64,20 +64,20 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
     part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
     be = cls(**ctor_opts)
-    if refine and opts.get("weights", "unit") != "unit":
-        raise ValueError("refine currently balances vertex counts; "
-                         "combine it with weights='unit' only")
     with EdgeStream.open(path) as es:
         res = be.partition(es, k, **part_opts)
         if refine:
-            res = refine_result(res, es, rounds=refine, alpha=refine_alpha)
+            res = refine_result(res, es, rounds=refine, alpha=refine_alpha,
+                                weights=opts.get("weights", "unit"))
         return res
 
 
-def refine_result(res, stream, rounds=3, alpha=1.10):
+def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
     """Apply the post-pass refinement to a PartitionResult (shared by the
     library API and the CLI's --refine flag); rescores cut/balance (and
-    comm volume when the input carried one)."""
+    comm volume when the input carried one). ``weights="degree"`` caps
+    parts by degree weight, matching the backend's balance semantics
+    (one extra stream pass recomputes the degrees)."""
     import dataclasses
 
     import numpy as np
@@ -86,8 +86,15 @@ def refine_result(res, stream, rounds=3, alpha=1.10):
     from sheep_tpu.ops.refine import refine_assignment
 
     n = stream.num_vertices
+    w = None
+    if weights == "degree":
+        w = np.zeros(n, dtype=np.int64)
+        for c in stream.chunks(1 << 22):
+            w += np.bincount(np.asarray(c, np.int64).ravel(),
+                             minlength=n)[:n]
     new_assign, rstats = refine_assignment(
-        res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha)
+        res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha,
+        weights=w)
     cv = res.comm_volume
     if cv is not None:
         import jax.numpy as jnp
@@ -104,7 +111,7 @@ def refine_result(res, stream, rounds=3, alpha=1.10):
         res, assignment=new_assign,
         edge_cut=rstats["refine_cut_after"],
         cut_ratio=rstats["refine_cut_after"] / max(res.total_edges, 1),
-        balance=pure.part_balance(new_assign, res.k, None),
+        balance=pure.part_balance(new_assign, res.k, w),
         comm_volume=cv,
         diagnostics={**(res.diagnostics or {}),
                      **{kk: float(vv) for kk, vv in rstats.items()}})

@@ -74,9 +74,6 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    if args.refine and args.weights != "unit":
-        parser.error("--refine currently balances vertex counts; "
-                     "drop it or use --weights unit")
 
     # Honor JAX_PLATFORMS even though a TPU platform plugin may pre-import
     # jax at interpreter startup (which makes the env var a no-op on its
@@ -162,7 +159,8 @@ def main(argv=None) -> int:
                 from sheep_tpu import refine_result
 
                 res = refine_result(res, es, rounds=args.refine,
-                                    alpha=args.refine_alpha)
+                                    alpha=args.refine_alpha,
+                                    weights=args.weights)
         finally:
             if profile is not None:
                 profile.__exit__(None, None, None)

@@ -114,18 +114,51 @@ def plan_moves(best: jax.Array, gain: jax.Array, assign: jax.Array,
     return jnp.where(allowed, best, cur_part).astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("n", "k"))
+def plan_moves_weighted(best: jax.Array, gain: jax.Array, assign: jax.Array,
+                        w: jax.Array, cap, parity, n: int, k: int):
+    """Weighted variant of :func:`plan_moves`: per-part headroom is in
+    vertex WEIGHT, and the accepted movers of each part are the longest
+    gain-descending prefix whose cumulative weight fits the headroom
+    (one global cumsum minus the part-start offset). float32 accumulation
+    — caps are balance heuristics, so ~1e-7 relative slack is fine."""
+    vid = jnp.arange(n + 1, dtype=jnp.int32)
+    cur_part = assign[:n + 1]
+    want = (gain > 0) & (vid < n) & ((vid % 2) == parity)
+
+    wf = w.astype(jnp.float32)
+    loads = jnp.zeros(k, jnp.float32).at[cur_part[:n]].add(wf[:n],
+                                                           mode="drop")
+    head = jnp.maximum(cap - loads, 0.0)
+
+    part_key = jnp.where(want, best, k)
+    order = jnp.lexsort((-gain, part_key))
+    pk_sorted = part_key[order]
+    w_sorted = jnp.where(pk_sorted < k, wf[order], 0.0)
+    csum = jnp.cumsum(w_sorted)
+    starts = jnp.searchsorted(pk_sorted, jnp.arange(k, dtype=pk_sorted.dtype))
+    pk_c = jnp.clip(pk_sorted, 0, k - 1)
+    base = jnp.where(starts > 0, csum[jnp.maximum(starts - 1, 0)], 0.0)
+    within = csum - base[pk_c]  # inclusive prefix weight within the part
+    ok_sorted = (pk_sorted < k) & (within <= head[pk_c])
+    allowed = jnp.zeros(n + 1, bool).at[order].set(ok_sorted)
+    return jnp.where(allowed, best, cur_part).astype(jnp.int32)
+
+
 def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
                       rounds: int = 3, alpha: float = 1.10,
                       chunk_edges: int = 1 << 22,
                       budget_bytes: int = 4 << 30,
-                      min_block: int = 1 << 16):
+                      min_block: int = 1 << 16,
+                      weights: np.ndarray = None):
     """Refine a host assignment in place-semantics; returns
     (new_assign, refine_stats).
 
     Each round: two parity half-rounds of histogram + capped moves, then
     a scoring pass; a non-improving round is rolled back and refinement
-    stops. The balance cap is ``alpha * ceil(n / k)`` vertices per part —
-    parts already above it only shrink.
+    stops. The balance cap is ``alpha * ceil(n / k)`` vertices per part
+    (with ``weights``: ``alpha * total_weight / k`` per part) — parts
+    already above it only shrink.
     """
     from sheep_tpu.backends.tpu_backend import pad_chunk
     from sheep_tpu.ops import score as score_ops
@@ -177,7 +210,12 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
 
     a_dev = jnp.asarray(np.concatenate(
         [np.asarray(assign, np.int32), np.zeros(1, np.int32)]))
-    cap = jnp.int32(int(alpha * (-(-n // k))))
+    if weights is not None:
+        w_dev = jnp.asarray(np.concatenate(
+            [np.asarray(weights, np.float32), np.zeros(1, np.float32)]))
+        cap = jnp.float32(alpha * float(np.sum(weights)) / k)
+    else:
+        cap = jnp.int32(int(alpha * (-(-n // k))))
     best_cut, total = score(a_dev)
     stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut,
              "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1}
@@ -186,7 +224,11 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
         a_try = best
         for parity in (0, 1):
             b, g = gains(a_try)
-            a_try = plan_moves(b, g, a_try, cap, parity, n, k)
+            if weights is not None:
+                a_try = plan_moves_weighted(b, g, a_try, w_dev, cap,
+                                            parity, n, k)
+            else:
+                a_try = plan_moves(b, g, a_try, cap, parity, n, k)
         cut, _ = score(a_try)
         if cut >= best_cut:
             break  # roll back this round; refined result never regresses

@@ -78,6 +78,25 @@ def test_refine_blocked_histogram_matches_full():
     assert bs["refine_cut_after"] == fs["refine_cut_after"]
 
 
+def test_refine_weighted_caps_by_degree():
+    """Degree-weighted refinement: cut still never regresses and no part
+    grows past the weighted cap (alpha * total_degree / k)."""
+    e, n, k = CASES["rmat"]
+    es = EdgeStream.from_array(e, n_vertices=n)
+    res = get_backend("pure").partition(es, k, weights="degree",
+                                        comm_volume=False)
+    deg = np.bincount(e.ravel(), minlength=n)[:n]
+    alpha = 1.10
+    cap_w = alpha * deg.sum() / k
+    new_assign, stats = refine_assignment(
+        res.assignment, es, n, k, rounds=3, alpha=alpha,
+        chunk_edges=1 << 12, weights=deg)
+    assert stats["refine_cut_after"] <= stats["refine_cut_before"]
+    loads_w = np.bincount(new_assign, weights=deg, minlength=k)
+    start_w = np.bincount(res.assignment, weights=deg, minlength=k)
+    assert np.all(loads_w <= np.maximum(start_w, cap_w * (1 + 1e-5)))
+
+
 def test_partition_api_refine(tmp_path):
     e, n, k = CASES["rmat"]
     gp = str(tmp_path / "g.edges")
