@@ -92,9 +92,19 @@ def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
         for c in stream.chunks(1 << 22):
             w += np.bincount(np.asarray(c, np.int64).ravel(),
                              minlength=n)[:n]
-    new_assign, rstats = refine_assignment(
-        res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha,
-        weights=w)
+    try:
+        new_assign, rstats = refine_assignment(
+            res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha,
+            weights=w)
+    except ValueError as e:
+        # never lose a finished partition to an over-budget refinement —
+        # return it unrefined with the reason in the diagnostics
+        import sys
+
+        print(f"refine skipped: {e}", file=sys.stderr)
+        return dataclasses.replace(
+            res, diagnostics={**(res.diagnostics or {}),
+                              "refine_skipped": str(e)})
     cv = res.comm_volume
     if cv is not None:
         import jax.numpy as jnp
@@ -104,9 +114,11 @@ def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
 
         a_dev = jnp.asarray(np.concatenate(
             [new_assign.astype(np.int32), np.zeros(1, np.int32)]))
-        chunks = [score_ops.cut_pair_keys_host(c, a_dev, n, res.k)
-                  for c in stream.chunks(1 << 22)]
-        cv = int(len(compact_cv_keys(chunks)))
+        acc: list = []
+        for c in stream.chunks(1 << 22):
+            score_ops.accumulate_cv_keys(
+                acc, score_ops.cut_pair_keys_host(c, a_dev, n, res.k))
+        cv = int(len(compact_cv_keys(acc)))
     return dataclasses.replace(
         res, assignment=new_assign,
         edge_cut=rstats["refine_cut_after"],

@@ -121,11 +121,20 @@ def main(argv=None) -> int:
     with EdgeStream.open(args.input, n_vertices=args.num_vertices) as es:
         if auto and backend.startswith("tpu") and "tpu-bigv" in list_backends():
             # replicated vertex tables past the single-chip ceiling need
-            # the vertex-sharded mode (BASELINE.md HBM budget; 16 GiB v5e)
+            # the vertex-sharded mode (BASELINE.md HBM budget); ask the
+            # real device for its memory limit, 16 GiB (v5e) fallback
             from sheep_tpu.utils.membudget import max_vertices_for
 
+            hbm = 16 << 30
+            try:
+                import jax
+
+                stats = jax.local_devices()[0].memory_stats() or {}
+                hbm = int(stats.get("bytes_limit", hbm)) or hbm
+            except Exception:
+                pass
             cs = args.chunk_edges or (1 << 22)
-            if es.num_vertices > max_vertices_for(int(0.9 * (16 << 30)), cs):
+            if es.num_vertices > max_vertices_for(int(0.9 * hbm), cs):
                 backend = "tpu-bigv"
 
         ctor = {"alpha": args.alpha}

@@ -149,6 +149,7 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
                       rounds: int = 3, alpha: float = 1.10,
                       chunk_edges: int = 1 << 22,
                       budget_bytes: int = 4 << 30,
+                      plan_budget_bytes: int = 4 << 30,
                       min_block: int = 1 << 16,
                       weights: np.ndarray = None):
     """Refine a host assignment in place-semantics; returns
@@ -162,6 +163,18 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
     """
     from sheep_tpu.backends.tpu_backend import pad_chunk
     from sheep_tpu.ops import score as score_ops
+
+    # the move-planning step (lexsort + companion arrays) materializes
+    # ~10 full-length O(V) single-device buffers with no blocked variant;
+    # refuse clearly rather than OOM after the partition already finished
+    # (refine_result converts this into a skip-with-diagnostic)
+    plan_bytes = 10 * 4 * (n + 1)
+    if plan_bytes > plan_budget_bytes:
+        raise ValueError(
+            f"refinement planning needs ~{plan_bytes / 2**30:.1f} GiB of "
+            f"O(V) device buffers (V={n:,}) > budget "
+            f"{plan_budget_bytes / 2**30:.1f} GiB — V is past the "
+            "single-device refine ceiling")
 
     hist_bytes = 4 * (n + 1) * k
     vb = 0  # 0 = single full-width histogram

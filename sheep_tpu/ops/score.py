@@ -55,10 +55,14 @@ CV_COMPACT_ENTRIES = 1 << 25  # 256 MiB of int64 keys
 
 
 def accumulate_cv_keys(cv_chunks: list, keys) -> list:
-    """Append a chunk's cv keys; compact in place past the size cap."""
+    """Append a chunk's cv keys; compact in place when the PENDING tail
+    (everything after the already-compacted head) exceeds the cap.
+    Head-excluded accounting keeps memory at O(distinct + cap) without
+    going quadratic when the distinct-key set alone exceeds the cap
+    (re-sorting the whole accumulator per chunk)."""
     cv_chunks.append(keys)
     if (len(cv_chunks) > 1
-            and sum(len(c) for c in cv_chunks) > CV_COMPACT_ENTRIES):
+            and sum(len(c) for c in cv_chunks[1:]) > CV_COMPACT_ENTRIES):
         from sheep_tpu.utils.checkpoint import compact_cv_keys
 
         compacted = compact_cv_keys(cv_chunks)

@@ -97,6 +97,55 @@ def test_refine_weighted_caps_by_degree():
     assert np.all(loads_w <= np.maximum(start_w, cap_w * (1 + 1e-5)))
 
 
+def test_refine_over_plan_budget_skips_gracefully(tmp_path):
+    """Past the O(V) planning-buffer ceiling, refine_result must return
+    the UNREFINED result with a diagnostic instead of losing the run."""
+    e, n, k = CASES["rmat"]
+    es = EdgeStream.from_array(e, n_vertices=n)
+    with pytest.raises(ValueError, match="ceiling"):
+        refine_assignment(np.zeros(n, np.int32), es, n, k,
+                          plan_budget_bytes=64)
+
+    gp = str(tmp_path / "g.edges")
+    formats.write_edges(gp, e)
+    import unittest.mock as mock
+
+    from sheep_tpu.ops import refine as refine_mod
+
+    base = sheep_tpu.partition(gp, k, backend="pure", comm_volume=False)
+    with mock.patch.object(
+            refine_mod, "refine_assignment",
+            side_effect=ValueError("past the single-device refine ceiling")):
+        res = sheep_tpu.partition(gp, k, backend="pure",
+                                  comm_volume=False, refine=2)
+    np.testing.assert_array_equal(res.assignment, base.assignment)
+    assert "ceiling" in res.diagnostics["refine_skipped"]
+
+
+def test_accumulate_cv_keys_not_quadratic_past_distinct_cap(monkeypatch):
+    """Once the compacted head alone exceeds the cap, further appends
+    must NOT recompact every chunk (review r2 finding #3)."""
+    from sheep_tpu.ops import score as score_ops
+    from sheep_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setattr(score_ops, "CV_COMPACT_ENTRIES", 8)
+    calls = {"n": 0}
+    real = ckpt.compact_cv_keys
+
+    def counting(chunks):
+        calls["n"] += 1
+        return real(chunks)
+
+    monkeypatch.setattr(ckpt, "compact_cv_keys", counting)
+    acc = [np.arange(100, dtype=np.int64)]  # compacted head > cap
+    for i in range(20):
+        score_ops.accumulate_cv_keys(
+            acc, np.array([i], dtype=np.int64))
+    # tail of 1-element chunks only crosses the cap ~twice in 20 appends
+    assert calls["n"] <= 3
+    assert set(real(acc)) == set(range(100))
+
+
 def test_partition_api_refine(tmp_path):
     e, n, k = CASES["rmat"]
     gp = str(tmp_path / "g.edges")
