@@ -145,6 +145,41 @@ def plan_moves_weighted(best: jax.Array, gain: jax.Array, assign: jax.Array,
     return jnp.where(allowed, best, cur_part).astype(jnp.int32)
 
 
+def plan_moves_host(best: np.ndarray, gain: np.ndarray, assign: np.ndarray,
+                    cap, parity: int, n: int, k: int,
+                    w: np.ndarray = None) -> np.ndarray:
+    """Numpy mirror of plan_moves/plan_moves_weighted, for graphs whose
+    O(V) planning buffers exceed the device budget (hosts hold hundreds
+    of GB). Stable lexsorts on both sides -> identical accepted sets."""
+    vid = np.arange(n + 1, dtype=np.int64)
+    cur = assign[:n + 1]
+    want = (gain > 0) & (vid < n) & ((vid % 2) == parity)
+    part_key = np.where(want, best, k)
+    order = np.lexsort((-gain, part_key))
+    pk = part_key[order]
+    starts = np.searchsorted(pk, np.arange(k))
+    pk_c = np.clip(pk, 0, k - 1)
+    if w is None:
+        loads = np.bincount(cur[:n], minlength=k)
+        head = np.maximum(cap - loads, 0)
+        rank = np.arange(n + 1) - starts[pk_c]
+        ok = (pk < k) & (rank < head[pk_c])
+    else:
+        wf = w.astype(np.float32)
+        loads = np.bincount(cur[:n], weights=wf[:n],
+                            minlength=k).astype(np.float32)
+        head = np.maximum(np.float32(cap) - loads, 0.0)
+        w_sorted = np.where(pk < k, wf[order], 0.0).astype(np.float32)
+        csum = np.cumsum(w_sorted, dtype=np.float32)
+        base = np.where(starts > 0, csum[np.maximum(starts - 1, 0)],
+                        np.float32(0.0))
+        within = csum - base[pk_c]
+        ok = (pk < k) & (within <= head[pk_c])
+    allowed = np.zeros(n + 1, bool)
+    allowed[order] = ok
+    return np.where(allowed, best, cur).astype(np.int32)
+
+
 def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
                       rounds: int = 3, alpha: float = 1.10,
                       chunk_edges: int = 1 << 22,
@@ -166,15 +201,10 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
 
     # the move-planning step (lexsort + companion arrays) materializes
     # ~10 full-length O(V) single-device buffers with no blocked variant;
-    # refuse clearly rather than OOM after the partition already finished
-    # (refine_result converts this into a skip-with-diagnostic)
+    # past the device budget, plan on HOST instead (numpy mirror of the
+    # same math — hosts hold hundreds of GB)
     plan_bytes = 10 * 4 * (n + 1)
-    if plan_bytes > plan_budget_bytes:
-        raise ValueError(
-            f"refinement planning needs ~{plan_bytes / 2**30:.1f} GiB of "
-            f"O(V) device buffers (V={n:,}) > budget "
-            f"{plan_budget_bytes / 2**30:.1f} GiB — V is past the "
-            "single-device refine ceiling")
+    host_plan = plan_bytes > plan_budget_bytes
 
     hist_bytes = 4 * (n + 1) * k
     vb = 0  # 0 = single full-width histogram
@@ -231,13 +261,22 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
         cap = jnp.int32(int(alpha * (-(-n // k))))
     best_cut, total = score(a_dev)
     stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut,
-             "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1}
+             "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1,
+             "refine_host_plan": int(host_plan)}
     best = a_dev
     for _ in range(rounds):
         a_try = best
         for parity in (0, 1):
             b, g = gains(a_try)
-            if weights is not None:
+            if host_plan:
+                w_host = None if weights is None \
+                    else np.concatenate([np.asarray(weights, np.float32),
+                                         np.zeros(1, np.float32)])
+                a_try = jnp.asarray(plan_moves_host(
+                    np.asarray(b), np.asarray(g), np.asarray(a_try),
+                    float(cap) if weights is not None else int(cap),
+                    parity, n, k, w=w_host))
+            elif weights is not None:
                 a_try = plan_moves_weighted(b, g, a_try, w_dev, cap,
                                             parity, n, k)
             else:

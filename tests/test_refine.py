@@ -97,15 +97,28 @@ def test_refine_weighted_caps_by_degree():
     assert np.all(loads_w <= np.maximum(start_w, cap_w * (1 + 1e-5)))
 
 
-def test_refine_over_plan_budget_skips_gracefully(tmp_path):
-    """Past the O(V) planning-buffer ceiling, refine_result must return
-    the UNREFINED result with a diagnostic instead of losing the run."""
+def test_refine_host_planning_matches_device():
+    """Past the O(V) device planning budget, moves are planned on host
+    (numpy mirror) — results must be bit-identical to device planning,
+    for both unit and degree weights."""
     e, n, k = CASES["rmat"]
     es = EdgeStream.from_array(e, n_vertices=n)
-    with pytest.raises(ValueError, match="ceiling"):
-        refine_assignment(np.zeros(n, np.int32), es, n, k,
-                          plan_budget_bytes=64)
+    res = get_backend("pure").partition(es, k, comm_volume=False)
+    deg = np.bincount(e.ravel(), minlength=n)[:n]
+    for w in (None, deg):
+        dev, ds = refine_assignment(res.assignment, es, n, k, rounds=3,
+                                    chunk_edges=1 << 12, weights=w)
+        host, hs = refine_assignment(res.assignment, es, n, k, rounds=3,
+                                     chunk_edges=1 << 12, weights=w,
+                                     plan_budget_bytes=64)
+        assert ds["refine_host_plan"] == 0 and hs["refine_host_plan"] == 1
+        np.testing.assert_array_equal(host, dev)
 
+
+def test_refine_error_skips_gracefully(tmp_path):
+    """A refinement failure must return the UNREFINED result with a
+    diagnostic instead of losing the run."""
+    e, n, k = CASES["rmat"]
     gp = str(tmp_path / "g.edges")
     formats.write_edges(gp, e)
     import unittest.mock as mock
