@@ -291,3 +291,24 @@ def test_tpu_backend_end_to_end(k):
     assert res.total_edges == ref.total_edges
     assert res.comm_volume == ref.comm_volume
     np.testing.assert_array_equal(res.assignment, ref.assignment)
+
+
+@pytest.mark.parametrize("k", [1, 1024, 4096, 5000])
+def test_extreme_k_cross_backend(k):
+    """k spanning 1 .. > V (BASELINE config 5 uses k=1024): no backend
+    may crash, scores must agree exactly, and k=1 means zero cut."""
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    e = generators.rmat(12, 8, seed=3)
+    es = EdgeStream.from_array(e, n_vertices=4096)
+    ref = get_backend("pure").partition(es, k, comm_volume=False)
+    if k == 1:
+        assert ref.edge_cut == 0
+    assert ref.assignment.min() >= 0 and ref.assignment.max() < max(k, 1)
+    for b in ("tpu", "tpu-bigv"):
+        if b not in list_backends():
+            continue
+        got = get_backend(b, chunk_edges=2048).partition(
+            es, k, comm_volume=False)
+        assert got.edge_cut == ref.edge_cut
+        np.testing.assert_array_equal(got.assignment, ref.assignment)
