@@ -7,12 +7,14 @@ The comm surface mirrors the reference's three MPI crossings exactly
                           (EdgeStream chunk index % D), device_put with a
                           NamedSharding — no collective, just placement
   2. tree-merge reduce -> butterfly allreduce with *forest merge* as the
-                          combiner: log2(D) ppermute rounds, each device
-                          ships its O(V) parent table over ICI and folds
-                          the incoming forest with the elimination
-                          fixpoint; after the last round every device
-                          holds the global tree (T is associative +
-                          commutative, so the butterfly is valid)
+                          combiner: log2(D) host-driven ppermute rounds,
+                          each device ships compacted boundary pairs (or
+                          the dense O(V) table when occupancy is high)
+                          over ICI and folds the received constraints
+                          with the adaptive elimination fixpoint; after
+                          the last round every device holds the global
+                          tree (T is associative + commutative, so the
+                          butterfly is valid)
   3. score all-reduce  -> psum of (cut, total) counters
 
 Degrees use per-device partial counts summed once at the end (one
