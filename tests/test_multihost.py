@@ -152,18 +152,20 @@ def test_two_process_run_matches_single_process(tmp_path, nprocs):
     _check(outs, ref, expect_parent)
 
 
-@pytest.mark.parametrize("nprocs", [2, 3])
-def test_text_byte_range_sharding_matches_oracle(tmp_path, nprocs):
+@pytest.mark.parametrize("kind", ["sharded", "bigv"])
+def test_text_byte_range_sharding_matches_oracle(tmp_path, kind):
     """Multi-process TEXT ingestion takes the byte-span path (each process
     parses ~file/P, VERDICT r1 item 7) and must reproduce the oracle's
     tree/scores exactly — byte spans regroup edges into different chunks
-    than round-robin, which the order-independent build must not notice."""
+    than round-robin, which the order-independent build must not notice.
+    Covered for both the replicated-table and vertex-sharded pipelines."""
     from sheep_tpu.io import formats, generators
 
     gp = str(tmp_path / "g.edges")
     formats.write_edges(gp, generators.rmat(9, 8, seed=21))
-    rcs, outs, errs = _spawn(nprocs, tmp_path, "textspan", graph=gp)
-    assert rcs == [0] * nprocs, errs
+    rcs, outs, errs = _spawn(2, tmp_path, f"textspan-{kind}", graph=gp,
+                             kind=kind)
+    assert rcs == [0, 0], errs
     ref, expect_parent = _oracle()
     _check(outs, ref, expect_parent)
 
