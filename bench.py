@@ -138,9 +138,11 @@ def measure(scale: int, platform: str) -> dict:
         return out
 
     # --- accelerated backend ---------------------------------------------
-    # cpu-jax fallback prefers smaller chunks (width-proportional round
-    # cost thrashes host caches); the real chip streams HBM either way
-    accel_chunk = 1 << (24 if platform != "cpu" else 22)
+    # chunk sizes from the tools/tune_fixpoint.py sweeps: 2^23 on the
+    # real chip (RMAT-20/22, fewest fixpoint sequences that still hand
+    # the tail off early), 2^22 on the cpu-jax fallback (width-
+    # proportional round cost thrashes host caches)
+    accel_chunk = 1 << (23 if platform != "cpu" else 22)
     tpu = get_backend("tpu", chunk_edges=min(accel_chunk, m))
     t0 = time.perf_counter()
     tpu.partition(es, k, comm_volume=False)  # compile warm-up

@@ -16,6 +16,7 @@ phase — no recompilation across chunks (SURVEY.md §7 hard part #3).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -48,13 +49,98 @@ def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
     return out
 
 
+class _ChunkCache:
+    """Device-resident cache of padded edge chunks, shared by the three
+    streaming passes (degrees / build / score).
+
+    The pipeline reads the same chunks once per pass; without a cache
+    every pass re-crosses the host->device link, which on the tunneled
+    bench chip runs at ~43 MB/s (tools/out/*/probe_timing.txt) and even
+    on a co-located host costs a PCIe crossing per pass. Chunks are kept
+    on device while they fit ``budget`` bytes; a graph bigger than the
+    budget keeps a cached prefix and streams the rest, so the saving
+    degrades gradually. Filling is prefix-ordered and exception-safe:
+    chunk i is cached only with chunks [0, i) already cached, so a
+    partially-filled cache is always a valid prefix of the stream."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self.used = 0
+        self.chunks: list = []
+        self.complete = False
+
+
+def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
+    """Yield padded (cs, 2) int32 chunks as DEVICE arrays, serving and
+    filling ``cache`` when iterating from the stream head. Host read +
+    parse + pad of chunk i+1 overlaps the device work on chunk i via
+    :func:`prefetch`; jnp.asarray issues the (async) upload."""
+    if cache is None or start_chunk != 0:
+        for padded in prefetch(pad_chunk(c, cs, n)
+                               for c in stream.chunks(cs,
+                                                      start_chunk=start_chunk)):
+            yield jnp.asarray(padded)
+        return
+    yield from cache.chunks
+    if cache.complete:
+        return
+    grow = True
+    for padded in prefetch(pad_chunk(c, cs, n)
+                           for c in stream.chunks(
+                               cs, start_chunk=len(cache.chunks))):
+        d = jnp.asarray(padded)
+        nb = int(d.size) * 4
+        if grow and cache.used + nb <= cache.budget:
+            cache.chunks.append(d)
+            cache.used += nb
+        else:
+            grow = False
+        yield d
+    if grow:
+        cache.complete = True
+
+
+def _chunk_cache_budget(n: int, chunk_edges: int) -> int:
+    """Bytes of HBM safely spendable on cached chunks: the device limit
+    minus the build phase's modeled peak and a safety margin.
+
+    0 (cache disabled) on cpu-jax — there the "device" IS host RAM, so
+    caching would duplicate the stream in memory to save a transfer that
+    does not exist — and 0 when the accelerator does not report a real
+    bytes_limit (no basis for a budget)."""
+    from sheep_tpu.utils.membudget import build_phase_bytes
+
+    if jax.default_backend() == "cpu":
+        return 0
+    env = os.environ.get("SHEEP_CACHE_BYTES")
+    if env is not None:
+        return max(0, int(env))
+    dev = jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", 0))
+    except Exception:
+        hbm = 0
+    if hbm <= 0:
+        # no reported limit: infer only from a known device generation;
+        # an unknown accelerator gets no cache rather than a guessed
+        # budget that could OOM it (SHEEP_CACHE_BYTES overrides)
+        kind = getattr(dev, "device_kind", "").lower()
+        known = {"v5 lite": 16, "v5e": 16, "v4": 32, "v5p": 95, "v6": 32}
+        hbm = next((g << 30 for key, g in known.items() if key in kind), 0)
+    reserve = build_phase_bytes(n, chunk_edges)["total_bytes"] + (1 << 30)
+    return max(0, int(0.9 * hbm) - reserve)
+
+
 @register
 class TpuBackend(Partitioner):
     name = "tpu"
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
-                 alpha: float = 1.0, segment_rounds: int = 2):
+                 alpha: float = 1.0, segment_rounds: int = 2,
+                 warm_schedule=None, cache_chunks: bool = True,
+                 host_tail_threshold: int = -1):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -62,6 +148,19 @@ class TpuBackend(Partitioner):
         # accelerator executions short (long single executions tripped the
         # TPU worker watchdog) while staying bit-identical to monolithic
         self.segment_rounds = segment_rounds
+        # one cheap 8-level round before any full-depth round: a
+        # full-buffer round costs ~lift_levels x width in gathers, most
+        # slots retire early without long jumps, and the dedup/compaction
+        # it unlocks shrinks every later round. Measured on the v5e
+        # (tools/tune_fixpoint.py, RMAT-20): build 44.9s -> 10.5s
+        # together with the C/2 host-tail handoff.
+        self.warm_schedule = ((1, 8),) if warm_schedule is None \
+            else tuple(warm_schedule)
+        self.cache_chunks = cache_chunks
+        # -1 = platform default: C/2 on an accelerator (device rounds are
+        # expensive relative to the native host pass), auto (C/8, min
+        # 2^16) on cpu-jax where the measured sweet spot is later handoff
+        self.host_tail_threshold = host_tail_threshold
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -87,14 +186,15 @@ class TpuBackend(Partitioner):
             deg_host = state.arrays["deg"].copy()
         else:
             deg_host = np.zeros(n, dtype=np.int64)
+        cache_budget = _chunk_cache_budget(n, cs) if self.cache_chunks else 0
+        cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             deg = degrees_ops.init_degrees(n)
             since_flush = 0
             idx = start
             # read+parse+pad of chunk i+1 overlaps the device fold of i
-            for padded in prefetch(pad_chunk(c, cs, n)
-                                   for c in stream.chunks(cs, start_chunk=start)):
+            for padded in _device_chunks(stream, cs, n, cache, start):
                 deg = degrees_ops.degree_chunk(deg, padded, n)
                 since_flush += 1
                 idx += 1
@@ -116,44 +216,55 @@ class TpuBackend(Partitioner):
             else np.argsort(np.argsort(deg_host, kind="stable"), kind="stable")
         deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
         pos, order = order_ops.elimination_order(deg_dev, n)
-        pos.block_until_ready()
+        # tiny host pull as the completion barrier: block_until_ready is
+        # not a real barrier on a tunneled device (BASELINE.md fact 3)
+        np.asarray(pos[:1])
         t["sort"] = time.perf_counter() - t0
+        pos_host_cache = None
 
         t0 = time.perf_counter()
         build_stats: dict = {}
+        total_rounds = 0
         if state and from_phase >= 2:
             minp = jnp.asarray(state.arrays["minp"])
-            total_rounds = 0
         else:
+            # the carried forest lives in POSITION space on device (P);
+            # checkpoints keep the stable vertex-space minp encoding, so
+            # the conversions happen only at checkpoint/phase boundaries
             if state and state.phase == "build":
-                minp = jnp.asarray(state.arrays["minp"])
+                P = jnp.asarray(state.arrays["minp"])[order]
                 start = state.chunk_idx
             else:
-                minp = jnp.full(n + 1, n, dtype=jnp.int32)
+                P = jnp.full(n + 1, n, dtype=jnp.int32)
                 start = 0
             total_rounds = 0
             idx = start
             pos_host_cache = np.asarray(pos[:n])  # host tail reuses it
-            for padded in prefetch(pad_chunk(c, cs, n)
-                                   for c in stream.chunks(cs, start_chunk=start)):
-                minp, rounds = elim_ops.build_chunk_step_adaptive(
-                    minp, padded, pos, order, n,
+            tail_at = self.host_tail_threshold
+            if tail_at < 0:
+                tail_at = cs // 2 if jax.default_backend() != "cpu" else 0
+            for padded in _device_chunks(stream, cs, n, cache, start):
+                P, rounds = elim_ops.build_chunk_step_adaptive_pos(
+                    P, padded, pos, pos_host_cache, n,
                     lift_levels=self.lift_levels,
                     segment_rounds=self.segment_rounds,
-                    pos_host=pos_host_cache, stats=build_stats)
+                    warm_schedule=self.warm_schedule, stats=build_stats,
+                    host_tail_threshold=tail_at)
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)
                 if checkpointer is not None and checkpointer.due(idx - start):
                     checkpointer.save(
                         "build", idx,
-                        {"deg": deg_host, "minp": np.asarray(minp)}, meta)
-            minp.block_until_ready()
+                        {"deg": deg_host, "minp": np.asarray(P[pos])}, meta)
+            minp = P[pos]
+            np.asarray(minp[:1])  # real completion barrier (see above)
         t["build"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         parent = elim_ops.minp_to_parent(minp, order, n)
-        pos_host = np.asarray(pos[:n])
+        pos_host = pos_host_cache if pos_host_cache is not None \
+            else np.asarray(pos[:n])
         w = deg_host.astype(np.float64) if weights == "degree" else None
         assign_host = split_ops.tree_split_host(parent, pos_host, k, weights=w,
                                                 alpha=self.alpha)
@@ -173,8 +284,7 @@ class TpuBackend(Partitioner):
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         idx = start
-        for padded in prefetch(pad_chunk(c, cs, n)
-                               for c in stream.chunks(cs, start_chunk=start)):
+        for padded in _device_chunks(stream, cs, n, cache, start):
             c, tt = score_ops.score_chunk(padded, assign, n)
             cut += int(c)
             total += int(tt)

@@ -44,6 +44,17 @@ edge jumps up the tables to its highest ancestor still earlier than
 pos-bound predicate is monotone along a chain (measured: 645 -> 22
 rounds on RMAT-14).
 
+The round body runs entirely in **position space** (state = elimination
+positions, table P[p] = parent position of the vertex at rank p): the
+parent table then IS the first lifting table and jump admissibility is
+a direct integer compare, cutting the gathers per level per slot from
+three to one — and random-gather count is the entire round cost on a
+real TPU (measured ~100-150 M gathers/s on v5e regardless of operand
+shapes; tools/microbench_fixpoint.py). The public entry points keep the
+vertex-space minp contract via exact permutation conversions; the
+``*_pos`` variants let the streaming backend carry P across chunks with
+zero steady-state conversions.
+
 Two descent schedules, auto-selected by memory footprint:
 
 - **exact** (high-to-low over precomputed tables): one round climbs each
@@ -101,59 +112,71 @@ def _resolve(n: int, lift_levels: int, descent: str):
     return lift_levels, descent
 
 
-def _round_body(pos, order, n: int, lift_levels: int, descent: str):
-    """One fixpoint round as a while_loop body over state
-    (lo, hi, minp, changed, rounds) — shared by the run-to-fixpoint and
-    bounded-segment entry points so both execute identical rounds."""
+def _pos_round_body(n: int, lift_levels: int, descent: str):
+    """One fixpoint round as a while_loop body over POSITION-SPACE state
+    (loP, hiP, P, changed, rounds) — shared by every entry point so all
+    schedules execute identical rounds.
+
+    Position space is the real-chip optimization (BASELINE.md roofline):
+    with P[p] = elimination position of the parent of the vertex at rank
+    p, the parent table IS the first binary-lifting table (ancestor
+    chains strictly increase in position), and jump admissibility is the
+    direct integer compare ``cand < hiP``. The vertex-space formulation
+    needed three gathers per lifting level per slot (t[new_lo],
+    pos[cand], plus the order[...] rewrites); this needs ONE — and XLA
+    gather throughput is the whole cost of a round on TPU (measured
+    ~100-150 M random gathers/s on v5e, tools/microbench_fixpoint.py).
+    The dynamics commute with the pos/order permutation, so slot
+    trajectories are bit-identical to the vertex-space form under
+    ``order[.]``/``pos[.]`` conjugation."""
 
     def body(state):
-        lo_, hi_, minp_, _, rounds = state
-        poshi = pos[hi_]
-        old_at_lo = minp_[lo_]  # parent position BEFORE this round
-        new_minp = minp_.at[lo_].min(poshi, mode="drop")
-        now = new_minp[lo_]
+        lo_, hi_, P_, _, rounds = state
+        old_at_lo = P_[lo_]  # parent position BEFORE this round
+        newP = P_.at[lo_].min(hi_, mode="drop")
+        now = newP[lo_]
 
-        # climb for non-retiring edges. binary lifting: t_j[x] = x's
-        # 2^j-step ancestor under the updated table (sentinel n is a
-        # fixpoint of every table since minp[n] = n and order[n] = n);
-        # a jump is safe iff the landing vertex is still earlier than hi
-        t = order[new_minp]
-        new_lo = lo_
+        # climb for non-retiring slots. t_j[p] = p's 2^j-step ancestor
+        # position under the updated table (sentinel n is a fixpoint of
+        # every table since P[n] = n); a jump is safe iff it lands
+        # strictly earlier than hiP
+        t = newP
+        cur = lo_
         if descent == "exact":
             tables = [t]
             for _ in range(lift_levels - 1):
                 t = t[t]
                 tables.append(t)
             for t in reversed(tables):
-                cand = t[new_lo]
-                new_lo = jnp.where(pos[cand] < poshi, cand, new_lo)
+                cand = t[cur]
+                cur = jnp.where(cand < hi_, cand, cur)
         else:  # stream: square in place, only one table live
             for j in range(lift_levels):
-                cand = t[new_lo]
-                new_lo = jnp.where(pos[cand] < poshi, cand, new_lo)
+                cand = t[cur]
+                cur = jnp.where(cand < hi_, cand, cur)
                 if j < lift_levels - 1:
                     t = t[t]
-        became_loop = new_lo == hi_  # constraint already implied
-        climb_lo = jnp.where(became_loop, n, new_lo)
+        became_loop = cur == hi_  # constraint already implied
+        climb_lo = jnp.where(became_loop, n, cur)
         climb_hi = jnp.where(became_loop, n, hi_)
 
-        # retire: this edge's target IS the min at lo (pos is injective,
-        # so only duplicates of the same edge can retire together). If it
-        # improved on an existing parent p, reuse the slot for the
-        # displaced constraint (v, p); else the slot dies.
-        retire = poshi == now
+        # retire: this slot's target IS the min at lo (positions are
+        # unique, so only duplicates of the same constraint retire
+        # together). If it improved on an existing parent p, reuse the
+        # slot for the displaced constraint (now, old); else it dies.
+        retire = hi_ == now
         displaced = retire & (now < old_at_lo) & (old_at_lo < n)
         out_lo = jnp.where(retire,
-                           jnp.where(displaced, order[now], n),
+                           jnp.where(displaced, now, n),
                            climb_lo).astype(jnp.int32)
         out_hi = jnp.where(retire,
-                           jnp.where(displaced, order[old_at_lo], n),
+                           jnp.where(displaced, old_at_lo, n),
                            climb_hi).astype(jnp.int32)
-        # slots only ever change toward progress (pos[lo] strictly
+        # slots only ever change toward progress (loP strictly
         # increases), so "no slot changed" == fixpoint (table included:
         # the table only changes through a retiring slot)
         changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
-        return out_lo, out_hi, new_minp, changed, rounds + 1
+        return out_lo, out_hi, newP, changed, rounds + 1
 
     return body
 
@@ -165,6 +188,89 @@ def _init_state(minp, lo, hi):
     rounds0 = (lo[0] * 0).astype(jnp.int32)
     return (lo.astype(jnp.int32), hi.astype(jnp.int32),
             minp.astype(jnp.int32), changed0, rounds0)
+
+
+@partial(jax.jit, static_argnames=("n", "lift_levels", "segment_rounds",
+                                   "descent"))
+def fold_segment_pos(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 32,
+    descent: str = "auto",
+):
+    """At most ``segment_rounds`` rounds in ONE device execution, entirely
+    in position space — the production hot path (no pos/order tables in
+    the compiled program at all). Returns the full loop state
+    (loP, hiP, P, changed, rounds) so a host driver resumes where the
+    segment stopped; bounding rounds per execution keeps accelerator
+    calls short (long single executions tripped the TPU worker watchdog
+    in round 2's first bench attempt)."""
+    lift_levels, descent = _resolve(n, lift_levels, descent)
+    body = _pos_round_body(n, lift_levels, descent)
+
+    def cond(state):
+        _, _, _, changed, rounds = state
+        return changed & (rounds < segment_rounds)
+
+    return lax.while_loop(cond, body, _init_state(P, loP, hiP))
+
+
+def _pos_small_round_body(n: int, jumps: int):
+    """Jump-mode round body for SMALL active buffers: identical
+    retire/displace semantics to :func:`_pos_round_body`, but the climb is
+    ``jumps`` single parent steps via per-element gathers — O(C') work per
+    round with NO O(V) lifting-table rebuild. Used for the fixpoint tail,
+    where a handful of displacement-chain constraints would otherwise pay
+    the full-buffer, full-table cost every round."""
+
+    def body(state):
+        lo_, hi_, P_, _, rounds = state
+        old_at_lo = P_[lo_]
+        newP = P_.at[lo_].min(hi_, mode="drop")
+        now = newP[lo_]
+
+        cur = lo_
+        for _ in range(jumps):
+            cand = newP[cur]
+            cur = jnp.where(cand < hi_, cand, cur)
+        became_loop = cur == hi_
+        climb_lo = jnp.where(became_loop, n, cur)
+        climb_hi = jnp.where(became_loop, n, hi_)
+
+        retire = hi_ == now
+        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
+        out_lo = jnp.where(retire,
+                           jnp.where(displaced, now, n),
+                           climb_lo).astype(jnp.int32)
+        out_hi = jnp.where(retire,
+                           jnp.where(displaced, old_at_lo, n),
+                           climb_hi).astype(jnp.int32)
+        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
+        return out_lo, out_hi, newP, changed, rounds + 1
+
+    return body
+
+
+@partial(jax.jit, static_argnames=("n", "jumps", "segment_rounds"))
+def fold_segment_small_pos(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    jumps: int = 8,
+    segment_rounds: int = 64,
+):
+    """Bounded segment of jump-mode rounds (see _pos_small_round_body)."""
+    body = _pos_small_round_body(n, jumps)
+
+    def cond(state):
+        _, _, _, changed, rounds = state
+        return changed & (rounds < segment_rounds)
+
+    return lax.while_loop(cond, body, _init_state(P, loP, hiP))
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels", "max_rounds", "descent"))
@@ -186,20 +292,24 @@ def fold_edges(
     fixed-size: a retiring slot is reused in place by the constraint it
     displaces, so per-round work is O(len(lo)), independent of V.
 
+    Vertex-space contract over the position-space core: inputs convert
+    with three gathers (minp[order], pos[lo], pos[hi]), the result with
+    one (P[pos]) — exact integer permutations, so results are identical.
+
     ``lift_levels`` = number of doubled ancestor tables per round
     (0 -> auto: ceil(log2(n+1)), enough to cover any chain in one round).
     ``descent`` = "exact" | "stream" | "auto" (see module docstring).
     """
     lift_levels, descent = _resolve(n, lift_levels, descent)
-    body = _round_body(pos, order, n, lift_levels, descent)
+    body = _pos_round_body(n, lift_levels, descent)
 
     def cond(state):
         _, _, _, changed, rounds = state
         return changed & (rounds < max_rounds)
 
-    state = _init_state(minp, lo, hi)
-    _, _, minp_f, _, rounds = lax.while_loop(cond, body, state)
-    return minp_f, rounds
+    state = _init_state(minp[order], pos[lo], pos[hi])
+    _, _, P_f, _, rounds = lax.while_loop(cond, body, state)
+    return P_f[pos], rounds
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels", "segment_rounds",
@@ -215,63 +325,21 @@ def fold_edges_segment(
     segment_rounds: int = 32,
     descent: str = "auto",
 ):
-    """At most ``segment_rounds`` fixpoint rounds in ONE device execution.
-
-    Returns the full loop state (lo, hi, minp, changed, rounds) so a host
-    driver can resume where the segment stopped. Bounding the rounds per
-    execution keeps each accelerator call short — long-running single
-    executions are what tripped the TPU worker watchdog in round 2's
-    first bench attempt — and gives the host a natural point to report
-    progress. Rounds are executed by the same body as :func:`fold_edges`,
-    so the segmented fixpoint is bit-identical to the monolithic one.
-    """
+    """Vertex-space wrapper of :func:`fold_segment_pos` (same state
+    contract as before: returns (lo, hi, minp, changed, rounds) with
+    vertex ids). The round dynamics commute with the pos/order
+    permutation, so the returned state is bit-identical to the historic
+    vertex-space implementation."""
     lift_levels, descent = _resolve(n, lift_levels, descent)
-    body = _round_body(pos, order, n, lift_levels, descent)
+    body = _pos_round_body(n, lift_levels, descent)
 
     def cond(state):
         _, _, _, changed, rounds = state
         return changed & (rounds < segment_rounds)
 
-    state = _init_state(minp, lo, hi)
-    return lax.while_loop(cond, body, state)
-
-
-def _small_round_body(pos, order, n: int, jumps: int):
-    """Jump-mode round body for SMALL active buffers: identical
-    retire/displace semantics to :func:`_round_body`, but the climb is
-    ``jumps`` single parent steps via per-element gathers — O(C') work per
-    round with NO O(V) lifting-table rebuild. Used for the fixpoint tail,
-    where a handful of displacement-chain constraints would otherwise pay
-    the full-buffer, full-table cost every round."""
-
-    def body(state):
-        lo_, hi_, minp_, _, rounds = state
-        poshi = pos[hi_]
-        old_at_lo = minp_[lo_]
-        new_minp = minp_.at[lo_].min(poshi, mode="drop")
-        now = new_minp[lo_]
-
-        cur = lo_
-        for _ in range(jumps):
-            cand_pos = new_minp[cur]
-            cand = order[cand_pos]
-            cur = jnp.where(cand_pos < poshi, cand, cur)
-        became_loop = cur == hi_
-        climb_lo = jnp.where(became_loop, n, cur)
-        climb_hi = jnp.where(became_loop, n, hi_)
-
-        retire = poshi == now
-        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
-        out_lo = jnp.where(retire,
-                           jnp.where(displaced, order[now], n),
-                           climb_lo).astype(jnp.int32)
-        out_hi = jnp.where(retire,
-                           jnp.where(displaced, order[old_at_lo], n),
-                           climb_hi).astype(jnp.int32)
-        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
-        return out_lo, out_hi, new_minp, changed, rounds + 1
-
-    return body
+    state = _init_state(minp[order], pos[lo], pos[hi])
+    loP, hiP, P_f, changed, rounds = lax.while_loop(cond, body, state)
+    return order[loP], order[hiP], P_f[pos], changed, rounds
 
 
 @partial(jax.jit, static_argnames=("n", "jumps", "segment_rounds"))
@@ -285,22 +353,42 @@ def fold_edges_segment_small(
     jumps: int = 8,
     segment_rounds: int = 64,
 ):
-    """Bounded segment of jump-mode rounds (see _small_round_body)."""
-    body = _small_round_body(pos, order, n, jumps)
+    """Vertex-space wrapper of :func:`fold_segment_small_pos`."""
+    body = _pos_small_round_body(n, jumps)
 
     def cond(state):
         _, _, _, changed, rounds = state
         return changed & (rounds < segment_rounds)
 
-    return lax.while_loop(cond, body, _init_state(minp, lo, hi))
+    state = _init_state(minp[order], pos[lo], pos[hi])
+    loP, hiP, P_f, changed, rounds = lax.while_loop(cond, body, state)
+    return order[loP], order[hiP], P_f[pos], changed, rounds
 
 
-@partial(jax.jit, static_argnames=("n", "size"))
-def compact_actives(lo: jax.Array, hi: jax.Array, n: int, size: int):
+@partial(jax.jit, static_argnames=("n", "size", "dedup"))
+def compact_actives(lo: jax.Array, hi: jax.Array, n: int, size: int,
+                    dedup: bool = False):
     """Pack the live constraints into a (size,) buffer, padding with the
     inert sentinel (n, n). Valid only when the live count <= size (the
-    caller checks); slot identity is meaningless — only the multiset of
-    active constraints matters to the fixpoint, so compaction is exact."""
+    caller checks); slot identity is meaningless — only the SET of
+    active constraints matters to the fixpoint (duplicates retire
+    together and spawn identical displacements), so compaction and
+    dedup are exact.
+
+    ``dedup`` additionally drops duplicate (lo, hi) pairs first via one
+    two-key sort: after a few rounds many slots have been rewritten to
+    the same (ancestor, hi) constraint. The production driver sizes the
+    target from the cheap pre-dedup :func:`count_live` (a per-segment
+    distinct count would cost a full-buffer sort each segment — measured
+    seconds at C=2^24 on the v5e); the count is an upper bound on the
+    distinct count, so the size is always sufficient.
+    :func:`count_live_distinct` exists for diagnostics/tests."""
+    if dedup:
+        lo, hi = lax.sort((lo, hi), num_keys=2)
+        dup = (lo == jnp.roll(lo, 1)) & (hi == jnp.roll(hi, 1))
+        dup = dup.at[0].set(False)
+        lo = jnp.where(dup, n, lo)
+        hi = jnp.where(dup, n, hi)
     c = lo.shape[0]
     # fill slots index an appended sentinel row, so padding is inert
     sel = jnp.nonzero(lo != n, size=size, fill_value=c)[0]
@@ -309,12 +397,30 @@ def compact_actives(lo: jax.Array, hi: jax.Array, n: int, size: int):
     return lo_ext[sel], hi_ext[sel]
 
 
+@partial(jax.jit, static_argnames=("n",))
+def count_live_distinct(lo: jax.Array, hi: jax.Array, n: int):
+    slo, shi = lax.sort((lo, hi), num_keys=2)
+    dup = (slo == jnp.roll(slo, 1)) & (shi == jnp.roll(shi, 1))
+    dup = dup.at[0].set(False)
+    live = jnp.sum(slo != n)
+    return live, live - jnp.sum(dup & (slo != n))
+
+
 def count_live(lo: jax.Array, n: int) -> int:
     return int(jnp.sum(lo != n))
 
 
-def _host_tail_finish(minp, lo, hi, pos, order, n: int, size: int,
-                      pos_host=None):
+def _order_host(pos_host, n: int):
+    """Inverse permutation of pos_host with the sentinel slot appended."""
+    import numpy as np
+
+    order_host = np.empty(n + 1, dtype=np.int64)
+    order_host[np.asarray(pos_host)] = np.arange(n, dtype=np.int64)
+    order_host[n] = n
+    return order_host
+
+
+def _host_tail_finish_pos(P, loP, hiP, n: int, size: int, pos_host):
     """Finish the fixpoint on HOST via the native core's Liu pass.
 
     The fixpoint tail is a displacement cascade — inherently sequential
@@ -329,16 +435,129 @@ def _host_tail_finish(minp, lo, hi, pos, order, n: int, size: int,
 
     from sheep_tpu.core import native
 
-    clo, chi = compact_actives(lo, hi, n, size)
+    clo, chi = compact_actives(loP, hiP, n, size, dedup=True)
     lo_np = np.asarray(clo)
     hi_np = np.asarray(chi)
     mask = lo_np != n
-    edges = np.stack([lo_np[mask], hi_np[mask]], axis=1)
-    if pos_host is None:
-        pos_host = np.asarray(pos[:n])
-    parent = minp_to_parent(minp, order, n)
+    pos_host = np.asarray(pos_host)
+    order_host = _order_host(pos_host, n)
+    edges = np.stack([order_host[lo_np[mask]], order_host[hi_np[mask]]],
+                     axis=1)
+    P_np = np.asarray(P)  # the one O(V) device->host pull
+    pp = P_np[pos_host]   # vertex-indexed parent positions
+    parent = np.where(pp < n, order_host[np.minimum(pp, n)],
+                      NO_PARENT).astype(np.int64)
     parent = native.build_elim_tree(edges, pos_host, parent)
-    return parent_to_minp(parent, pos_host, n)
+    newP = np.full(n + 1, n, dtype=np.int32)
+    has = parent >= 0
+    newP[pos_host[has]] = pos_host[parent[has]]
+    return jnp.asarray(newP)
+
+
+def fold_edges_adaptive_pos(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 2,
+    descent: str = "auto",
+    max_rounds: int = 1 << 20,
+    small_size: int = 1 << 14,
+    small_jumps: int = 16,
+    host_tail: bool = True,
+    host_tail_threshold: int = 0,
+    warm_schedule: tuple = (),
+    pos_host=None,
+    stats=None,
+):
+    """Host-driven fixpoint with active-set compaction and a host-finished
+    tail — same unique forest as :func:`fold_edges`, far less work.
+    Everything stays in position space; callers carry P across chunks and
+    convert to the vertex-space minp encoding only at phase boundaries.
+
+    Measured motivation (RMAT-18, cpu-jax): 106 of 122 rounds had < 4k
+    live constraints out of a 4.2M buffer, so >85% of build time was
+    climbing dead slots and rebuilding lifting tables for them; at
+    RMAT-20 the tail cascade alone was 6.8k rounds. Schedule:
+
+    - warm phase: ``warm_schedule`` = ((rounds, lift_levels), ...)
+      segments run FIRST with few lifting levels — on the real chip a
+      full-buffer round's cost is ~linear in lift_levels x buffer width,
+      and the bulk of the buffer retires in the first rounds without
+      needing long jumps, so cheap warm rounds + compaction shrink the
+      buffer before any full-depth round pays for it
+    - full mode: lifting-table segments on the current buffer
+    - after each segment, if live count <= size/2, compact the buffer to
+      max(small_size, 2*live) rounded up to a power of two (each size is
+      one extra compiled program; sizes shrink geometrically, so at most
+      ~log4(C) programs exist)
+    - once live <= ``host_tail_threshold`` and the native core is
+      available, finish on host (:func:`_host_tail_finish_pos`): the
+      displacement cascade is sequential work the CPU does in O(chain),
+      for one O(V) table round-trip per chunk
+    - fallback (no native core): jump-mode rounds at ``small_size`` —
+      O(C') gathers per round, independent of V
+    """
+    from sheep_tpu.core import native
+
+    use_host_tail = host_tail and native.available() and pos_host is not None
+    if stats is None:
+        stats = {}
+    total = 0
+    size = int(loP.shape[0])
+    if host_tail_threshold <= 0:
+        # auto: hand off once <= size/8 constraints remain (min 2^16) —
+        # the cpu-jax sweet spot; on a real chip device rounds are far
+        # cheaper relative to the host pass, so callers may lower it
+        host_tail_threshold = max(1 << 16, size // 8)
+    warm = list(warm_schedule)
+    while True:
+        if warm and size > small_size:
+            wrounds, wlevels = warm.pop(0)
+            seg = min(wrounds, max_rounds - total)
+            loP, hiP, P, changed, r = fold_segment_pos(
+                P, loP, hiP, n, lift_levels=wlevels,
+                segment_rounds=seg, descent="stream")
+            stats["warm_segments"] = stats.get("warm_segments", 0) + 1
+        elif size > small_size:
+            seg = min(segment_rounds, max_rounds - total)
+            loP, hiP, P, changed, r = fold_segment_pos(
+                P, loP, hiP, n, lift_levels=lift_levels,
+                segment_rounds=seg, descent=descent)
+            stats["full_segments"] = stats.get("full_segments", 0) + 1
+        else:
+            seg = min(max(segment_rounds, 64), max_rounds - total)
+            loP, hiP, P, changed, r = fold_segment_small_pos(
+                P, loP, hiP, n, jumps=small_jumps, segment_rounds=seg)
+            stats["small_segments"] = stats.get("small_segments", 0) + 1
+        total += int(r)
+        stats["device_rounds"] = stats.get("device_rounds", 0) + int(r)
+        if not bool(changed) or total >= max_rounds:
+            return P, total
+        # decisions use the cheap live count (one reduction); the
+        # duplicate collapse happens inside the dedup compactions, which
+        # run rarely — a per-segment distinct count would cost a
+        # full-buffer two-key sort every segment (measured: seconds at
+        # C=2^24 on the v5e, swamping the rounds it saved)
+        live = count_live(loP, n)
+        if use_host_tail and live <= host_tail_threshold:
+            stats["host_tails"] = stats.get("host_tails", 0) + 1
+            stats["host_tail_live"] = stats.get("host_tail_live", 0) + live
+            # size the pull by the live count, not the threshold: the
+            # tail ships two O(size) arrays over the host link
+            pull = max(1 << 14, 1 << max(1, (live - 1).bit_length()))
+            return (_host_tail_finish_pos(P, loP, hiP, n,
+                                          min(pull, size), pos_host),
+                    total)
+        if size > small_size and live <= size // 2:
+            new_size = max(small_size, 1 << max(1, (2 * live - 1)
+                                                .bit_length()))
+            if new_size < size:
+                loP, hiP = compact_actives(loP, hiP, n, new_size,
+                                           dedup=True)
+                size = new_size
+                stats["compactions"] = stats.get("compactions", 0) + 1
 
 
 def fold_edges_adaptive(
@@ -356,74 +575,28 @@ def fold_edges_adaptive(
     small_jumps: int = 16,
     host_tail: bool = True,
     host_tail_threshold: int = 0,
+    warm_schedule: tuple = (),
     pos_host=None,
     stats=None,
 ):
-    """Host-driven fixpoint with active-set compaction and a host-finished
-    tail — same unique forest as :func:`fold_edges`, far less work.
+    """Vertex-space wrapper of :func:`fold_edges_adaptive_pos` (one
+    conversion each way; same unique forest)."""
+    import numpy as np
 
-    Measured motivation (RMAT-18, cpu-jax): 106 of 122 rounds had < 4k
-    live constraints out of a 4.2M buffer, so >85% of build time was
-    climbing dead slots and rebuilding lifting tables for them; at
-    RMAT-20 the tail cascade alone was 6.8k rounds. Schedule:
-
-    - full mode: lifting-table segments on the current buffer
-    - after each segment, if live count <= size/4, compact the buffer to
-      max(small_size, 2*live) rounded up to a power of two (each size is
-      one extra compiled program; sizes shrink geometrically, so at most
-      ~log16(C) programs exist)
-    - once live <= ``host_tail_threshold`` and the native core is
-      available, finish on host (:func:`_host_tail_finish`): the
-      displacement cascade is sequential work the CPU does in O(chain),
-      for one O(V) table round-trip per chunk
-    - fallback (no native core): jump-mode rounds at ``small_size`` —
-      O(C') gathers per round, independent of V
-    """
     from sheep_tpu.core import native
 
-    use_host_tail = host_tail and native.available()
-    if stats is None:
-        stats = {}
-    total = 0
-    size = int(lo.shape[0])
-    if host_tail_threshold <= 0:
-        # auto: hand off once <= size/8 constraints remain (min 2^16) —
-        # the cpu-jax sweet spot; on a real chip device rounds are far
-        # cheaper relative to the host pass, so callers may lower it
-        host_tail_threshold = max(1 << 16, size // 8)
-    while True:
-        if size > small_size:
-            seg = min(segment_rounds, max_rounds - total)
-            lo, hi, minp, changed, r = fold_edges_segment(
-                minp, lo, hi, pos, order, n, lift_levels=lift_levels,
-                segment_rounds=seg, descent=descent)
-            stats["full_segments"] = stats.get("full_segments", 0) + 1
-        else:
-            seg = min(max(segment_rounds, 64), max_rounds - total)
-            lo, hi, minp, changed, r = fold_edges_segment_small(
-                minp, lo, hi, pos, order, n, jumps=small_jumps,
-                segment_rounds=seg)
-            stats["small_segments"] = stats.get("small_segments", 0) + 1
-        total += int(r)
-        stats["device_rounds"] = stats.get("device_rounds", 0) + int(r)
-        if not bool(changed) or total >= max_rounds:
-            return minp, total
-        live = count_live(lo, n)
-        if use_host_tail and live <= host_tail_threshold:
-            # fixed compact size -> one compiled compaction per input size
-            stats["host_tails"] = stats.get("host_tails", 0) + 1
-            stats["host_tail_live"] = stats.get("host_tail_live", 0) + live
-            return (_host_tail_finish(minp, lo, hi, pos, order, n,
-                                      min(host_tail_threshold, size),
-                                      pos_host=pos_host),
-                    total)
-        if size > small_size and live <= size // 4:
-            new_size = max(small_size, 1 << max(1, (2 * live - 1)
-                                                .bit_length()))
-            if new_size < size:
-                lo, hi = compact_actives(lo, hi, n, new_size)
-                size = new_size
-                stats["compactions"] = stats.get("compactions", 0) + 1
+    if host_tail and pos_host is None and native.available():
+        # only pulled when a host tail can actually run — this is an
+        # O(V) d2h transfer (~1 s at V=4M through the tunnel)
+        pos_host = np.asarray(pos[:n])
+    P, total = fold_edges_adaptive_pos(
+        minp[order], pos[lo], pos[hi], n, lift_levels=lift_levels,
+        segment_rounds=segment_rounds, descent=descent,
+        max_rounds=max_rounds, small_size=small_size,
+        small_jumps=small_jumps, host_tail=host_tail,
+        host_tail_threshold=host_tail_threshold,
+        warm_schedule=warm_schedule, pos_host=pos_host, stats=stats)
+    return P[pos], total
 
 
 def fold_edges_segmented(
@@ -536,19 +709,65 @@ def build_chunk_step_adaptive(
     n: int,
     lift_levels: int = 0,
     segment_rounds: int = 2,
+    warm_schedule: tuple = (),
     pos_host=None,
     stats=None,
+    **fold_opts,
 ):
     """:func:`build_chunk_step` via :func:`fold_edges_adaptive`
-    (compaction + host-finished tail) — the single-device streaming
-    path's production fold: same unique forest, bounded device
-    executions, and the sequential displacement cascade runs on host
-    instead of one link per device round."""
+    (compaction + host-finished tail) — same unique forest, bounded
+    device executions, and the sequential displacement cascade runs on
+    host instead of one link per device round."""
     clo, chi = orient_edges(chunk, pos, n)
     return fold_edges_adaptive(parent_pos, clo, chi, pos, order, n,
                                lift_levels=lift_levels,
                                segment_rounds=segment_rounds,
-                               pos_host=pos_host, stats=stats)
+                               warm_schedule=warm_schedule,
+                               pos_host=pos_host, stats=stats, **fold_opts)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def orient_edges_pos(edges: jax.Array, pos: jax.Array, n: int):
+    """(C,2) int32 edges -> oriented elimination POSITIONS (loP, hiP)
+    with loP < hiP; self-loops and out-of-range/padding endpoints become
+    the inert sentinel (n, n). pos is injective over vertices with
+    pos[n] = n, so equal positions <=> same vertex or both padding."""
+    e = edges.astype(jnp.int32)
+    u = jnp.clip(e[:, 0], 0, n)
+    v = jnp.clip(e[:, 1], 0, n)
+    pu, pv = pos[u], pos[v]
+    lo = jnp.minimum(pu, pv)
+    hi = jnp.maximum(pu, pv)
+    bad = lo == hi
+    lo = jnp.where(bad, n, lo)
+    hi = jnp.where(bad, n, hi)
+    return lo, hi
+
+
+def build_chunk_step_adaptive_pos(
+    P: jax.Array,
+    chunk: jax.Array,
+    pos: jax.Array,
+    pos_host,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 2,
+    warm_schedule: tuple = (),
+    stats=None,
+    **fold_opts,
+):
+    """One streaming step on the POSITION-SPACE carried table P — the
+    single-device production fold: the backend carries P across chunks
+    and converts to/from the vertex-space minp encoding only at phase
+    (and checkpoint) boundaries, so the steady-state loop runs zero
+    vertex<->position conversions. Extra ``fold_opts`` (e.g.
+    host_tail_threshold) forward to :func:`fold_edges_adaptive_pos`."""
+    loP, hiP = orient_edges_pos(chunk, pos, n)
+    return fold_edges_adaptive_pos(P, loP, hiP, n, lift_levels=lift_levels,
+                                   segment_rounds=segment_rounds,
+                                   warm_schedule=warm_schedule,
+                                   pos_host=pos_host, stats=stats,
+                                   **fold_opts)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels"))

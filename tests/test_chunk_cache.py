@@ -1,0 +1,110 @@
+"""Device chunk cache invariants (tpu_backend._device_chunks).
+
+The cache is budget-gated off on cpu-jax in production, so these tests
+construct _ChunkCache with explicit budgets and drive _device_chunks
+directly — the prefix/fill/resume invariants must hold no matter what
+the platform is, because a violation silently changes WHICH edges a
+pass processes (double-count or skip), not just how fast.
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.tpu_backend import (_ChunkCache, _device_chunks,
+                                            pad_chunk)
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.io.generators import rmat
+
+CS = 64
+N = 1 << 8
+
+
+@pytest.fixture()
+def stream():
+    e = rmat(8, 3, seed=21)  # 768 edges -> 12 chunks of 64
+    return EdgeStream.from_array(e, n_vertices=N)
+
+
+def _expected(stream, start=0):
+    return [pad_chunk(c, CS, N) for c in stream.chunks(CS, start_chunk=start)]
+
+
+def _collect(stream, cache, start=0):
+    return [np.asarray(d) for d in _device_chunks(stream, CS, N, cache, start)]
+
+
+def test_unlimited_budget_caches_all_and_reserves(stream):
+    cache = _ChunkCache(1 << 30)
+    first = _collect(stream, cache)
+    exp = _expected(stream)
+    assert len(first) == len(exp) and cache.complete
+    assert len(cache.chunks) == len(exp)
+    for a, b in zip(first, exp):
+        np.testing.assert_array_equal(a, b)
+    # second pass serves purely from cache, identically
+    second = _collect(stream, cache)
+    for a, b in zip(second, exp):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_partial_budget_keeps_prefix_and_streams_rest(stream):
+    chunk_bytes = CS * 2 * 4
+    cache = _ChunkCache(3 * chunk_bytes)  # room for exactly 3 chunks
+    first = _collect(stream, cache)
+    exp = _expected(stream)
+    assert len(cache.chunks) == 3 and not cache.complete
+    for a, b in zip(first, exp):
+        np.testing.assert_array_equal(a, b)
+    # second pass: 3 served from cache, rest re-streamed, order intact;
+    # the budget stays exhausted so the prefix does not grow
+    second = _collect(stream, cache)
+    assert len(second) == len(exp) and len(cache.chunks) == 3
+    for a, b in zip(second, exp):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resume_start_chunk_bypasses_cache(stream):
+    cache = _ChunkCache(1 << 30)
+    _collect(stream, cache)  # fill fully
+    got = _collect(stream, cache, start=5)
+    exp = _expected(stream, start=5)
+    assert len(got) == len(exp)
+    for a, b in zip(got, exp):
+        np.testing.assert_array_equal(a, b)
+    # bypass must not have mutated the cache
+    assert cache.complete and len(cache.chunks) == len(_expected(stream))
+
+
+def test_exception_mid_fill_leaves_valid_prefix(stream):
+    cache = _ChunkCache(1 << 30)
+    exp = _expected(stream)
+    it = _device_chunks(stream, CS, N, cache, 0)
+    for _ in range(4):  # consume 4 chunks, then abandon the pass
+        next(it)
+    it.close()
+    assert not cache.complete
+    assert 0 < len(cache.chunks) <= 5  # a valid prefix, nothing past it
+    for a, b in zip(cache.chunks, exp):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # the next full pass serves the prefix and finishes the fill
+    got = _collect(stream, cache)
+    assert len(got) == len(exp) and cache.complete
+    for a, b in zip(got, exp):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_interrupted_growth_second_pass_continues(stream):
+    chunk_bytes = CS * 2 * 4
+    cache = _ChunkCache(10 * chunk_bytes)
+    it = _device_chunks(stream, CS, N, cache, 0)
+    for _ in range(2):
+        next(it)
+    it.close()
+    k = len(cache.chunks)
+    assert 0 < k <= 3 and not cache.complete
+    got = _collect(stream, cache)
+    exp = _expected(stream)
+    assert len(got) == len(exp)
+    for a, b in zip(got, exp):
+        np.testing.assert_array_equal(a, b)
+    assert len(cache.chunks) == 10 and not cache.complete  # budget-capped

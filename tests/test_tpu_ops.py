@@ -154,6 +154,34 @@ def test_compact_actives_preserves_multiset():
     assert pairs == [(1, 0), (3, 4), (3, 4), (5, 5)]
 
 
+def test_compact_actives_dedup_drops_duplicates():
+    lo = jnp.asarray(np.array([5, 3, 5, 3, 1, 5], np.int32))
+    hi = jnp.asarray(np.array([2, 4, 2, 4, 0, 2], np.int32))
+    n = 5
+    clo, chi = elim_ops.compact_actives(lo, hi, n, 4, dedup=True)
+    pairs = sorted(zip(np.asarray(clo).tolist(), np.asarray(chi).tolist()))
+    assert pairs == [(1, 0), (3, 4), (5, 5), (5, 5)]
+    live, distinct = elim_ops.count_live_distinct(lo, hi, n)
+    assert int(live) == 3 and int(distinct) == 2
+
+
+def test_adaptive_warm_schedule_and_thresholds(graph):
+    """Warm low-lift rounds, dedup compaction, and every host-tail
+    handoff point must all produce the identical unique forest."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    padded = pad_chunk(e, len(e), n)
+    whole, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), padded, pos, order, n)
+    for warm, tail_at in [(((1, 2),), 0), (((1, 4), (1, 8)), len(e) // 2),
+                          (((2, 3),), len(e)), ((), len(e) // 2)]:
+        got, _ = elim_ops.build_chunk_step_adaptive(
+            jnp.full(n + 1, n, dtype=jnp.int32), padded, pos, order, n,
+            segment_rounds=2, warm_schedule=warm,
+            host_tail_threshold=tail_at)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(whole))
+
+
 def test_cut_pair_compact_matches_dense(graph):
     """Device-deduped cv rows must yield the same distinct key set as the
     dense pull, and the tiny-cap overflow path must fall back cleanly."""
