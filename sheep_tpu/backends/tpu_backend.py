@@ -169,7 +169,9 @@ class TpuBackend(Partitioner):
         from sheep_tpu.utils.fault import maybe_fail
 
         t = {}
-        cs = self.chunk_edges
+        # right-size the chunk for small graphs so a tiny input doesn't
+        # pad out to the full default chunk shape
+        cs = stream.clamp_chunk_edges(self.chunk_edges)
         t0 = time.perf_counter()
         n = stream.num_vertices
         meta = ckpt.stream_meta(stream, k, cs, weights=weights,

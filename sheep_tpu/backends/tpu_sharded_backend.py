@@ -36,13 +36,11 @@ class TpuShardedBackend(Partitioner):
         # O(cut pairs) accumulator on huge runs
         n = stream.num_vertices
         mesh = shards_mesh(self.n_devices)
-        # shrink the chunk so small graphs don't pad (and compile) up to the
-        # full default chunk shape — but only when the stream size is known
-        # in O(1) (binary/memory); never pay a counting pass for this
-        cs = self.chunk_edges
-        m_cheap = stream.num_edges_cheap
-        if m_cheap is not None:
-            cs = min(cs, max(1024, -(-m_cheap // mesh.devices.size)))
+        # shrink the chunk so small graphs don't pad (and compile) up to
+        # the full default chunk shape; shared helper so the backends'
+        # chunk sizing (and checkpoint fingerprints) cannot diverge
+        cs = stream.clamp_chunk_edges(self.chunk_edges,
+                                      parts=mesh.devices.size)
         pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels)
 
         timings: dict = {}

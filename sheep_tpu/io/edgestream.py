@@ -100,6 +100,31 @@ class EdgeStream:
         return None
 
     @property
+    def num_edges_upper_bound(self) -> Optional[int]:
+        """O(1) upper bound on num_edges: exact where cheap, else the
+        text-format floor of >= 4 bytes per edge line ("0 1\\n"). Used to
+        right-size chunk buffers without paying a counting pass; None
+        only for unsized generator streams."""
+        cheap = self.num_edges_cheap
+        if cheap is not None:
+            return cheap
+        if self.path is not None:
+            # +1: the last line may lack its trailing newline
+            return (os.path.getsize(self.path) + 1) // 4
+        return None
+
+    def clamp_chunk_edges(self, chunk_edges: int, parts: int = 1,
+                          floor: int = 1024) -> int:
+        """Shrink ``chunk_edges`` for small streams using the O(1) size
+        bound (shared by the single-device and sharded backends so their
+        chunk sizing — and checkpoint fingerprints — cannot diverge).
+        ``parts`` divides the bound across devices."""
+        bound = self.num_edges_upper_bound
+        if bound is None:
+            return chunk_edges
+        return min(chunk_edges, max(floor, -(-bound // parts)))
+
+    @property
     def num_vertices(self) -> int:
         """max vertex id + 1; computed by a streaming pass if not provided."""
         if self._n_vertices is None:

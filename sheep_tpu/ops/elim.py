@@ -342,27 +342,6 @@ def fold_edges_segment(
     return order[loP], order[hiP], P_f[pos], changed, rounds
 
 
-@partial(jax.jit, static_argnames=("n", "jumps", "segment_rounds"))
-def fold_edges_segment_small(
-    minp: jax.Array,
-    lo: jax.Array,
-    hi: jax.Array,
-    pos: jax.Array,
-    order: jax.Array,
-    n: int,
-    jumps: int = 8,
-    segment_rounds: int = 64,
-):
-    """Vertex-space wrapper of :func:`fold_segment_small_pos`."""
-    body = _pos_small_round_body(n, jumps)
-
-    def cond(state):
-        _, _, _, changed, rounds = state
-        return changed & (rounds < segment_rounds)
-
-    state = _init_state(minp[order], pos[lo], pos[hi])
-    loP, hiP, P_f, changed, rounds = lax.while_loop(cond, body, state)
-    return order[loP], order[hiP], P_f[pos], changed, rounds
 
 
 @partial(jax.jit, static_argnames=("n", "size", "dedup"))

@@ -179,7 +179,7 @@ class ShardedPipeline:
                  out_shardings=(self.state_sharding, self.state_sharding))
         def orient_step(batch, pos):
             def f(chunk_local, pos_):
-                lo, hi = elim_ops.orient_edges(chunk_local[0], pos_, n_)
+                lo, hi = elim_ops.orient_edges_pos(chunk_local[0], pos_, n_)
                 return lo[None], hi[None]
             return shard_map(
                 f, mesh=mesh,
@@ -189,44 +189,46 @@ class ShardedPipeline:
 
         def _make_fold_seg(small: bool):
             """Segment step over whatever active-buffer width the inputs
-            have (one compiled program per width). ``small`` selects
-            jump-mode rounds (no O(V) lifting-table rebuild) for the
-            compacted tail. Returns carried state + pmax'd
-            any-device-changed flag and max live count, replicated, so
-            every device AND process makes the same host decision."""
+            have (one compiled program per width). Everything is POSITION
+            SPACE (tables P[p] = parent position, actives = position
+            pairs), so the compiled programs carry no pos/order tables
+            and no per-segment conversion gathers — the orient step maps
+            in, and the caller converts the merged table out once.
+            ``small`` selects jump-mode rounds (no O(V) lifting-table
+            rebuild) for the compacted tail. Returns carried state +
+            pmax'd any-device-changed flag and max live count,
+            replicated, so every device AND process makes the same host
+            decision."""
             @partial(jax.jit,
                      in_shardings=(self.state_sharding, self.state_sharding,
-                                   self.state_sharding, self.repl_sharding,
-                                   self.repl_sharding),
+                                   self.state_sharding),
                      out_shardings=(self.state_sharding, self.state_sharding,
                                     self.state_sharding, self.repl_sharding,
                                     self.repl_sharding))
-            def fold_seg_step(forest_all, lo_all, hi_all, pos, order):
-                def f(forest_local, lo_local, hi_local, pos_, order_):
+            def fold_seg_step(P_all, lo_all, hi_all):
+                def f(P_local, lo_local, hi_local):
                     if small:
-                        lo2, hi2, minp, changed, _ = \
-                            elim_ops.fold_edges_segment_small(
-                                forest_local[0], lo_local[0], hi_local[0],
-                                pos_, order_, n_,
+                        lo2, hi2, Pn, changed, _ = \
+                            elim_ops.fold_segment_small_pos(
+                                P_local[0], lo_local[0], hi_local[0], n_,
                                 segment_rounds=max(seg_, 64))
                     else:
-                        lo2, hi2, minp, changed, _ = \
-                            elim_ops.fold_edges_segment(
-                                forest_local[0], lo_local[0], hi_local[0],
-                                pos_, order_, n_, lift_levels=lift,
-                                segment_rounds=seg_)
+                        lo2, hi2, Pn, changed, _ = \
+                            elim_ops.fold_segment_pos(
+                                P_local[0], lo_local[0], hi_local[0], n_,
+                                lift_levels=lift, segment_rounds=seg_)
                     any_changed = lax.pmax(changed.astype(jnp.int32),
                                            SHARD_AXIS)
                     max_live = lax.pmax(jnp.sum(lo2 != n_), SHARD_AXIS)
-                    return (minp[None], lo2[None], hi2[None], any_changed,
+                    return (Pn[None], lo2[None], hi2[None], any_changed,
                             max_live)
                 return shard_map(
                     f, mesh=mesh,
                     in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                              P(SHARD_AXIS, None), P(), P()),
+                              P(SHARD_AXIS, None)),
                     out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None),
                                P(SHARD_AXIS, None), P(), P()))(
-                        forest_all, lo_all, hi_all, pos, order)
+                        P_all, lo_all, hi_all)
             return fold_seg_step
 
         def _make_compact(to_size: int):
@@ -257,15 +259,15 @@ class ShardedPipeline:
         def _make_exchange(cap0: int, r: int):
             """One butterfly exchange round, as its own jitted step: each
             device ships its forest to its XOR partner and receives the
-            partner's as an ACTIVE CONSTRAINT buffer (lo, hi) for the
-            host-driven adaptive fold — a minp entry x -> p IS the
-            constraint "x ~ order[p] from time p".
+            partner's as an ACTIVE CONSTRAINT buffer for the host-driven
+            adaptive fold. In position space a table entry p -> P[p] IS
+            the constraint (loP=p, hiP=P[p]) — no order lookup anywhere.
 
             ``cap0`` = per-round payload capacity (entries); 0 means dense
-            (ship the whole O(V) minp table). Compact rounds ship
-            (index, value) pairs of the non-sentinel entries only —
-            SURVEY.md §7 hard part #4's O(boundary) traffic. Capacity
-            doubles per round: a merged forest has at most
+            (ship the whole O(V) table). Compact rounds ship
+            (position, parent-position) pairs of the non-sentinel entries
+            only — SURVEY.md §7 hard part #4's O(boundary) traffic.
+            Capacity doubles per round: a merged forest has at most
             count_A + count_B parent entries, so cap0 >= the initial max
             occupancy makes cap0 * 2^r sufficient for round r — checked
             on host before selecting this path. Once 2 * cap is no
@@ -276,47 +278,55 @@ class ShardedPipeline:
             compact = 2 * cap < n_ + 1
 
             @partial(jax.jit,
-                     in_shardings=(self.state_sharding, self.repl_sharding),
+                     in_shardings=(self.state_sharding,),
                      out_shardings=(self.state_sharding, self.state_sharding))
-            def exchange(forest_all, order):
-                def f(forest_local, order_):
-                    forest = forest_local[0]
+            def exchange(P_all):
+                def f(P_local):
+                    table = P_local[0]
                     idx = lax.axis_index(SHARD_AXIS)
                     valid = (idx ^ (1 << r)) < d_
                     if compact:
-                        sel = jnp.nonzero(forest[:n_] != n_, size=cap,
+                        sel = jnp.nonzero(table[:n_] != n_, size=cap,
                                           fill_value=n_)[0].astype(jnp.int32)
-                        # fill slots index the sentinel: forest[n] == n
-                        payload = jnp.stack([sel, forest[sel]])
+                        # fill slots index the sentinel: table[n] == n
+                        payload = jnp.stack([sel, table[sel]])
                         recv = lax.ppermute(payload, SHARD_AXIS, perm)
                         # out-of-range XOR partners receive zeros;
                         # neutralize to the inert (n, n) pair
                         recv = jnp.where(valid, recv, jnp.int32(n_))
-                        lo, val = recv[0], recv[1]
-                        bad = (lo >= n_) | (val >= n_)
+                        lo, hi = recv[0], recv[1]
+                        bad = (lo >= n_) | (hi >= n_)
                         lo = jnp.where(bad, n_, lo)
-                        hi = jnp.where(bad, n_,
-                                       order_[jnp.clip(val, 0, n_)])
+                        hi = jnp.where(bad, n_, hi)
                     else:
-                        other = lax.ppermute(forest, SHARD_AXIS, perm)
+                        other = lax.ppermute(table, SHARD_AXIS, perm)
                         other = jnp.where(valid, other, jnp.int32(n_))
-                        lo, hi = elim_ops.tree_edges_from_parent(
-                            other, order_, n_)
+                        p = jnp.arange(n_ + 1, dtype=jnp.int32)
+                        has = other < n_
+                        lo = jnp.where(has, p, n_)
+                        hi = jnp.where(has, other, n_)
                     return lo[None], hi[None].astype(jnp.int32)
                 return shard_map(
                     f, mesh=mesh,
-                    in_specs=(P(SHARD_AXIS, None), P()),
+                    in_specs=(P(SHARD_AXIS, None),),
                     out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
-                        forest_all, order)
+                        P_all)
             return exchange
 
         @partial(jax.jit, out_shardings=self.repl_sharding)
-        def extract_merged(forest_all):
-            return forest_all[0]
+        def extract_merged(P_all):
+            return P_all[0]
+
+        @partial(jax.jit, out_shardings=self.repl_sharding)
+        def to_minp(P_repl, pos):
+            """Replicated position-space table -> vertex-space minp (the
+            stable checkpoint/result encoding)."""
+            return P_repl[pos]
 
         self._make_exchange = _make_exchange
         self._exchange_cache: dict = {}
         self._extract_merged = extract_merged
+        self.to_minp = to_minp
 
         @partial(jax.jit, out_shardings=self.repl_sharding)
         def max_occupancy(forest_all):
@@ -347,7 +357,7 @@ class ShardedPipeline:
 
     SMALL_SIZE = 1 << 14
 
-    def _fold_actives(self, forest_all, lo_all, hi_all, pos, order):
+    def _fold_actives(self, P_all, lo_all, hi_all):
         """Adaptive host-driven fold of (D, W) active-constraint buffers
         into the per-device forests (same unique forests as a monolithic
         while_loop): compact every device's buffer to the same smaller
@@ -361,10 +371,10 @@ class ShardedPipeline:
         while True:
             step = self._fold_small if size <= self.SMALL_SIZE \
                 else self._fold_full
-            forest_all, lo_all, hi_all, changed, max_live = step(
-                forest_all, lo_all, hi_all, pos, order)
+            P_all, lo_all, hi_all, changed, max_live = step(
+                P_all, lo_all, hi_all)
             if not int(changed):
-                return forest_all
+                return P_all
             live = int(max_live)
             if size > self.SMALL_SIZE and live <= size // 4:
                 new_size = max(self.SMALL_SIZE,
@@ -377,10 +387,10 @@ class ShardedPipeline:
                     lo_all, hi_all = fn(lo_all, hi_all)
                     size = new_size
 
-    def build_step(self, forest_all, batch_dev, pos, order):
+    def build_step(self, P_all, batch_dev, pos):
         """Fold one sharded batch into the per-device forests."""
         lo_all, hi_all = self.orient_step(batch_dev, pos)
-        return self._fold_actives(forest_all, lo_all, hi_all, pos, order)
+        return self._fold_actives(P_all, lo_all, hi_all)
 
     # -- host->device placement (multi-host aware) -------------------------
     def _put(self, sharding, arr: np.ndarray):
@@ -392,8 +402,10 @@ class ShardedPipeline:
         return jax.make_array_from_process_local_data(sharding, arr)
 
     # -- adaptive tree merge (comm point 2) --------------------------------
-    def merge(self, forest_all, pos, order, stats: Optional[dict] = None):
-        """Merge the per-device forests into the global tree.
+    def merge(self, P_all, stats: Optional[dict] = None):
+        """Merge the per-device forests into the global tree (all in
+        position space; callers convert via :func:`to_minp` when they
+        need the stable vertex-space encoding).
 
         Host-driven butterfly: log2(D) rounds, each one jitted exchange
         step (ppermute of the forest — compact boundary pairs or the
@@ -412,7 +424,7 @@ class ShardedPipeline:
         """
         cap0 = 0
         if self.rounds:
-            cnt = int(self.max_occupancy(forest_all))
+            cnt = int(self.max_occupancy(P_all))
             c = max(1024, 1 << max(0, int(cnt - 1).bit_length()))
             if 2 * c < self.n + 1:
                 cap0 = c
@@ -421,10 +433,9 @@ class ShardedPipeline:
             if fn is None:
                 fn = self._exchange_cache[(cap0, r)] = \
                     self._make_exchange(cap0, r)
-            lo_all, hi_all = fn(forest_all, order)
-            forest_all = self._fold_actives(forest_all, lo_all, hi_all,
-                                            pos, order)
-        merged = self._extract_merged(forest_all)
+            lo_all, hi_all = fn(P_all)
+            P_all = self._fold_actives(P_all, lo_all, hi_all)
+        merged = self._extract_merged(P_all)
         if stats is not None:
             total = 0
             for r in range(self.rounds):
@@ -544,11 +555,15 @@ class ShardedPipeline:
         pos.block_until_ready()
         t["degrees+sort"] = time.perf_counter() - t0
 
-        # pass 2: per-device forests, then butterfly merge (comm point 2)
+        # pass 2: per-device forests, then butterfly merge (comm point 2).
+        # Device state is position-space (P tables); checkpoints and the
+        # returned forest keep the stable vertex-space minp encoding, so
+        # conversions (one replicated gather each way) happen only at
+        # checkpoint/phase boundaries.
         t0 = time.perf_counter()
         merge_stats: dict = {}
         if state and from_phase >= 2:
-            merged = jnp.asarray(state.arrays["merged"])
+            merged_minp = jnp.asarray(state.arrays["merged"])
         else:
             if state and state.phase == "build":
                 # build checkpoints store the O(V) *merged* forest, not the
@@ -560,32 +575,35 @@ class ShardedPipeline:
                 rows = self.n_local
                 fa = np.full((rows, n + 1), n, np.int32)
                 if self.proc == 0:
-                    fa[0] = state.arrays["merged_partial"]
-                forest_all = self._put(self.state_sharding, fa)
+                    # vertex-space checkpoint -> position space, host-side
+                    # (no device round-trip, no eager op on a global array)
+                    fa[0] = np.asarray(state.arrays["merged_partial"],
+                                       dtype=np.int32)[np.asarray(order)]
+                P_all = self._put(self.state_sharding, fa)
                 start = state.chunk_idx
             else:
-                forest_all = self.init_forest()
+                P_all = self.init_forest()
                 start = 0
             batches = 0
             for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
-                forest_all = self.build_step(forest_all, self.put_batch(batch),
-                                             pos, order)
+                P_all = self.build_step(P_all, self.put_batch(batch), pos)
                 batches += 1
                 maybe_fail("build", batches)
                 if checkpointer is not None and \
                         checkpointer.due_span((batches - 1) * d, batches * d):
-                    partial = np.asarray(
-                        self.merge(forest_all, pos, order, stats=merge_stats))
+                    partial = np.asarray(self.to_minp(
+                        self.merge(P_all, stats=merge_stats), pos))
                     checkpointer.save(
                         "build", start + batches * d,
                         {"deg": deg_host, "merged_partial": partial}, meta)
-            merged = self.merge(forest_all, pos, order, stats=merge_stats)
-            merged.block_until_ready()
+            merged_minp = self.to_minp(
+                self.merge(P_all, stats=merge_stats), pos)
+            np.asarray(merged_minp[:1])  # real completion barrier
         t["build+merge"] = time.perf_counter() - t0
 
         # split on host over O(V) state
         t0 = time.perf_counter()
-        parent = elim_ops.minp_to_parent(merged, order, n)
+        parent = elim_ops.minp_to_parent(merged_minp, order, n)
         pos_host = np.asarray(pos[:n])
         w = deg_host.astype(np.float64) if weights == "degree" else None
         assign_host = tree_split_host(parent, pos_host, k, weights=w, alpha=alpha)
@@ -620,7 +638,7 @@ class ShardedPipeline:
                     checkpointer.due_span((batches - 1) * d, batches * d):
                 cv_chunks = ckpt.save_score_state(
                     checkpointer, start + batches * d, cut, total, cv_chunks,
-                    {"deg": deg_host, "merged": np.asarray(merged)}, meta,
+                    {"deg": deg_host, "merged": np.asarray(merged_minp)}, meta,
                     comm_volume)
         cv = None
         if comm_volume:
