@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Poll the axon tunnel; the moment it heals, capture the post-optimization
+# evidence in one shot: focused RMAT-22 schedule sweep + headline bench.
+# The tunnel wedges for long stretches (observed twice this round), so
+# polling + immediate capture beats hoping it is up when a human looks.
+set -u
+cd "$(dirname "$0")/.."
+interval=${SHEEP_WATCH_INTERVAL:-240}
+deadline=$(( $(date +%s) + ${SHEEP_WATCH_HOURS:-10} * 3600 ))
+
+probe() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp, numpy as np
+assert int(np.asarray(jnp.sum(jnp.arange(8)))) == 28
+print('ok')" 2>/dev/null | grep -q ok
+}
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if probe; then
+    ts=$(date -u +%Y%m%dT%H%M%S)
+    out="tools/out/$ts"
+    mkdir -p "$out"
+    echo "tunnel healthy at $ts; capturing" | tee "$out/watch.log"
+    timeout 2400 python tools/tune_fixpoint.py --scale 22 --ef 16 \
+      --chunk-logs 24,23 --warm w44,w8 --segment-rounds 2 \
+      --lift-levels 0 --tail-divisors 2 \
+      >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
+    tune_rc=$?
+    timeout 3600 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
+    cat "$out/bench.json" | tee -a "$out/watch.log"
+    # success = a real measurement (bench.py emits its JSON contract even
+    # on failure, with value 0 + "error"); a mid-capture wedge (the
+    # failure mode this script exists for) keeps polling for another try
+    if [ "$tune_rc" -eq 0 ] && [ -s "$out/tune22_post.jsonl" ] && \
+       grep -q '"vs_baseline"' "$out/bench.json" && \
+       ! grep -q '"value": 0.0' "$out/bench.json"; then
+      exit 0
+    fi
+    echo "capture incomplete (tune rc=$tune_rc); resuming poll" \
+      | tee -a "$out/watch.log"
+  fi
+  sleep "$interval"
+done
+echo "deadline reached without a healthy tunnel"
+exit 1
