@@ -11,27 +11,32 @@ RMAT-30 (V=2^30) fits a v5e-8 slice at ~2.6 GiB/chip.
 With the displacement fixpoint (ops/elim.py) the build needs no partial
 trees and no merge at all: there is ONE distributed forest table, and all
 devices' active constraints fold into it concurrently through routed
-collective ops. Per fixpoint round (inside shard_map over the ``shards``
-axis):
+collective ops. Like the single-chip path, the fixpoint runs in
+POSITION SPACE: the forest table P is indexed by elimination position
+(block-sharded by position), actives are (loP, hiP) position pairs, and
+a climb step is one routed P-lookup — the vertex-space formulation
+needed a second routed order[] lookup per step and carried the vertex
+id alongside, so position space halves the climb collectives AND drops
+a third of the active-buffer traffic. Per fixpoint round (inside
+shard_map over the ``shards`` axis):
 
-  1. routed scatter-min  — all_gather the (lo, pos[hi]) requests; each
-     owner folds the requests hitting its block into its minp shard and
+  1. routed scatter-min  — all_gather the (loP, hiP) requests; each
+     owner folds the requests hitting its block into its P shard and
      answers (pre-round, post-round) parent positions; answers ride one
      all_to_all back and combine with jnp.min (non-owners answer the
      sentinel n = +inf).
-  2. routed gather       — order[p] / minp[x] lookups for the climb and
-     for displaced-constraint construction, same gather/answer/min
-     pattern (``jumps`` single-step climbs per round instead of the
-     single-chip path's binary-lifting tables, which would be V-sized).
+  2. routed gather       — P[p] lookups for the climb (``jumps``
+     single-step climbs per round instead of the single-chip path's
+     binary-lifting tables, which would be V-sized).
   3. local rewrite       — retire / displace-in-place / climb, exactly
      the single-chip displacement rules; liveness is a psum, so the
      while_loop terminates collectively.
 
 The elimination order is computed on HOST (numpy argsort over the int64
 degree table — hosts hold hundreds of GB; one sort per run, amortized
-over the whole stream) and only the pos/order block shards are pushed to
-devices. The split likewise runs on host over the O(V) parent array
-(native C++). Degrees accumulate into a block-sharded table via the same
+over the whole stream) and only the pos block shard is pushed to
+devices (position space needs no device-side order table). The split
+likewise runs on host over the O(V) parent array (native C++). Degrees accumulate into a block-sharded table via the same
 routed scatter pattern, and scoring resolves part lookups against a
 block-sharded assignment table with the routed gather — NO vertex-indexed
 device state is replicated anywhere in the pipeline, so per-device memory
@@ -121,9 +126,9 @@ class BigVPipeline:
             mine = lax.all_to_all(part, SHARD_AXIS, 0, 0)
             return jnp.min(mine, axis=0)                # (Q,)
 
-        def _scatter_min(minp_local, lo, val):
+        def _scatter_min(table_local, lo, val):
             """Fold (lo -> val) requests from EVERY device into the
-            distributed table; returns (new_minp_local, old, new) where
+            distributed table; returns (new_table_local, old, new) where
             old/new are the pre-/post-round parent positions at each of
             THIS device's requests."""
             glo = lax.all_gather(lo, SHARD_AXIS)        # (D, Q)
@@ -132,10 +137,10 @@ class BigVPipeline:
             local = glo - me * B
             ok = (local >= 0) & (local < B)
             idx = jnp.where(ok, local, B)               # B = dropped
-            new_local = minp_local.at[idx.ravel()].min(
+            new_local = table_local.at[idx.ravel()].min(
                 gval.ravel(), mode="drop")
             lidx = jnp.clip(local, 0, B - 1)
-            old_part = jnp.where(ok, minp_local[lidx], jnp.int32(n_))
+            old_part = jnp.where(ok, table_local[lidx], jnp.int32(n_))
             new_part = jnp.where(ok, new_local[lidx], jnp.int32(n_))
             old = jnp.min(lax.all_to_all(old_part, SHARD_AXIS, 0, 0), axis=0)
             new = jnp.min(lax.all_to_all(new_part, SHARD_AXIS, 0, 0), axis=0)
@@ -171,109 +176,96 @@ class BigVPipeline:
 
         @partial(jax.jit,
                  in_shardings=(self.shard, self.batch_sharding),
-                 out_shardings=(act, act, act))
+                 out_shardings=(act, act))
         def orient_step(pos_sh, batch):
-            """Resolve a batch's endpoints to oriented active constraints
-            (lo, polo, poshi); carrying lo's own position makes loop
-            detection local (polo == poshi)."""
+            """Resolve a batch's endpoints to oriented POSITION-PAIR
+            constraints (loP, hiP); loop detection is local
+            (loP == hiP -> inert)."""
             def f(pos_local, chunk_local):
                 chunk = chunk_local[0]
                 u = jnp.clip(chunk[:, 0], 0, n_)
                 v = jnp.clip(chunk[:, 1], 0, n_)
                 pu = _lookup(pos_local, u)
                 pv = _lookup(pos_local, v)
-                lo = jnp.where(pu <= pv, u, v).astype(jnp.int32)
-                polo = jnp.minimum(pu, pv).astype(jnp.int32)
-                poshi = jnp.maximum(pu, pv).astype(jnp.int32)
+                lo = jnp.minimum(pu, pv).astype(jnp.int32)
+                hi = jnp.maximum(pu, pv).astype(jnp.int32)
                 bad = (pu == pv) | (pu == n_) | (pv == n_)
                 lo = jnp.where(bad, n_, lo)
-                polo = jnp.where(bad, n_, polo)
-                poshi = jnp.where(bad, n_, poshi)
-                return lo[None], polo[None], poshi[None]
+                hi = jnp.where(bad, n_, hi)
+                return lo[None], hi[None]
             return shard_map(
                 f, mesh=mesh,
                 in_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None, None)),
-                out_specs=(P(SHARD_AXIS, None),) * 3)(pos_sh, batch)
+                out_specs=(P(SHARD_AXIS, None),) * 2)(pos_sh, batch)
 
         seg_ = self.segment_rounds
 
         @partial(jax.jit,
-                 in_shardings=(self.shard, self.shard, act, act, act),
-                 out_shardings=(self.shard, act, act, act, self.repl,
+                 in_shardings=(self.shard, act, act),
+                 out_shardings=(self.shard, act, act, self.repl,
                                 self.repl, self.repl))
-        def fold_seg_step(minp_sh, order_sh, lo_all, polo_all, poshi_all):
+        def fold_seg_step(P_sh, lo_all, hi_all):
             """At most ``segment_rounds`` routed fixpoint rounds in one
             device execution; the psum'd live count is the collective
             continue signal, identical on every device/process, so the
-            host loop segment boundaries stay in lockstep."""
-            def f(minp_local, order_local, lo_l, polo_l, poshi_l):
-                lo0, polo0, poshi0 = lo_l[0], polo_l[0], poshi_l[0]
+            host loop segment boundaries stay in lockstep. Same
+            retire/displace/climb semantics as the single-chip
+            _pos_small_round_body, with the table lookups routed."""
+            def f(P_local, lo_l, hi_l):
+                lo0, hi0 = lo_l[0], hi_l[0]
 
                 def body(state):
-                    lo_, polo_, poshi_, minp_l, _, rounds = state
-                    minp_l, old, new = _scatter_min(minp_l, lo_, poshi_)
-                    # one order[] lookup answers the climb target
-                    # order[new]; the displaced constraint reuses it too
-                    m_vtx = _lookup(order_local, new)
+                    lo_, hi_, P_l, _, rounds = state
+                    P_l, old, new = _scatter_min(P_l, lo_, hi_)
 
-                    retire = poshi_ == new
+                    retire = hi_ == new
                     displaced = retire & (new < old) & (old < n_)
 
                     # climb: first step from the scatter reply, further
-                    # single steps via routed minp/order lookups
-                    can0 = new < poshi_
-                    cur_lo = jnp.where(can0, m_vtx, lo_)
-                    cur_po = jnp.where(can0, new, polo_)
+                    # single steps via routed P lookups (one collective
+                    # pair per step — position space needs no order[])
+                    can0 = new < hi_
+                    cur = jnp.where(can0, new, lo_)
                     for _ in range(jumps_ - 1):
-                        p_next = _lookup(minp_l, cur_lo)
-                        v_next = _lookup(order_local, p_next)
-                        can = p_next < poshi_
-                        cur_lo = jnp.where(can, v_next, cur_lo)
-                        cur_po = jnp.where(can, p_next, cur_po)
-                    became_loop = cur_po == poshi_
-                    climb_lo = jnp.where(became_loop, n_, cur_lo)
-                    climb_po = jnp.where(became_loop, n_, cur_po)
-                    climb_ph = jnp.where(became_loop, n_, poshi_)
+                        p_next = _lookup(P_l, cur)
+                        cur = jnp.where(p_next < hi_, p_next, cur)
+                    became_loop = cur == hi_
+                    climb_lo = jnp.where(became_loop, n_, cur)
+                    climb_hi = jnp.where(became_loop, n_, hi_)
 
-                    # displaced constraint (order[new] ~ old-parent from
-                    # time old): lo = m_vtx at position new, poshi = old
+                    # displaced constraint: (new, old-parent position)
                     out_lo = jnp.where(
-                        retire, jnp.where(displaced, m_vtx, n_),
-                        climb_lo).astype(jnp.int32)
-                    out_po = jnp.where(
                         retire, jnp.where(displaced, new, n_),
-                        climb_po).astype(jnp.int32)
-                    out_ph = jnp.where(
+                        climb_lo).astype(jnp.int32)
+                    out_hi = jnp.where(
                         retire, jnp.where(displaced, old, n_),
-                        climb_ph).astype(jnp.int32)
+                        climb_hi).astype(jnp.int32)
                     live = lax.psum(jnp.sum(out_lo != n_), SHARD_AXIS)
-                    return out_lo, out_po, out_ph, minp_l, live, rounds + 1
+                    return out_lo, out_hi, P_l, live, rounds + 1
 
                 def cond(state):
-                    _, _, _, _, live, rounds = state
+                    _, _, _, live, rounds = state
                     return (live > 0) & (rounds < seg_)
 
                 live0 = lax.psum(jnp.sum(lo0 != n_), SHARD_AXIS)
-                state = (lo0, polo0, poshi0, minp_local, live0,
+                state = (lo0, hi0, P_local, live0,
                          (live0 * 0).astype(jnp.int32))
-                lo_f, polo_f, poshi_f, minp_f, live_f, rounds = \
+                lo_f, hi_f, P_f, live_f, rounds = \
                     lax.while_loop(cond, body, state)
                 max_live = lax.pmax(jnp.sum(lo_f != n_), SHARD_AXIS)
-                return (minp_f, lo_f[None], polo_f[None], poshi_f[None],
+                return (P_f, lo_f[None], hi_f[None],
                         live_f, lax.pmax(rounds, SHARD_AXIS), max_live)
 
             return shard_map(
                 f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS), P(SHARD_AXIS),
-                          P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                          P(SHARD_AXIS, None)),
+                in_specs=(P(SHARD_AXIS),
+                          P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
                 out_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None),
-                           P(SHARD_AXIS, None), P(SHARD_AXIS, None),
-                           P(), P(), P()))(
-                    minp_sh, order_sh, lo_all, polo_all, poshi_all)
+                           P(SHARD_AXIS, None), P(), P(), P()))(
+                    P_sh, lo_all, hi_all)
 
         def _make_compact(to_size: int):
-            """Pack each device's live (lo, polo, poshi) actives into a
+            """Pack each device's live (loP, hiP) actives into a
             (D, to_size) buffer (valid when every device's live count <=
             to_size — the caller checks the pmax). Shrinking Q directly
             shrinks every routed collective: all_gather/all_to_all ship
@@ -281,23 +273,22 @@ class BigVPipeline:
             act = NamedSharding(mesh, P(SHARD_AXIS, None))
 
             @partial(jax.jit,
-                     in_shardings=(act, act, act),
-                     out_shardings=(act, act, act))
-            def compact_step(lo_all, polo_all, poshi_all):
-                def f(lo_l, polo_l, poshi_l):
+                     in_shardings=(act, act),
+                     out_shardings=(act, act))
+            def compact_step(lo_all, hi_all):
+                def f(lo_l, hi_l):
                     lo0 = lo_l[0]
                     c = lo0.shape[0]
                     sel = jnp.nonzero(lo0 != n_, size=to_size,
                                       fill_value=c)[0]
                     ext = lambda a: jnp.concatenate(
                         [a, jnp.full(1, n_, a.dtype)])[sel]
-                    return (ext(lo0)[None], ext(polo_l[0])[None],
-                            ext(poshi_l[0])[None])
+                    return (ext(lo0)[None], ext(hi_l[0])[None])
                 return shard_map(
                     f, mesh=mesh,
-                    in_specs=(P(SHARD_AXIS, None),) * 3,
-                    out_specs=(P(SHARD_AXIS, None),) * 3)(
-                        lo_all, polo_all, poshi_all)
+                    in_specs=(P(SHARD_AXIS, None),) * 2,
+                    out_specs=(P(SHARD_AXIS, None),) * 2)(
+                        lo_all, hi_all)
             return compact_step
 
         # ---- scoring (block-sharded assignment, routed part lookups;
@@ -332,23 +323,23 @@ class BigVPipeline:
 
     MIN_Q = 1 << 12
 
-    def build_step(self, minp_sh, pos_sh, order_sh, batch_dev):
+    def build_step(self, P_sh, pos_sh, batch_dev):
         """Fold one sharded batch into the distributed forest via
-        host-bounded segments. Returns (minp_sh, total_rounds) — identical
+        host-bounded segments. Returns (P_sh, total_rounds) — identical
         to running the whole fixpoint in one execution, but no single
         device call exceeds ``segment_rounds`` rounds, and the active
         buffers compact to the pmax live width as the set collapses (every
         routed collective ships D*Q words, so smaller Q = proportionally
         less ICI/DCN traffic per tail round)."""
-        lo_a, polo_a, poshi_a = self.orient_step(pos_sh, batch_dev)
+        lo_a, hi_a = self.orient_step(pos_sh, batch_dev)
         size = int(lo_a.shape[-1])
         total = 0
         while True:
-            minp_sh, lo_a, polo_a, poshi_a, live, r, max_live = \
-                self.fold_seg_step(minp_sh, order_sh, lo_a, polo_a, poshi_a)
+            P_sh, lo_a, hi_a, live, r, max_live = \
+                self.fold_seg_step(P_sh, lo_a, hi_a)
             total += int(r)
             if int(live) == 0 or total >= self.max_rounds:
-                return minp_sh, total
+                return P_sh, total
             ml = int(max_live)
             if size > self.MIN_Q and ml <= size // 4:
                 new_size = max(self.MIN_Q,
@@ -358,7 +349,7 @@ class BigVPipeline:
                     if fn is None:
                         fn = self._compact_cache[new_size] = \
                             self._make_compact(new_size)
-                    lo_a, polo_a, poshi_a = fn(lo_a, polo_a, poshi_a)
+                    lo_a, hi_a = fn(lo_a, hi_a)
                     size = new_size
 
     # ---- host-side helpers ----------------------------------------------
@@ -409,7 +400,7 @@ class BigVPipeline:
         """Full vertex-sharded partition run.
 
         Checkpoint state is the per-process LOCAL block (deg_local int64,
-        minp_local int32 — O(V/P) per process, the bigv scaling story
+        ptable_local int32 — O(V/P) per process, the bigv scaling story
         carried through to recovery); the cadence/fingerprint/reconcile
         machinery is shared with the other backends (utils/checkpoint)."""
         from sheep_tpu.core import pure
@@ -430,8 +421,15 @@ class BigVPipeline:
                 start_chunk=start_chunk,
                 byte_range=use_byte_range(stream, self.procs)))
 
+        # state_format "bigv-pos": the checkpointed table block is now
+        # POSITION-indexed; the format bump makes --resume against a
+        # checkpoint written by the old vertex-indexed layout raise a
+        # fingerprint mismatch (collectively, in multi-host) instead of
+        # resuming into silently-wrong state; runs without --resume
+        # start fresh as always
         meta = ckpt.stream_meta(stream, k, cs, weights=weights, alpha=alpha,
-                                comm_volume=comm_volume, state_format="bigv",
+                                comm_volume=comm_volume,
+                                state_format="bigv-pos",
                                 devices=d, procs=self.procs,
                                 text_byte_range=use_byte_range(
                                     stream, self.procs))
@@ -473,34 +471,31 @@ class BigVPipeline:
         deg_host = self._allgather_table(deg_local)[:n]
 
         # host-side elimination order: one argsort over (deg, id); hosts
-        # hold hundreds of GB, and the sort is once per run
+        # hold hundreds of GB, and the sort is once per run. Only pos is
+        # pushed to devices — position space needs no order table there.
         pos_np = pure.elimination_order(deg_host)
         order_np = np.full(n + 1, n, dtype=np.int64)
         order_np[pos_np] = np.arange(n)
         pos_sh = self._shard_table(
             np.concatenate([pos_np, [n]]).astype(np.int32))
-        order_sh = self._shard_table(order_np.astype(np.int32))
         t["degrees+sort"] = time.perf_counter() - t0
 
-        # pass 2: the single distributed forest
+        # pass 2: the single distributed forest (position-indexed table)
         t0 = time.perf_counter()
         total_rounds = 0
         if state and from_phase >= 2:
-            minp_local = state.arrays["minp_local"]
-            minp_sh = self._put(self.shard, minp_local)
+            P_sh = self._put(self.shard, state.arrays["ptable_local"])
         else:
             if state and state.phase == "build":
-                minp_sh = self._put(self.shard,
-                                    state.arrays["minp_local"])
+                P_sh = self._put(self.shard, state.arrays["ptable_local"])
                 start = state.chunk_idx
             else:
-                minp_sh = self._shard_table(np.full(n + 1, n, np.int32))
+                P_sh = self._shard_table(np.full(n + 1, n, np.int32))
                 start = 0
             nb = 0
             for batch in batches(start):
-                minp_sh, rounds = self.build_step(
-                    minp_sh, pos_sh, order_sh,
-                    self._put(self.batch_sharding, batch))
+                P_sh, rounds = self.build_step(
+                    P_sh, pos_sh, self._put(self.batch_sharding, batch))
                 total_rounds += rounds
                 nb += 1
                 maybe_fail("build", nb)
@@ -509,15 +504,16 @@ class BigVPipeline:
                     checkpointer.save(
                         "build", start + nb * d,
                         {"deg_local": deg_local,
-                         "minp_local": self._local_block(minp_sh)}, meta)
-        minp_host = self._allgather_table(
-            self._local_block(minp_sh))[: n + 1]
+                         "ptable_local": self._local_block(P_sh)}, meta)
+        P_host = self._allgather_table(
+            self._local_block(P_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
 
-        # split on host over O(V) state (native C++)
+        # split on host over O(V) state (native C++); position-indexed
+        # table -> vertex parent array: parent[v] = order[P[pos[v]]]
         t0 = time.perf_counter()
-        minp_v = minp_host[:n]
-        parent = np.where(minp_v < n, order_np[np.minimum(minp_v, n)], -1)
+        pp = P_host[pos_np]
+        parent = np.where(pp < n, order_np[np.minimum(pp, n)], -1)
         w = deg_host.astype(np.float64) if weights == "degree" else None
         assign_host = tree_split_host(parent, pos_np, k, weights=w,
                                       alpha=alpha)
@@ -555,7 +551,7 @@ class BigVPipeline:
                 cv_chunks = ckpt.save_score_state(
                     checkpointer, start + nb * d, cut, total, cv_chunks,
                     {"deg_local": deg_local,
-                     "minp_local": self._local_block(minp_sh)}, meta,
+                     "ptable_local": self._local_block(P_sh)}, meta,
                     comm_volume)
         cv = None
         if comm_volume:
