@@ -190,6 +190,22 @@ def _init_state(minp, lo, hi):
             minp.astype(jnp.int32), changed0, rounds0)
 
 
+def _run_segment(body, P, loP, hiP, n: int, segment_rounds: int):
+    """Shared segment epilogue: bounded while_loop + the packed int32[3]
+    stats vector (changed, rounds, live) — the cross-module contract
+    read by the adaptive driver (one host pull) and the sharded
+    pipeline (sv[0]/sv[2] pmax)."""
+    def cond(state):
+        _, _, _, changed, rounds = state
+        return changed & (rounds < segment_rounds)
+
+    loP, hiP, P, changed, rounds = lax.while_loop(
+        cond, body, _init_state(P, loP, hiP))
+    stats = jnp.stack([changed.astype(jnp.int32), rounds,
+                       jnp.sum(loP != n, dtype=jnp.int32)])
+    return loP, hiP, P, stats
+
+
 @partial(jax.jit, static_argnames=("n", "lift_levels", "segment_rounds",
                                    "descent"))
 def fold_segment_pos(
@@ -203,19 +219,17 @@ def fold_segment_pos(
 ):
     """At most ``segment_rounds`` rounds in ONE device execution, entirely
     in position space — the production hot path (no pos/order tables in
-    the compiled program at all). Returns the full loop state
-    (loP, hiP, P, changed, rounds) so a host driver resumes where the
-    segment stopped; bounding rounds per execution keeps accelerator
-    calls short (long single executions tripped the TPU worker watchdog
-    in round 2's first bench attempt)."""
+    the compiled program at all). Returns (loP, hiP, P, stats) where
+    ``stats`` is int32[3] = (changed, rounds, live): packing the three
+    control scalars into one vector lets the host driver read them with
+    a SINGLE device pull per segment — each pull is a full round-trip
+    (~73 ms on the tunneled bench chip), and the driver needs all three
+    every segment. Bounding rounds per execution keeps accelerator calls
+    short (long single executions tripped the TPU worker watchdog in
+    round 2's first bench attempt)."""
     lift_levels, descent = _resolve(n, lift_levels, descent)
     body = _pos_round_body(n, lift_levels, descent)
-
-    def cond(state):
-        _, _, _, changed, rounds = state
-        return changed & (rounds < segment_rounds)
-
-    return lax.while_loop(cond, body, _init_state(P, loP, hiP))
+    return _run_segment(body, P, loP, hiP, n, segment_rounds)
 
 
 def _pos_small_round_body(n: int, jumps: int):
@@ -263,14 +277,10 @@ def fold_segment_small_pos(
     jumps: int = 8,
     segment_rounds: int = 64,
 ):
-    """Bounded segment of jump-mode rounds (see _pos_small_round_body)."""
+    """Bounded segment of jump-mode rounds (see _pos_small_round_body).
+    Same (loP, hiP, P, stats) contract as :func:`fold_segment_pos`."""
     body = _pos_small_round_body(n, jumps)
-
-    def cond(state):
-        _, _, _, changed, rounds = state
-        return changed & (rounds < segment_rounds)
-
-    return lax.while_loop(cond, body, _init_state(P, loP, hiP))
+    return _run_segment(body, P, loP, hiP, n, segment_rounds)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels", "max_rounds", "descent"))
@@ -357,11 +367,13 @@ def compact_actives(lo: jax.Array, hi: jax.Array, n: int, size: int,
     ``dedup`` additionally drops duplicate (lo, hi) pairs first via one
     two-key sort: after a few rounds many slots have been rewritten to
     the same (ancestor, hi) constraint. The production driver sizes the
-    target from the cheap pre-dedup :func:`count_live` (a per-segment
-    distinct count would cost a full-buffer sort each segment — measured
-    seconds at C=2^24 on the v5e); the count is an upper bound on the
-    distinct count, so the size is always sufficient.
-    :func:`count_live_distinct` exists for diagnostics/tests."""
+    target from the cheap pre-dedup live count, which every segment
+    program returns in its packed stats vector (:func:`fold_segment_pos`)
+    — a per-segment distinct count would cost a full-buffer sort each
+    segment (measured: seconds at C=2^24 on the v5e). The live count is
+    an upper bound on the distinct count, so the size is always
+    sufficient. :func:`count_live_distinct` exists for
+    diagnostics/tests."""
     if dedup:
         lo, hi = lax.sort((lo, hi), num_keys=2)
         dup = (lo == jnp.roll(lo, 1)) & (hi == jnp.roll(hi, 1))
@@ -385,8 +397,6 @@ def count_live_distinct(lo: jax.Array, hi: jax.Array, n: int):
     return live, live - jnp.sum(dup & (slo != n))
 
 
-def count_live(lo: jax.Array, n: int) -> int:
-    return int(jnp.sum(lo != n))
 
 
 def _order_host(pos_host, n: int):
@@ -490,36 +500,39 @@ def fold_edges_adaptive_pos(
         # the cpu-jax sweet spot; on a real chip device rounds are far
         # cheaper relative to the host pass, so callers may lower it
         host_tail_threshold = max(1 << 16, size // 8)
+    import numpy as np
+
     warm = list(warm_schedule)
     while True:
         if warm and size > small_size:
             wrounds, wlevels = warm.pop(0)
             seg = min(wrounds, max_rounds - total)
-            loP, hiP, P, changed, r = fold_segment_pos(
+            loP, hiP, P, sv = fold_segment_pos(
                 P, loP, hiP, n, lift_levels=wlevels,
                 segment_rounds=seg, descent="stream")
             stats["warm_segments"] = stats.get("warm_segments", 0) + 1
         elif size > small_size:
             seg = min(segment_rounds, max_rounds - total)
-            loP, hiP, P, changed, r = fold_segment_pos(
+            loP, hiP, P, sv = fold_segment_pos(
                 P, loP, hiP, n, lift_levels=lift_levels,
                 segment_rounds=seg, descent=descent)
             stats["full_segments"] = stats.get("full_segments", 0) + 1
         else:
             seg = min(max(segment_rounds, 64), max_rounds - total)
-            loP, hiP, P, changed, r = fold_segment_small_pos(
+            loP, hiP, P, sv = fold_segment_small_pos(
                 P, loP, hiP, n, jumps=small_jumps, segment_rounds=seg)
             stats["small_segments"] = stats.get("small_segments", 0) + 1
-        total += int(r)
-        stats["device_rounds"] = stats.get("device_rounds", 0) + int(r)
-        if not bool(changed) or total >= max_rounds:
-            return P, total
-        # decisions use the cheap live count (one reduction); the
+        # ONE device pull per segment for all three control scalars
+        # (each pull is a full round-trip on a tunneled device); the
         # duplicate collapse happens inside the dedup compactions, which
         # run rarely — a per-segment distinct count would cost a
         # full-buffer two-key sort every segment (measured: seconds at
         # C=2^24 on the v5e, swamping the rounds it saved)
-        live = count_live(loP, n)
+        changed, r, live = (int(x) for x in np.asarray(sv))
+        total += r
+        stats["device_rounds"] = stats.get("device_rounds", 0) + r
+        if not changed or total >= max_rounds:
+            return P, total
         if use_host_tail and live <= host_tail_threshold:
             stats["host_tails"] = stats.get("host_tails", 0) + 1
             stats["host_tail_live"] = stats.get("host_tail_live", 0) + live

@@ -36,7 +36,8 @@ The elimination order is computed on HOST (numpy argsort over the int64
 degree table — hosts hold hundreds of GB; one sort per run, amortized
 over the whole stream) and only the pos block shard is pushed to
 devices (position space needs no device-side order table). The split
-likewise runs on host over the O(V) parent array (native C++). Degrees accumulate into a block-sharded table via the same
+likewise runs on host over the O(V) parent array (native C++).
+Degrees accumulate into a block-sharded table via the same
 routed scatter pattern, and scoring resolves part lookups against a
 block-sharded assignment table with the routed gather — NO vertex-indexed
 device state is replicated anywhere in the pipeline, so per-device memory

@@ -208,18 +208,18 @@ class ShardedPipeline:
             def fold_seg_step(P_all, lo_all, hi_all):
                 def f(P_local, lo_local, hi_local):
                     if small:
-                        lo2, hi2, Pn, changed, _ = \
+                        lo2, hi2, Pn, sv = \
                             elim_ops.fold_segment_small_pos(
                                 P_local[0], lo_local[0], hi_local[0], n_,
                                 segment_rounds=max(seg_, 64))
                     else:
-                        lo2, hi2, Pn, changed, _ = \
+                        lo2, hi2, Pn, sv = \
                             elim_ops.fold_segment_pos(
                                 P_local[0], lo_local[0], hi_local[0], n_,
                                 lift_levels=lift, segment_rounds=seg_)
-                    any_changed = lax.pmax(changed.astype(jnp.int32),
-                                           SHARD_AXIS)
-                    max_live = lax.pmax(jnp.sum(lo2 != n_), SHARD_AXIS)
+                    # sv = (changed, rounds, live) computed in-program
+                    any_changed = lax.pmax(sv[0], SHARD_AXIS)
+                    max_live = lax.pmax(sv[2], SHARD_AXIS)
                     return (Pn[None], lo2[None], hi2[None], any_changed,
                             max_live)
                 return shard_map(
