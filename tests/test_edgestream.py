@@ -154,3 +154,34 @@ def test_memory_stream():
     assert es.num_edges == 78
     assert es.num_vertices == 34
     np.testing.assert_array_equal(es.read_all(), e)
+
+
+class TestSizeBounds:
+    def test_upper_bound_exact_for_binary(self, tmp_path):
+        e = np.array([[0, 1], [1, 2], [2, 3]], np.int64)
+        p = str(tmp_path / "g.bin32")
+        formats.write_edges(p, e)
+        es = EdgeStream.open(p)
+        assert es.num_edges_upper_bound == 3
+
+    def test_upper_bound_covers_text_without_trailing_newline(self, tmp_path):
+        # minimal 4-byte lines, last line unterminated: 7 bytes, 2 edges;
+        # the bound must still be >= the true count (review r2 finding)
+        p = tmp_path / "g.edges"
+        p.write_bytes(b"0 1\n0 1")
+        es = EdgeStream.open(str(p))
+        assert es.num_edges_upper_bound >= es.num_edges == 2
+
+    def test_upper_bound_none_for_unsized_generator(self):
+        es = EdgeStream.from_generator(
+            lambda: iter([np.array([[0, 1]], np.int64)]), n_vertices=2)
+        assert es.num_edges_upper_bound is None
+        assert es.clamp_chunk_edges(1 << 20) == 1 << 20
+
+    def test_clamp_chunk_edges(self, tmp_path):
+        e = np.arange(2000, dtype=np.int64).reshape(1000, 2)
+        es = EdgeStream.from_array(e, n_vertices=2000)
+        assert es.clamp_chunk_edges(1 << 20) == 1024  # floor
+        assert es.clamp_chunk_edges(1 << 20, floor=100) == 1000
+        assert es.clamp_chunk_edges(1 << 20, parts=4, floor=100) == 250
+        assert es.clamp_chunk_edges(512) == 512  # never grows

@@ -170,6 +170,15 @@ def main():
     s = timeit(sm, table, idx_c, vals)
     report("scatter_min_C_into_V", s, 4 * (2 * c + 2 * (n + 1)))
 
+    # 3b. sorts at active-buffer shapes — the cost of dedup compaction
+    # and of any sort-based alternative to scatter/gather
+    srt = jax.jit(lambda i: jax.lax.sort(i))
+    s = timeit(srt, idx_c)
+    report("sort_C_int32", s, 4 * 2 * c)
+    srt2 = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=2))
+    s = timeit(srt2, idx_c, vals)
+    report("sort2key_C_int32", s, 4 * 4 * c)
+
     # 4. streaming copy baseline (pure-bandwidth reference point)
     cp = jax.jit(lambda t: t + 1)
     big = jnp.zeros(max(n + 1, c), jnp.int32)

@@ -34,11 +34,14 @@ def log(*a):
 
 WARM_SCHEDULES = {
     "none": (),
+    "w1": ((1, 1),),     # near-pure retire round (scatter + 1-step climb)
+    "w11": ((2, 1),),
     "w4": ((1, 4),),
     "w44": ((2, 4),),
     "w48": ((1, 4), (1, 8)),
     "w248": ((1, 2), (1, 4), (1, 8)),
     "w8": ((1, 8),),
+    "w88": ((2, 8),),
 }
 
 
