@@ -35,6 +35,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="vertex weights for balance (default unit)")
     p.add_argument("--alpha", type=float, default=1.0,
                    help="bag capacity factor for the tree split (default 1.0)")
+    p.add_argument("--segment-rounds", type=int, default=None,
+                   help="fixpoint rounds per device execution (tpu "
+                        "backend; default 2 — tuned on the v5e)")
+    p.add_argument("--warm-schedule", default=None, metavar="R:L[,R:L...]",
+                   help="low-lift warm rounds before full-depth rounds, "
+                        "e.g. '1:8' (the tpu backend's tuned default) or "
+                        "'' to disable")
+    p.add_argument("--host-tail-threshold", type=int, default=None,
+                   help="hand the fixpoint tail to the native host core "
+                        "at this live-constraint count (tpu backend; "
+                        "default: chunk/2 on accelerators, auto on cpu)")
+    p.add_argument("--no-cache-chunks", action="store_true",
+                   help="disable the device-resident edge-chunk cache "
+                        "(tpu backend re-streams each pass)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
     p.add_argument("--refine", type=int, default=0, metavar="N",
@@ -69,6 +83,23 @@ def build_parser() -> argparse.ArgumentParser:
     mh.add_argument("--process-id", type=int, default=None,
                     help="this process's rank in [0, num_processes)")
     return p
+
+
+def _parse_warm_schedule(spec: str, parser) -> tuple:
+    """'R:L[,R:L...]' -> ((R, L), ...); '' -> (); malformed input is an
+    argparse error at parse time, not a mid-partition crash."""
+    out = []
+    for part in spec.split(","):
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) != 2 or not all(b.lstrip("-").isdigit() for b in bits):
+            parser.error(f"--warm-schedule: expected R:L pairs, got {part!r}")
+        rounds, levels = int(bits[0]), int(bits[1])
+        if rounds < 1 or levels < 1:
+            parser.error(f"--warm-schedule: R and L must be >= 1 in {part!r}")
+        out.append((rounds, levels))
+    return tuple(out)
 
 
 def main(argv=None) -> int:
@@ -140,11 +171,30 @@ def main(argv=None) -> int:
         ctor = {"alpha": args.alpha}
         if args.chunk_edges:
             ctor["chunk_edges"] = args.chunk_edges
-        try:
-            be = get_backend(backend, **ctor)
-        except TypeError:
-            be = get_backend(backend, **({"chunk_edges": args.chunk_edges}
-                                         if args.chunk_edges else {}))
+        if args.segment_rounds is not None:
+            ctor["segment_rounds"] = args.segment_rounds
+        if args.warm_schedule is not None:
+            ctor["warm_schedule"] = _parse_warm_schedule(
+                args.warm_schedule, parser)
+        if args.host_tail_threshold is not None:
+            ctor["host_tail_threshold"] = args.host_tail_threshold
+        if args.no_cache_chunks:
+            ctor["cache_chunks"] = False
+        # keep only the options this backend's constructor names; warn
+        # about the rest instead of silently changing the run (the
+        # tuning knobs are tpu-backend-only; alpha/chunk_edges are
+        # universal and always survive the filter)
+        import inspect
+
+        from sheep_tpu.backends.base import _REGISTRY
+
+        sig = inspect.signature(_REGISTRY[backend].__init__)
+        accepted = {k: v for k, v in ctor.items() if k in sig.parameters}
+        dropped = sorted(set(ctor) - set(accepted))
+        if dropped and is_main:
+            print(f"note: backend {backend!r} does not take "
+                  f"{', '.join(dropped)}; ignored", file=sys.stderr)
+        be = get_backend(backend, **accepted)
         ckpt_kw = {}
         if args.checkpoint_dir:
             from sheep_tpu.utils.checkpoint import Checkpointer

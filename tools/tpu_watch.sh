@@ -21,19 +21,20 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     out="tools/out/$ts"
     mkdir -p "$out"
     echo "tunnel healthy at $ts; capturing" | tee "$out/watch.log"
-    timeout 2400 python tools/tune_fixpoint.py --scale 22 --ef 16 \
-      --chunk-logs 24,23 --warm w44,w8 --segment-rounds 2 \
+    timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
+      --chunk-logs 24,23 --warm w1,w44,w8 --segment-rounds 2 \
       --lift-levels 0 --tail-divisors 2 \
       >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
     tune_rc=$?
     timeout 3600 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
     cat "$out/bench.json" | tee -a "$out/watch.log"
-    # success = a real measurement (bench.py emits its JSON contract even
-    # on failure, with value 0 + "error"); a mid-capture wedge (the
-    # failure mode this script exists for) keeps polling for another try
-    if [ "$tune_rc" -eq 0 ] && [ -s "$out/tune22_post.jsonl" ] && \
-       grep -q '"vs_baseline"' "$out/bench.json" && \
+    # success = the HEADLINE measurement landed (bench.py emits its JSON
+    # contract even on failure, with value 0 + "error"); the tune sweep
+    # is best-effort extra evidence — a partial jsonl is still data. A
+    # mid-capture wedge keeps polling for another try.
+    if grep -q '"vs_baseline"' "$out/bench.json" && \
        ! grep -q '"value": 0.0' "$out/bench.json"; then
+      echo "bench landed (tune rc=$tune_rc)" | tee -a "$out/watch.log"
       exit 0
     fi
     echo "capture incomplete (tune rc=$tune_rc); resuming poll" \
