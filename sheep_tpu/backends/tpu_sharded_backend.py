@@ -22,11 +22,14 @@ class TpuShardedBackend(Partitioner):
     supports_multidevice = True
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
-                 alpha: float = 1.0, n_devices: int | None = None):
+                 alpha: float = 1.0, n_devices: int | None = None,
+                 segment_rounds: int = 32, warm_schedule=((1, 8),)):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
         self.n_devices = n_devices
+        self.segment_rounds = segment_rounds
+        self.warm_schedule = tuple(warm_schedule)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -41,7 +44,9 @@ class TpuShardedBackend(Partitioner):
         # chunk sizing (and checkpoint fingerprints) cannot diverge
         cs = stream.clamp_chunk_edges(self.chunk_edges,
                                       parts=mesh.devices.size)
-        pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels)
+        pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels,
+                               segment_rounds=self.segment_rounds,
+                               warm_schedule=self.warm_schedule)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,

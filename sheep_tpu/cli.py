@@ -93,7 +93,7 @@ def _parse_warm_schedule(spec: str, parser) -> tuple:
         if not part:
             continue
         bits = part.split(":")
-        if len(bits) != 2 or not all(b.lstrip("-").isdigit() for b in bits):
+        if len(bits) != 2 or not all(b.isdigit() for b in bits):
             parser.error(f"--warm-schedule: expected R:L pairs, got {part!r}")
         rounds, levels = int(bits[0]), int(bits[1])
         if rounds < 1 or levels < 1:
@@ -182,18 +182,25 @@ def main(argv=None) -> int:
             ctor["cache_chunks"] = False
         # keep only the options this backend's constructor names; warn
         # about the rest instead of silently changing the run (the
-        # tuning knobs are tpu-backend-only; alpha/chunk_edges are
-        # universal and always survive the filter)
+        # tuning knobs vary per backend; alpha/chunk_edges are universal
+        # and always survive the filter). A plugin ctor taking **kwargs
+        # accepts everything; an unknown backend name falls through to
+        # get_backend's friendly available-backends error.
         import inspect
 
         from sheep_tpu.backends.base import _REGISTRY
 
-        sig = inspect.signature(_REGISTRY[backend].__init__)
-        accepted = {k: v for k, v in ctor.items() if k in sig.parameters}
-        dropped = sorted(set(ctor) - set(accepted))
-        if dropped and is_main:
-            print(f"note: backend {backend!r} does not take "
-                  f"{', '.join(dropped)}; ignored", file=sys.stderr)
+        cls = _REGISTRY.get(backend)
+        accepted = ctor
+        if cls is not None:
+            params = inspect.signature(cls.__init__).parameters
+            if not any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in params.values()):
+                accepted = {k: v for k, v in ctor.items() if k in params}
+                dropped = sorted(set(ctor) - set(accepted))
+                if dropped and is_main:
+                    print(f"note: backend {backend!r} does not take "
+                          f"{', '.join(dropped)}; ignored", file=sys.stderr)
         be = get_backend(backend, **accepted)
         ckpt_kw = {}
         if args.checkpoint_dir:

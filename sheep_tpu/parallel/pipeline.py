@@ -123,7 +123,7 @@ class ShardedPipeline:
     """Compiled sharded pipeline for a fixed (n, chunk_edges, mesh)."""
 
     def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0,
-                 segment_rounds: int = 32):
+                 segment_rounds: int = 32, warm_schedule=((1, 8),)):
         self.n = n
         self.cs = chunk_edges
         self.mesh = mesh
@@ -132,6 +132,11 @@ class ShardedPipeline:
         # host loops bounded segments so no single accelerator call runs
         # unboundedly long (the TPU worker watchdog kills those)
         self.segment_rounds = segment_rounds
+        # low-lift warm rounds before full-depth rounds, as in the
+        # single-device adaptive fold: a full-buffer round costs
+        # ~lift_levels x width gathers per device and most slots retire
+        # early without long jumps (tools/tune_fixpoint.py sweeps)
+        self.warm_schedule = tuple(warm_schedule)
         d = mesh.devices.size
         self.n_devices = d
         self.rounds = max(1, math.ceil(math.log2(d))) if d > 1 else 0
@@ -187,7 +192,8 @@ class ShardedPipeline:
                 out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)))(
                     batch, pos)
 
-        def _make_fold_seg(small: bool):
+        def _make_fold_seg(small: bool, warm_levels: int = 0,
+                           warm_rounds: int = 0):
             """Segment step over whatever active-buffer width the inputs
             have (one compiled program per width). Everything is POSITION
             SPACE (tables P[p] = parent position, actives = position
@@ -212,6 +218,13 @@ class ShardedPipeline:
                             elim_ops.fold_segment_small_pos(
                                 P_local[0], lo_local[0], hi_local[0], n_,
                                 segment_rounds=max(seg_, 64))
+                    elif warm_levels:
+                        lo2, hi2, Pn, sv = \
+                            elim_ops.fold_segment_pos(
+                                P_local[0], lo_local[0], hi_local[0], n_,
+                                lift_levels=warm_levels,
+                                segment_rounds=warm_rounds,
+                                descent="stream")
                     else:
                         lo2, hi2, Pn, sv = \
                             elim_ops.fold_segment_pos(
@@ -250,6 +263,9 @@ class ShardedPipeline:
         self.orient_step = orient_step
         self._fold_full = _make_fold_seg(False)
         self._fold_small = _make_fold_seg(True)
+        self._fold_warm = [
+            _make_fold_seg(False, warm_levels=wl, warm_rounds=wr)
+            for wr, wl in self.warm_schedule]
         self._make_compact = _make_compact
         self._compact_cache: dict = {}
 
@@ -368,9 +384,14 @@ class ShardedPipeline:
         forests are per-device (pulling D of them would cost O(V*D)
         transfers) — the jump-mode tail is the sharded equivalent."""
         size = int(lo_all.shape[-1])
+        warm = list(self._fold_warm)
         while True:
-            step = self._fold_small if size <= self.SMALL_SIZE \
-                else self._fold_full
+            if warm and size > self.SMALL_SIZE:
+                step = warm.pop(0)
+            elif size <= self.SMALL_SIZE:
+                step = self._fold_small
+            else:
+                step = self._fold_full
             P_all, lo_all, hi_all, changed, max_live = step(
                 P_all, lo_all, hi_all)
             if not int(changed):
