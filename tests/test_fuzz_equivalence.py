@@ -1,0 +1,71 @@
+"""Seeded cross-backend fuzz: many random graph shapes, every backend,
+exact equality of cut/assignment/comm-volume (SURVEY.md §4.3 taken to
+its limit — the elimination forest is unique given the order, and the
+split/score semantics are shared, so equality is exact, not tolerant).
+
+The quick tier (always on) runs a handful of shapes; SHEEP_FUZZ=1 runs
+the full sweep. Shapes mix RMAT skew, uniform noise, self-loops,
+duplicate edges, isolated vertices, and tiny k up to k > V.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.base import get_backend, list_backends
+from sheep_tpu.io.edgestream import EdgeStream
+
+FULL = os.environ.get("SHEEP_FUZZ") == "1"
+
+
+def _random_graph(rng):
+    kind = rng.integers(0, 4)
+    if kind == 0:  # uniform
+        n = int(rng.integers(2, 400))
+        m = int(rng.integers(1, 4 * n))
+        e = rng.integers(0, n, size=(m, 2))
+    elif kind == 1:  # skewed (hub-heavy)
+        n = int(rng.integers(10, 400))
+        m = int(rng.integers(n, 6 * n))
+        hub = rng.integers(0, max(1, n // 10), size=m)
+        other = rng.integers(0, n, size=m)
+        e = np.stack([hub, other], axis=1)
+    elif kind == 2:  # sparse forest-ish + noise
+        n = int(rng.integers(3, 300))
+        parents = rng.integers(0, np.maximum(1, np.arange(1, n)))
+        e = np.stack([np.arange(1, n), parents], axis=1)
+        noise = rng.integers(0, n, size=(int(rng.integers(0, n)), 2))
+        e = np.concatenate([e, noise])
+    else:  # dense-ish small
+        n = int(rng.integers(2, 60))
+        m = int(rng.integers(1, n * n // 2 + 1))
+        e = rng.integers(0, n, size=(m, 2))
+    # sprinkle self-loops and exact duplicates
+    if len(e) > 2 and rng.random() < 0.5:
+        e[rng.integers(0, len(e))] = [e[0][0], e[0][0]]
+        e[rng.integers(0, len(e))] = e[1]
+    return e.astype(np.int64), n
+
+
+@pytest.mark.parametrize("seed", range(40 if FULL else 8))
+def test_backends_agree_on_random_graphs(seed):
+    rng = np.random.default_rng(1000 + seed)
+    e, n = _random_graph(rng)
+    k = int(rng.integers(1, n + 3))  # includes k = 1 and k > V
+    chunk = int(rng.integers(8, max(9, len(e) + 1)))
+    backends = [b for b in ("pure", "cpu", "tpu") if b in list_backends()]
+    results = {}
+    for b in backends:
+        es = EdgeStream.from_array(e, n_vertices=n)
+        results[b] = get_backend(b, chunk_edges=chunk).partition(
+            es, k, comm_volume=True)
+    ref = results[backends[0]]
+    a = np.asarray(ref.assignment)
+    assert len(a) == n and (a >= 0).all() and (a < max(k, 1)).all()
+    for b in backends[1:]:
+        r = results[b]
+        assert r.edge_cut == ref.edge_cut, (seed, b)
+        assert r.comm_volume == ref.comm_volume, (seed, b)
+        np.testing.assert_array_equal(np.asarray(r.assignment), a,
+                                      err_msg=f"seed {seed} backend {b}")

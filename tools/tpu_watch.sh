@@ -35,6 +35,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     if grep -q '"vs_baseline"' "$out/bench.json" && \
        ! grep -q '"value": 0.0' "$out/bench.json"; then
       echo "bench landed (tune rc=$tune_rc)" | tee -a "$out/watch.log"
+      # best-effort: micro-roofline numbers + an xprof trace of one
+      # fixpoint round (the VERDICT r1 item 3 trace artifact)
+      timeout 1200 python tools/microbench_fixpoint.py --scale 22 \
+        --chunk-log 23 --profile-dir "$out/xprof" \
+        >"$out/microbench.jsonl" 2>>"$out/watch.log"
+      echo "microbench rc=$?" | tee -a "$out/watch.log"
       exit 0
     fi
     echo "capture incomplete (tune rc=$tune_rc); resuming poll" \
