@@ -91,22 +91,13 @@ def measure(scale: int, platform: str) -> dict:
     attempt. Returns the result dict (also printed as the last stdout
     line when invoked via --measure)."""
     # persistent compilation cache: a retried/repeated bench skips the
-    # multi-minute first-compile warm-up (the programs are identical).
-    # jax is pre-imported at interpreter startup in this environment, so
-    # the env var alone is too late — use the config API.
+    # multi-minute first-compile warm-up (the programs are identical)
+    from sheep_tpu.utils.platform import enable_compilation_cache, \
+        pin_platform
+
     if platform == "cpu":
-        from sheep_tpu.utils.platform import pin_platform
-
         pin_platform("cpu")
-    import jax
-
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                         "/tmp/sheep_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:
-        log(f"compilation cache unavailable: {e}")
+    enable_compilation_cache()
 
     from sheep_tpu.backends.base import get_backend, list_backends
 

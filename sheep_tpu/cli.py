@@ -110,9 +110,11 @@ def main(argv=None) -> int:
     # jax at interpreter startup (which makes the env var a no-op on its
     # own). Without this, `JAX_PLATFORMS=cpu python -m sheep_tpu.cli ...`
     # hangs trying to initialize an unreachable accelerator.
-    from sheep_tpu.utils.platform import pin_platform
+    from sheep_tpu.utils.platform import enable_compilation_cache, \
+        pin_platform
 
     pin_platform()
+    enable_compilation_cache()
 
     from sheep_tpu import list_backends
     from sheep_tpu.backends.base import get_backend

@@ -26,3 +26,26 @@ def pin_platform(platform: str | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", value)
+
+
+def enable_compilation_cache(
+        default_dir: str = "/tmp/sheep_jax_cache") -> None:
+    """Turn on JAX's persistent compilation cache (config API, because
+    the env var is read before user code when a platform plugin
+    pre-imports jax). First compiles of the streaming programs cost
+    minutes through a remote-device tunnel; repeat runs then start hot.
+    Best-effort: jax absent/broken or an old jax without the knobs
+    leaves things as-is, with one stderr note (a silently-disabled
+    cache re-pays the warm-up with no clue why)."""
+    import sys
+
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", default_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"note: persistent compilation cache unavailable: {e}",
+              file=sys.stderr)
