@@ -69,3 +69,34 @@ def test_backends_agree_on_random_graphs(seed):
         assert r.comm_volume == ref.comm_volume, (seed, b)
         np.testing.assert_array_equal(np.asarray(r.assignment), a,
                                       err_msg=f"seed {seed} backend {b}")
+
+
+@pytest.mark.parametrize("seed", range(12 if FULL else 4))
+def test_multidevice_backends_agree_on_random_graphs(seed):
+    """Same exact-equality bar for the multi-device backends (8-device
+    virtual mesh). Fixed chunk size: every random width would compile a
+    fresh mesh program set."""
+    import jax
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(2000 + seed)
+    e, n = _random_graph(rng)
+    k = int(rng.integers(1, n + 3))
+    targets = [b for b in ("tpu-sharded", "tpu-bigv")
+               if b in list_backends()]
+    # don't pass vacuously if an import regression unregistered both
+    # (backends/__init__.py guards those imports with except Exception)
+    assert targets, "no multi-device backend registered"
+    ref_es = EdgeStream.from_array(e, n_vertices=n)
+    ref = get_backend("tpu", chunk_edges=256).partition(
+        ref_es, k, comm_volume=True)
+    for b in targets:
+        es = EdgeStream.from_array(e, n_vertices=n)
+        r = get_backend(b, chunk_edges=256).partition(
+            es, k, comm_volume=True)
+        assert r.edge_cut == ref.edge_cut, (seed, b)
+        assert r.comm_volume == ref.comm_volume, (seed, b)
+        np.testing.assert_array_equal(
+            np.asarray(r.assignment), np.asarray(ref.assignment),
+            err_msg=f"seed {seed} backend {b}")
