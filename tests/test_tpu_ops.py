@@ -280,6 +280,34 @@ def test_merge_forests_matches_whole(graph):
     np.testing.assert_array_equal(np.asarray(merged), np.asarray(whole))
 
 
+def test_merge_forests_commutative_and_associative(graph):
+    """merge(A,B) == merge(B,A) and any merge order of three shards
+    yields the identical table — the property that makes the
+    distributed algorithm correct (SURVEY.md §4.1: the single most
+    important property test)."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    third = max(1, len(e) // 3)
+    shards = [e[:third], e[third:2 * third], e[2 * third:]]
+    forests = []
+    for s in shards:
+        f, _ = elim_ops.build_chunk_step(
+            jnp.full(n + 1, n, dtype=jnp.int32),
+            pad_chunk(s, max(len(s), 1), n), pos, order, n)
+        forests.append(f)
+    a, b, c = forests
+    ab = elim_ops.merge_forests(a, b, pos, order, n)
+    ba = elim_ops.merge_forests(b, a, pos, order, n)
+    np.testing.assert_array_equal(np.asarray(ab), np.asarray(ba))
+    left = elim_ops.merge_forests(ab, c, pos, order, n)
+    right = elim_ops.merge_forests(a, elim_ops.merge_forests(
+        b, c, pos, order, n), pos, order, n)
+    np.testing.assert_array_equal(np.asarray(left), np.asarray(right))
+    rotated = elim_ops.merge_forests(c, elim_ops.merge_forests(
+        a, b, pos, order, n), pos, order, n)
+    np.testing.assert_array_equal(np.asarray(left), np.asarray(rotated))
+
+
 def test_minp_parent_roundtrip(graph):
     e, n = graph
     pos, order = _device_order(e, n)
