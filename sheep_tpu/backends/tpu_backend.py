@@ -239,7 +239,6 @@ class TpuBackend(Partitioner):
             else:
                 P = jnp.full(n + 1, n, dtype=jnp.int32)
                 start = 0
-            total_rounds = 0
             idx = start
             pos_host_cache = np.asarray(pos[:n])  # host tail reuses it
             tail_at = self.host_tail_threshold

@@ -75,11 +75,21 @@ from __future__ import annotations
 
 from functools import partial
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 NO_PARENT = -1
+
+
+def pow2_at_least(x: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(x, 1), raised to at least ``floor``
+    — the shared buffer-sizing rule (compactions, host-tail pulls,
+    merge payload capacities): power-of-two sizes keep the set of
+    compiled program shapes logarithmic in the starting width."""
+    return max(floor, 1 << max(0, (max(int(x), 1) - 1).bit_length()))
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -401,8 +411,6 @@ def count_live_distinct(lo: jax.Array, hi: jax.Array, n: int):
 
 def _order_host(pos_host, n: int):
     """Inverse permutation of pos_host with the sentinel slot appended."""
-    import numpy as np
-
     order_host = np.empty(n + 1, dtype=np.int64)
     order_host[np.asarray(pos_host)] = np.arange(n, dtype=np.int64)
     order_host[n] = n
@@ -420,8 +428,6 @@ def _host_tail_finish_pos(P, loP, hiP, n: int, size: int, pos_host):
     table + the compacted live constraints, extend the forest there, and
     push the table back. Same unique forest (cross-backend bit-identity
     is an existing test invariant)."""
-    import numpy as np
-
     from sheep_tpu.core import native
 
     clo, chi = compact_actives(loP, hiP, n, size, dedup=True)
@@ -500,8 +506,6 @@ def fold_edges_adaptive_pos(
         # the cpu-jax sweet spot; on a real chip device rounds are far
         # cheaper relative to the host pass, so callers may lower it
         host_tail_threshold = max(1 << 16, size // 8)
-    import numpy as np
-
     warm = list(warm_schedule)
     while True:
         if warm and size > small_size:
@@ -538,13 +542,12 @@ def fold_edges_adaptive_pos(
             stats["host_tail_live"] = stats.get("host_tail_live", 0) + live
             # size the pull by the live count, not the threshold: the
             # tail ships two O(size) arrays over the host link
-            pull = max(1 << 14, 1 << max(1, (live - 1).bit_length()))
+            pull = pow2_at_least(live, floor=1 << 14)
             return (_host_tail_finish_pos(P, loP, hiP, n,
                                           min(pull, size), pos_host),
                     total)
         if size > small_size and live <= size // 2:
-            new_size = max(small_size, 1 << max(1, (2 * live - 1)
-                                                .bit_length()))
+            new_size = pow2_at_least(2 * live, floor=small_size)
             if new_size < size:
                 loP, hiP = compact_actives(loP, hiP, n, new_size,
                                            dedup=True)
@@ -573,8 +576,6 @@ def fold_edges_adaptive(
 ):
     """Vertex-space wrapper of :func:`fold_edges_adaptive_pos` (one
     conversion each way; same unique forest)."""
-    import numpy as np
-
     from sheep_tpu.core import native
 
     if host_tail and pos_host is None and native.available():
@@ -781,8 +782,6 @@ def merge_forests(
 
 def minp_to_parent(minp, order, n):
     """minp encoding -> parent array (int64[n], -1 for roots) on host."""
-    import numpy as np
-
     minp = np.asarray(minp[:n])
     order = np.asarray(order)
     parent = np.where(minp < n, order[np.minimum(minp, n)], NO_PARENT)
@@ -791,8 +790,6 @@ def minp_to_parent(minp, order, n):
 
 def parent_to_minp(parent, pos, n):
     """parent array (int[n], -1 roots) -> device minp encoding int32[n+1]."""
-    import numpy as np
-
     parent = np.asarray(parent)
     pos = np.asarray(pos)
     minp = np.full(n + 1, n, dtype=np.int32)

@@ -100,8 +100,9 @@ def cut_pair_rows_compact(edges: jax.Array, assign: jax.Array, n: int,
 
 def _compact_cap(c_rows: int) -> int:
     """Device-compaction capacity for a chunk producing c_rows rows."""
-    return min(c_rows, max(1 << 16, 1 << (max(c_rows >> 3, 1) - 1)
-                           .bit_length()))
+    from sheep_tpu.ops.elim import pow2_at_least
+
+    return min(c_rows, pow2_at_least(c_rows >> 3, floor=1 << 16))
 
 
 def cut_pair_keys_host(chunk, assign, n: int, k: int):

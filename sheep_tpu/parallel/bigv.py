@@ -71,6 +71,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from sheep_tpu.ops.elim import pow2_at_least
 from sheep_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -343,8 +344,7 @@ class BigVPipeline:
                 return P_sh, total
             ml = int(max_live)
             if size > self.MIN_Q and ml <= size // 4:
-                new_size = max(self.MIN_Q,
-                               1 << max(1, (2 * ml - 1).bit_length()))
+                new_size = pow2_at_least(2 * ml, floor=self.MIN_Q)
                 if new_size < size:
                     fn = self._compact_cache.get(new_size)
                     if fn is None:

@@ -398,8 +398,8 @@ class ShardedPipeline:
                 return P_all
             live = int(max_live)
             if size > self.SMALL_SIZE and live <= size // 4:
-                new_size = max(self.SMALL_SIZE,
-                               1 << max(1, (2 * live - 1).bit_length()))
+                new_size = elim_ops.pow2_at_least(2 * live,
+                                                  floor=self.SMALL_SIZE)
                 if new_size < size:
                     fn = self._compact_cache.get(new_size)
                     if fn is None:
@@ -446,7 +446,7 @@ class ShardedPipeline:
         cap0 = 0
         if self.rounds:
             cnt = int(self.max_occupancy(P_all))
-            c = max(1024, 1 << max(0, int(cnt - 1).bit_length()))
+            c = elim_ops.pow2_at_least(cnt, floor=1024)
             if 2 * c < self.n + 1:
                 cap0 = c
         for r in range(self.rounds):
