@@ -21,8 +21,9 @@ from sheep_tpu.types import ElimTree, PartitionResult
 class PureBackend(Partitioner):
     name = "pure"
 
-    def __init__(self, chunk_edges: int = 1 << 22):
+    def __init__(self, chunk_edges: int = 1 << 22, alpha: float = 1.0):
         self.chunk_edges = chunk_edges
+        self.alpha = alpha
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, **opts) -> PartitionResult:
@@ -52,7 +53,7 @@ class PureBackend(Partitioner):
 
         t0 = time.perf_counter()
         w = deg if weights == "degree" else None
-        assignment = pure.tree_split(tree, k, w)
+        assignment = pure.tree_split(tree, k, w, alpha=self.alpha)
         t["split"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()

@@ -31,7 +31,7 @@ from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
-from sheep_tpu.types import PartitionResult
+from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 from sheep_tpu.utils.prefetch import prefetch
 
 
@@ -43,7 +43,11 @@ def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
     """
     c = np.asarray(chunk, dtype=np.int64)
     if np.any(c >= np.iinfo(np.int32).max):
-        raise NotImplementedError("vertex ids >= 2^31 not supported yet")
+        # backstop only: partition() rejects n > MAX_TPU_VERTICES up
+        # front, so this fires only for ids beyond a user-supplied
+        # (too-small) --num-vertices
+        raise ValueError("vertex id >= 2^31 in chunk; ids must fit int32 "
+                         "on TPU backends (use --backend cpu)")
     out = np.full((size, 2), n, dtype=np.int32)
     out[: len(c)] = c
     return out
@@ -124,10 +128,22 @@ def _chunk_cache_budget(n: int, chunk_edges: int) -> int:
     if hbm <= 0:
         # no reported limit: infer only from a known device generation;
         # an unknown accelerator gets no cache rather than a guessed
-        # budget that could OOM it (SHEEP_CACHE_BYTES overrides)
+        # budget that could OOM it (SHEEP_CACHE_BYTES overrides). Exact
+        # kind match first so a future kind merely *containing* one of
+        # these substrings (with different HBM) prefers its own entry,
+        # and log the inference so an OOM is traceable to it.
         kind = getattr(dev, "device_kind", "").lower()
         known = {"v5 lite": 16, "v5e": 16, "v4": 32, "v5p": 95, "v6": 32}
-        hbm = next((g << 30 for key, g in known.items() if key in kind), 0)
+        g = known.get(kind) or next(
+            (g for key, g in known.items() if key in kind), 0)
+        hbm = g << 30
+        if hbm:
+            import sys
+
+            print(f"note: device reports no bytes_limit; inferring "
+                  f"{g} GiB HBM from device_kind {kind!r} for the chunk "
+                  f"cache (override with SHEEP_CACHE_BYTES)",
+                  file=sys.stderr)
     reserve = build_phase_bytes(n, chunk_edges)["total_bytes"] + (1 << 30)
     return max(0, int(0.9 * hbm) - reserve)
 
@@ -174,6 +190,7 @@ class TpuBackend(Partitioner):
         cs = stream.clamp_chunk_edges(self.chunk_edges)
         t0 = time.perf_counter()
         n = stream.num_vertices
+        check_tpu_vertex_range(n, self.name)
         meta = ckpt.stream_meta(stream, k, cs, weights=weights,
                                 alpha=self.alpha, comm_volume=comm_volume,
                                 state_format="minp")

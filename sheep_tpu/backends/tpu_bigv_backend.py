@@ -17,7 +17,7 @@ from __future__ import annotations
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.parallel.bigv import BigVPipeline
 from sheep_tpu.parallel.mesh import shards_mesh
-from sheep_tpu.types import PartitionResult
+from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 
 
 @register
@@ -36,6 +36,7 @@ class TpuBigVBackend(Partitioner):
                   comm_volume: bool = True, checkpointer=None,
                   resume: bool = False, **opts) -> PartitionResult:
         n = stream.num_vertices
+        check_tpu_vertex_range(n, self.name)
         mesh = shards_mesh(self.n_devices)
         cs = self.chunk_edges
         m_cheap = stream.num_edges_cheap

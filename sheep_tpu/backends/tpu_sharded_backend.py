@@ -13,7 +13,7 @@ from __future__ import annotations
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.parallel.mesh import shards_mesh
 from sheep_tpu.parallel.pipeline import ShardedPipeline
-from sheep_tpu.types import PartitionResult
+from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 
 
 @register
@@ -38,6 +38,7 @@ class TpuShardedBackend(Partitioner):
         # weak #5 asked for consistency); pass False to skip the host-side
         # O(cut pairs) accumulator on huge runs
         n = stream.num_vertices
+        check_tpu_vertex_range(n, self.name)
         mesh = shards_mesh(self.n_devices)
         # shrink the chunk so small graphs don't pad (and compile) up to
         # the full default chunk shape; shared helper so the backends'

@@ -120,6 +120,7 @@ def main(argv=None) -> int:
     from sheep_tpu.backends.base import get_backend
     from sheep_tpu.io.edgestream import EdgeStream
     from sheep_tpu.io.formats import write_partition
+    from sheep_tpu.types import UnsupportedGraphError
 
     if args.list_backends:
         print(" ".join(list_backends()))
@@ -184,8 +185,10 @@ def main(argv=None) -> int:
             ctor["cache_chunks"] = False
         # keep only the options this backend's constructor names; warn
         # about the rest instead of silently changing the run (the
-        # tuning knobs vary per backend; alpha/chunk_edges are universal
-        # and always survive the filter). A plugin ctor taking **kwargs
+        # tuning knobs vary per backend; every registered backend's ctor
+        # names alpha and chunk_edges, so those survive the filter for
+        # the built-ins — a third-party plugin without them gets the
+        # stderr note). A plugin ctor taking **kwargs
         # accepts everything; an unknown backend name falls through to
         # get_backend's friendly available-backends error.
         import inspect
@@ -221,8 +224,16 @@ def main(argv=None) -> int:
             profile = jax.profiler.trace(args.profile_dir)
             profile.__enter__()
         try:
-            res = be.partition(es, args.k, weights=args.weights,
-                               comm_volume=not args.no_comm_volume, **ckpt_kw)
+            try:
+                res = be.partition(es, args.k, weights=args.weights,
+                                   comm_volume=not args.no_comm_volume,
+                                   **ckpt_kw)
+            except UnsupportedGraphError as exc:
+                # documented envelope violations (e.g. >= 2^31 vertices on
+                # an int32-table TPU backend) reject cleanly, not as a
+                # mid-build stack trace
+                print(f"error: {exc}", file=sys.stderr)
+                return 2
             if args.refine and is_main:
                 from sheep_tpu import refine_result
 

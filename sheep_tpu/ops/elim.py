@@ -496,6 +496,17 @@ def fold_edges_adaptive_pos(
     """
     from sheep_tpu.core import native
 
+    # the CLI validates R:L >= 1 at parse time; validate the Python API
+    # too — _resolve silently promotes levels <= 0 to FULL depth, the
+    # opposite of a cheap warm round, so a malformed entry must fail
+    # loudly here rather than quietly invert the schedule's intent
+    for entry in warm_schedule:
+        wr, wl = entry
+        if wr < 1 or wl < 1:
+            raise ValueError(
+                f"warm_schedule entries must be (rounds >= 1, "
+                f"lift_levels >= 1); got {tuple(entry)!r}")
+
     use_host_tail = host_tail and native.available() and pos_host is not None
     if stats is None:
         stats = {}

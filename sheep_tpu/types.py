@@ -13,6 +13,27 @@ from typing import Dict, Optional
 
 import numpy as np
 
+# All device-resident vertex tables (pos/order/minp/assignment) are int32,
+# on every TPU backend including the block-sharded tpu-bigv — so vertex
+# ids must stay below 2^31. Every in-contract eval config does (RMAT-30 =
+# 2^30 vertices, BASELINE.md); beyond that the int64 cpu backend applies.
+MAX_TPU_VERTICES = 2**31 - 1
+
+
+class UnsupportedGraphError(ValueError):
+    """Graph outside a backend's documented envelope — raised up front
+    (before any streaming pass) so the CLI can reject it cleanly instead
+    of surfacing a mid-build stack trace (SURVEY.md §2 #1: trillion-edge
+    capable means failing loudly at the documented boundary)."""
+
+
+def check_tpu_vertex_range(n: int, backend: str) -> None:
+    if n > MAX_TPU_VERTICES:
+        raise UnsupportedGraphError(
+            f"graph has {n:,} vertices but backend {backend!r} keeps "
+            f"int32 device tables (max {MAX_TPU_VERTICES:,}); use "
+            f"--backend cpu (int64) for larger vertex ids")
+
 
 @dataclasses.dataclass
 class ElimTree:

@@ -307,7 +307,10 @@ def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
         raise ValueError(
             "checkpoint does not match this run "
             f"(saved {state.meta}, current {meta}); "
-            "pass a fresh --checkpoint-dir or drop --resume")
+            "pass a fresh --checkpoint-dir or drop --resume. Note: "
+            "upgrading sheep_tpu can change automatic chunk sizing "
+            "(part of the fingerprint), in which case restart fresh — "
+            "checkpoints are not portable across versions")
     return state
 
 

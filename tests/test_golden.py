@@ -1,0 +1,77 @@
+"""Checked-in golden fixtures (SURVEY.md §4.2).
+
+Every other correctness test computes the numpy oracle *dynamically*, so a
+silent semantic drift of the oracle itself — the root of the whole
+equivalence-test DAG — would pass the suite. These fixtures pin the
+oracle's exact output (elimination-forest parent array, partition map,
+edge cut, balance, communication volume) on the karate club (driver eval
+config 1) and an RMAT-8 graph, as files generated once and committed.
+
+Any intentional algorithm change must regenerate them consciously:
+
+    python - <<'EOF'
+    ... see tests/golden/README.md
+    EOF
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sheep_tpu.core import pure
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import EdgeStream
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+_GRAPHS = {
+    "karate_k2": lambda: (generators.karate_club(), 34, 2),
+    "rmat8_k8": lambda: (generators.rmat(8, 8, seed=4), 256, 8),
+}
+
+
+def _load(name):
+    with open(os.path.join(GOLDEN_DIR, f"{name}.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(params=list(_GRAPHS))
+def case(request):
+    e, n, k = _GRAPHS[request.param]()
+    return request.param, e, n, k, _load(request.param)
+
+
+def test_oracle_matches_golden(case):
+    """The numpy spec reproduces the committed fixture bit-for-bit."""
+    name, e, n, k, gold = case
+    deg = pure.degrees(e, n)
+    pos = pure.elimination_order(deg)
+    tree = pure.build_elim_tree(e, pos)
+    a = pure.tree_split(tree, k)
+    cut, total, balance, cv = pure.edge_cut_score(e, a, k)
+    np.testing.assert_array_equal(tree.parent, np.asarray(gold["parent"]))
+    np.testing.assert_array_equal(a, np.asarray(gold["assignment"]))
+    assert (cut, total, cv) == (gold["edge_cut"], gold["total_edges"],
+                                gold["comm_volume"])
+    assert balance == pytest.approx(gold["balance"], abs=1e-12)
+
+
+@pytest.mark.parametrize("backend", ["pure", "cpu", "tpu"])
+def test_backends_match_golden(case, backend):
+    """Every backend reproduces the committed partition and scores exactly
+    (the suite's usual cross-backend equality, but anchored to a file)."""
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if backend not in list_backends():
+        pytest.skip(f"{backend} unavailable")
+    name, e, n, k, gold = case
+    res = get_backend(backend).partition(
+        EdgeStream.from_array(e, n_vertices=n), k)
+    np.testing.assert_array_equal(res.assignment,
+                                  np.asarray(gold["assignment"], np.int32))
+    assert res.edge_cut == gold["edge_cut"]
+    assert res.total_edges == gold["total_edges"]
+    assert res.comm_volume == gold["comm_volume"]
+    assert res.balance == pytest.approx(gold["balance"], abs=1e-12)

@@ -1,0 +1,75 @@
+"""Documented-envelope rejection + Python-API validation tests.
+
+SURVEY.md §2 #1: trillion-edge capable means failing loudly at the
+documented boundary — a graph beyond a backend's envelope (>= 2^31
+vertex ids on int32-table TPU backends) must reject up front at the CLI,
+not stack-trace from inside the degrees loop.
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import formats, generators
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.types import MAX_TPU_VERTICES, UnsupportedGraphError
+
+
+@pytest.mark.parametrize("backend", ["tpu", "tpu-sharded", "tpu-bigv"])
+def test_tpu_backends_reject_huge_v_up_front(backend):
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if backend not in list_backends():
+        pytest.skip(f"{backend} unavailable")
+    es = EdgeStream.from_array(np.array([[0, 1]], dtype=np.int64),
+                               n_vertices=MAX_TPU_VERTICES + 2)
+    with pytest.raises(UnsupportedGraphError, match="int32"):
+        get_backend(backend).partition(es, 2)
+
+
+def test_cli_rejects_huge_v_cleanly(tmp_path, capsys):
+    """CLI exit code 2 + a one-line error, no traceback."""
+    from sheep_tpu import cli
+    from sheep_tpu.backends.base import list_backends
+
+    if "tpu" not in list_backends():
+        pytest.skip("tpu backend unavailable")
+
+    p = str(tmp_path / "tiny.edges")
+    formats.write_edges(p, generators.karate_club())
+    rc = cli.main(["--input", p, "--k", "2", "--backend", "tpu",
+                   "--num-vertices", str(2**31 + 5)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "int32" in err and "--backend cpu" in err
+
+
+def test_warm_schedule_python_api_validation():
+    """_resolve silently promotes levels <= 0 to full depth — the Python
+    API must reject malformed warm entries instead (ADVICE r2)."""
+    jnp = pytest.importorskip("jax.numpy")
+
+    from sheep_tpu.ops import elim
+
+    n = 8
+    P = jnp.full(n + 1, n, dtype=jnp.int32)
+    lo = jnp.full(4, n, dtype=jnp.int32)
+    hi = jnp.full(4, n, dtype=jnp.int32)
+    with pytest.raises(ValueError, match="warm_schedule"):
+        elim.fold_edges_adaptive_pos(P, lo, hi, n, warm_schedule=((1, 0),))
+    with pytest.raises(ValueError, match="warm_schedule"):
+        elim.fold_edges_adaptive_pos(P, lo, hi, n, warm_schedule=((0, 8),))
+
+
+def test_pure_backend_takes_alpha():
+    """--alpha routes to every built-in backend uniformly (ADVICE r2: it
+    was silently dropped for pure)."""
+    from sheep_tpu.backends.base import get_backend
+
+    e = generators.karate_club()
+    es = EdgeStream.from_array(e, n_vertices=34)
+    tight = get_backend("pure", alpha=1.0).partition(es, 4)
+    loose = get_backend("pure", alpha=1.6).partition(es, 4)
+    # alpha=1.6 provably changes the result on karate k=4 (cut 47 -> 39,
+    # balance 1.059 -> 1.529); identical outputs mean alpha was dropped
+    assert not np.array_equal(tight.assignment, loose.assignment)
+    assert (tight.edge_cut, tight.balance) != (loose.edge_cut, loose.balance)

@@ -1,0 +1,163 @@
+"""Billion-edge-class soak driver (BASELINE.json eval config 3 size class).
+
+The largest run previously executed end-to-end was RMAT-22x16 = 67M edges
+(LiveJournal class). This driver proves the twitter-2010 class actually
+streams: it generates RMAT-26 with edge factor 22 — 1.476B edges, matching
+twitter-2010's 1.47B — to a .bin32 file (the generator-streamed ingest
+pass), then partitions it at k=64 through the REAL CLI in a subprocess,
+SIGKILLs that process mid-build, and resumes from the chunk checkpoint
+with ``--resume``. Memory stays O(V + chunk) throughout; the file is
+12 GB and is .gitignored (tools/out/soak/).
+
+Usage:
+    python tools/soak_billion.py              # full orchestrated soak
+    python tools/soak_billion.py --scale 24   # smaller rehearsal
+
+Results land in tools/out/soak/soak_s{scale}.json:
+  gen_seconds, first_run (killed_at_phase/chunk), resume JSON summary,
+  end-to-end edges/sec for the resumed run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def generate(path: str, scale: int, ef: int, seed: int = 42,
+             chunk: int = 1 << 22) -> float:
+    """Stream RMAT chunks to a .bin32 file; returns wall seconds."""
+    from sheep_tpu.io import generators
+
+    t0 = time.perf_counter()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        done = 0
+        for block in generators.rmat_stream(scale, ef, seed=seed, chunk=chunk):
+            np.ascontiguousarray(block, dtype="<u4").tofile(f)
+            done += len(block)
+            if done % (chunk << 5) == 0:
+                print(f"  gen {done / 1e9:.2f}B edges "
+                      f"({time.perf_counter() - t0:.0f}s)", flush=True)
+    os.replace(tmp, path)
+    return time.perf_counter() - t0
+
+
+def cli_cmd(path: str, k: int, ckpt_dir: str, chunk_edges: int,
+            n_vertices: int, resume: bool) -> list:
+    cmd = [sys.executable, "-m", "sheep_tpu.cli", "--input", path,
+           "--k", str(k), "--backend", "cpu", "--json", "--no-comm-volume",
+           "--num-vertices", str(n_vertices),
+           "--chunk-edges", str(chunk_edges),
+           "--checkpoint-dir", ckpt_dir, "--checkpoint-every", "8"]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def read_manifest(ckpt_dir: str):
+    try:
+        with open(os.path.join(ckpt_dir, "sheep_ckpt_p0.json")) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def orchestrate(args) -> dict:
+    out_dir = os.path.join(REPO, "tools", "out", "soak")
+    os.makedirs(out_dir, exist_ok=True)
+    data = os.path.join(out_dir, f"rmat{args.scale}_ef{args.ef}.bin32")
+    ckpt_dir = os.path.join(out_dir, f"ckpt_s{args.scale}")
+    n = 1 << args.scale
+    m = args.ef << args.scale
+    result = {"scale": args.scale, "ef": args.ef, "k": args.k,
+              "n_vertices": n, "n_edges": m,
+              "chunk_edges": args.chunk_edges}
+
+    if os.path.exists(data) and os.path.getsize(data) == 8 * m:
+        print(f"reusing {data}")
+        result["gen_seconds"] = None
+    else:
+        print(f"generating {m / 1e9:.2f}B edges -> {data}")
+        result["gen_seconds"] = round(generate(data, args.scale, args.ef), 1)
+        print(f"  done in {result['gen_seconds']}s")
+
+    # fresh run; SIGKILL once the build phase has checkpointed past the
+    # kill threshold (a real process death, not an in-process exception)
+    for f in os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else []:
+        os.remove(os.path.join(ckpt_dir, f))
+    cmd = cli_cmd(data, args.k, ckpt_dir, args.chunk_edges, n, resume=False)
+    print("first run:", " ".join(cmd), flush=True)
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, cwd=REPO)
+    kill_after = args.kill_at_chunk
+    killed = None
+    while proc.poll() is None:
+        time.sleep(0.25)
+        man = read_manifest(ckpt_dir)
+        if man and (man["phase"] != "degrees") and \
+                (man["phase"] != "build" or man["chunk_idx"] >= kill_after):
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            killed = {"phase": man["phase"], "chunk_idx": man["chunk_idx"],
+                      "at_seconds": round(time.perf_counter() - t0, 1)}
+            break
+        if time.perf_counter() - t0 > args.timeout:
+            proc.kill()
+            raise RuntimeError("first run exceeded timeout before kill point")
+    if killed is None:
+        raise RuntimeError(
+            f"worker exited (rc={proc.returncode}) before the kill point:\n"
+            + (proc.stdout.read() if proc.stdout else ""))
+    result["first_run_killed"] = killed
+    print(f"  SIGKILLed at {killed}", flush=True)
+
+    cmd = cli_cmd(data, args.k, ckpt_dir, args.chunk_edges, n, resume=True)
+    print("resume run:", " ".join(cmd), flush=True)
+    t0 = time.perf_counter()
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=args.timeout, cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"resume failed rc={out.returncode}:\n"
+                           f"{out.stdout}\n{out.stderr}")
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    result["resume_wall_seconds"] = round(time.perf_counter() - t0, 1)
+    result["resume_summary"] = summary
+    result["resumed_edges_per_sec"] = summary["edges_per_sec"]
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=26)
+    ap.add_argument("--ef", type=int, default=22,
+                    help="22 @ scale 26 = 1.476B edges = twitter-2010's count")
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--chunk-edges", type=int, default=1 << 23)
+    ap.add_argument("--kill-at-chunk", type=int, default=64,
+                    help="SIGKILL once a build checkpoint >= this chunk exists")
+    ap.add_argument("--timeout", type=float, default=7200)
+    args = ap.parse_args()
+
+    res = orchestrate(args)
+    out = os.path.join(REPO, "tools", "out", "soak",
+                       f"soak_s{args.scale}.json")
+    with open(out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps(res))
+    print(f"written to {out}")
+
+
+if __name__ == "__main__":
+    main()
