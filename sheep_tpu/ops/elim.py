@@ -293,6 +293,108 @@ def fold_segment_small_pos(
     return _run_segment(body, P, loP, hiP, n, segment_rounds)
 
 
+# ---------------------------------------------------------------------------
+# sort-merge round prototype (VERDICT r2 item 2): the one primitive class
+# not yet tried as the round body. Replaces every random C-from-V table
+# gather with a sort-based join so the round rides lax.sort throughput
+# instead of the ~100-150 M elem/s XLA gather roofline. Kept bit-identical
+# to the jump-mode round (tests/test_tpu_ops.py) so the keep/reject
+# decision is purely a measured-throughput question — see BASELINE.md
+# "sort-based round" entry for the measured verdict.
+# ---------------------------------------------------------------------------
+
+def sorted_lookup(tables, idx: jax.Array, n: int):
+    """``[t[idx] for t in tables]`` with NO random gather.
+
+    Mechanism: concatenate the dense key range [0, n] (carrying each
+    table's values) with the query indices, one lexicographic
+    ``lax.sort`` by (key, is_query) — every query row lands immediately
+    after the table row with its key, table keys being dense — then a
+    last-valid ``associative_scan`` propagates table values onto query
+    rows, and one scatter returns results to slot order. Cost:
+    O((V + C) log) sort + streaming scan, vs C random gathers; wins iff
+    sort throughput/element beats the gather roofline on the target
+    device (the microbench probes exactly this pair)."""
+    C = idx.shape[0]
+    m = n + 1
+    keys = jnp.concatenate([jnp.arange(m, dtype=jnp.int32),
+                            idx.astype(jnp.int32)])
+    tag = jnp.concatenate([jnp.zeros(m, jnp.int32), jnp.ones(C, jnp.int32)])
+    slot = jnp.concatenate([jnp.zeros(m, jnp.int32),
+                            jnp.arange(C, dtype=jnp.int32)])
+    payloads = tuple(jnp.concatenate([t.astype(jnp.int32),
+                                      jnp.zeros(C, jnp.int32)])
+                     for t in tables)
+    srt = lax.sort((keys, tag, slot) + payloads, num_keys=2)
+    st, ss, sp = srt[1], srt[2], srt[3:]
+    is_table = st == 0
+
+    def combine(a, b):
+        # last-valid: b's payloads win wherever b is a table row
+        vals = tuple(jnp.where(b[-1], pb, pa)
+                     for pa, pb in zip(a[:-1], b[:-1]))
+        return vals + (a[-1] | b[-1],)
+
+    scanned = lax.associative_scan(combine, sp + (is_table,))
+    # scatter query rows back to slot order; table rows go to a dump slot
+    dump = jnp.where(st == 1, ss, C)
+    out = []
+    for v in scanned[:-1]:
+        buf = jnp.zeros(C + 1, jnp.int32).at[dump].set(v, mode="drop")
+        out.append(buf[:C])
+    return out
+
+
+def _pos_sortmerge_round_body(n: int, jumps: int):
+    """Jump-mode round with every table *read* through
+    :func:`sorted_lookup` — identical retire/displace/climb semantics to
+    :func:`_pos_small_round_body` (the scatter-min write stays a
+    scatter; it is not the dominant cost and has no sort equivalent
+    cheaper than a segmented reduce of the same sorted buffer)."""
+
+    def body(state):
+        lo_, hi_, P_, _, rounds = state
+        newP = P_.at[lo_].min(hi_, mode="drop")
+        old_at_lo, now = sorted_lookup((P_, newP), lo_, n)
+
+        cur = lo_
+        for _ in range(jumps):
+            cand = sorted_lookup((newP,), cur, n)[0]
+            cur = jnp.where(cand < hi_, cand, cur)
+        became_loop = cur == hi_
+        climb_lo = jnp.where(became_loop, n, cur)
+        climb_hi = jnp.where(became_loop, n, hi_)
+
+        retire = hi_ == now
+        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
+        out_lo = jnp.where(retire,
+                           jnp.where(displaced, now, n),
+                           climb_lo).astype(jnp.int32)
+        out_hi = jnp.where(retire,
+                           jnp.where(displaced, old_at_lo, n),
+                           climb_hi).astype(jnp.int32)
+        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
+        return out_lo, out_hi, newP, changed, rounds + 1
+
+    return body
+
+
+@partial(jax.jit, static_argnames=("n", "jumps", "segment_rounds"))
+def fold_segment_sortmerge_pos(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    jumps: int = 8,
+    segment_rounds: int = 64,
+):
+    """Sort-merge variant of :func:`fold_segment_small_pos` — same
+    (loP, hiP, P, stats) contract, bit-identical trajectories (asserted
+    by tests), different primitive mix for the microbench decision."""
+    body = _pos_sortmerge_round_body(n, jumps)
+    return _run_segment(body, P, loP, hiP, n, segment_rounds)
+
+
 @partial(jax.jit, static_argnames=("n", "lift_levels", "max_rounds", "descent"))
 def fold_edges(
     minp: jax.Array,

@@ -209,6 +209,21 @@ def main():
     report("full_fixpoint_round", s, 4 * levels * (n + 1 + c),
            {"lift_levels": levels})
 
+    # 5b. sort-based round prototype vs the gather round it would replace
+    # (VERDICT r2 item 2): matched shapes, one round each. sorted_lookup
+    # alone vs the plain gather it replaces is the primitive-level pair.
+    loP = pos[lo]
+    hiP = pos[hi]
+    s = timeit(jax.jit(lambda m, l, h: elim_ops.fold_segment_small_pos(
+        m, l, h, n, jumps=4, segment_rounds=1)[2]), minp, loP, hiP)
+    report("jump_round_C", s, 4 * 4 * 2 * c, {"jumps": 4})
+    s = timeit(jax.jit(lambda m, l, h: elim_ops.fold_segment_sortmerge_pos(
+        m, l, h, n, jumps=4, segment_rounds=1)[2]), minp, loP, hiP)
+    report("sortmerge_round_C", s, 4 * 4 * 2 * c, {"jumps": 4})
+    s = timeit(jax.jit(lambda t, i: elim_ops.sorted_lookup((t,), i, n)[0]),
+               table, idx_c)
+    report("sorted_lookup_C_from_V", s, 4 * 3 * c)
+
     # 6. one jump-mode round at tail shapes (16k actives) — measured on
     # the position-space core directly, so no O(V) vertex<->position
     # conversion gathers pollute the O(C')-per-round datum
