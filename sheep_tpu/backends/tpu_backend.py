@@ -156,7 +156,8 @@ class TpuBackend(Partitioner):
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0, segment_rounds: int = 2,
                  warm_schedule=None, cache_chunks: bool = True,
-                 host_tail_threshold: int = -1):
+                 host_tail_threshold: int = -1,
+                 carry_tail: Optional[bool] = None):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -177,6 +178,19 @@ class TpuBackend(Partitioner):
         # expensive relative to the native host pass), auto (C/8, min
         # 2^16) on cpu-jax where the measured sweet spot is later handoff
         self.host_tail_threshold = host_tail_threshold
+        # carry the fixpoint tail of intermediate chunks into the next
+        # chunk's fold instead of host-finishing each one — saves the
+        # per-chunk O(V) table round-trip and the serialized native
+        # tail pass; one host tail remains, after the last chunk.
+        # Default OFF (None -> False): measured at RMAT-20x16 on
+        # cpu-jax, carrying makes the DEVICE grind the displacement
+        # cascades the native pass resolves in O(chain) — device rounds
+        # 18 -> 30, build 44s -> 178s, identical output (BASELINE.md
+        # "carry-over tails"). Kept as an option because the trade
+        # reverses only when the per-chunk O(V) round-trip is extremely
+        # expensive (tunnel-grade links) — sweep --carry-tail on-chip
+        # before ever defaulting it on.
+        self.carry_tail = carry_tail
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -191,9 +205,11 @@ class TpuBackend(Partitioner):
         t0 = time.perf_counter()
         n = stream.num_vertices
         check_tpu_vertex_range(n, self.name)
+        carry_mode = bool(self.carry_tail)
         meta = ckpt.stream_meta(stream, k, cs, weights=weights,
                                 alpha=self.alpha, comm_volume=comm_volume,
-                                state_format="minp")
+                                state_format="minp_carry" if carry_mode
+                                else "minp")
         state = ckpt.resume_state(checkpointer, meta, resume)
         from_phase = ckpt.phase_index(state.phase) if state else 0
 
@@ -249,10 +265,18 @@ class TpuBackend(Partitioner):
         else:
             # the carried forest lives in POSITION space on device (P);
             # checkpoints keep the stable vertex-space minp encoding, so
-            # the conversions happen only at checkpoint/phase boundaries
+            # the conversions happen only at checkpoint/phase boundaries.
+            # In carry mode the in-flight actives are part of the state
+            # and are checkpointed alongside (position space — pos is a
+            # pure function of the fingerprinted stream, so positions
+            # are stable across resume).
+            carry = None
             if state and state.phase == "build":
                 P = jnp.asarray(state.arrays["minp"])[order]
                 start = state.chunk_idx
+                if carry_mode and "carry_lo" in state.arrays:
+                    carry = (jnp.asarray(state.arrays["carry_lo"]),
+                             jnp.asarray(state.arrays["carry_hi"]))
             else:
                 P = jnp.full(n + 1, n, dtype=jnp.int32)
                 start = 0
@@ -262,19 +286,36 @@ class TpuBackend(Partitioner):
             if tail_at < 0:
                 tail_at = cs // 2 if jax.default_backend() != "cpu" else 0
             for padded in _device_chunks(stream, cs, n, cache, start):
-                P, rounds = elim_ops.build_chunk_step_adaptive_pos(
+                step = elim_ops.build_chunk_step_adaptive_pos(
                     P, padded, pos, pos_host_cache, n,
                     lift_levels=self.lift_levels,
                     segment_rounds=self.segment_rounds,
                     warm_schedule=self.warm_schedule, stats=build_stats,
-                    host_tail_threshold=tail_at)
+                    host_tail_threshold=tail_at,
+                    carry=carry, carry_out=carry_mode)
+                if carry_mode:
+                    P, rounds, carry = step
+                else:
+                    P, rounds = step
                 total_rounds += int(rounds)
                 idx += 1
                 maybe_fail("build", idx - start)
                 if checkpointer is not None and checkpointer.due(idx - start):
-                    checkpointer.save(
-                        "build", idx,
-                        {"deg": deg_host, "minp": np.asarray(P[pos])}, meta)
+                    arrays = {"deg": deg_host, "minp": np.asarray(P[pos])}
+                    if carry_mode:
+                        arrays["carry_lo"] = np.asarray(carry[0])
+                        arrays["carry_hi"] = np.asarray(carry[1])
+                    checkpointer.save("build", idx, arrays, meta)
+            if carry_mode and carry is not None and int(carry[0].shape[0]):
+                # resolve the final carried tail (the stream's ONE host
+                # tail); plain entry point = host-finish semantics
+                P, rounds = elim_ops.fold_edges_adaptive_pos(
+                    P, carry[0], carry[1], n,
+                    lift_levels=self.lift_levels,
+                    segment_rounds=self.segment_rounds,
+                    host_tail_threshold=tail_at,
+                    pos_host=pos_host_cache, stats=build_stats)
+                total_rounds += int(rounds)
             minp = P[pos]
             np.asarray(minp[:1])  # real completion barrier (see above)
         t["build"] = time.perf_counter() - t0

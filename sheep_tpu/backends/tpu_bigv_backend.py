@@ -26,7 +26,7 @@ class TpuBigVBackend(Partitioner):
     supports_multidevice = True
 
     def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
-                 jumps: int = 4, n_devices: int | None = None):
+                 jumps: int = 32, n_devices: int | None = None):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
         self.jumps = jumps
@@ -54,5 +54,7 @@ class TpuBigVBackend(Partitioner):
             cut_ratio=out["edge_cut"] / max(out["total_edges"], 1),
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
-            diagnostics={"fixpoint_rounds": float(out["fixpoint_rounds"])},
+            diagnostics={"fixpoint_rounds": float(out["fixpoint_rounds"]),
+                         **{k_: float(v) for k_, v in
+                            out.get("build_stats", {}).items()}},
         )

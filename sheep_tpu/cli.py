@@ -49,6 +49,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-cache-chunks", action="store_true",
                    help="disable the device-resident edge-chunk cache "
                         "(tpu backend re-streams each pass)")
+    p.add_argument("--carry-tail", dest="carry_tail", action="store_true",
+                   default=None,
+                   help="carry intermediate chunks' fixpoint tails into "
+                        "the next chunk's fold instead of host-finishing "
+                        "each one (tpu backend; default off — measured "
+                        "slower except on extreme-latency device links, "
+                        "see BASELINE.md)")
+    p.add_argument("--no-carry-tail", dest="carry_tail",
+                   action="store_false",
+                   help="host-finish every chunk's tail (see --carry-tail)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
     p.add_argument("--refine", type=int, default=0, metavar="N",
@@ -183,6 +193,8 @@ def main(argv=None) -> int:
             ctor["host_tail_threshold"] = args.host_tail_threshold
         if args.no_cache_chunks:
             ctor["cache_chunks"] = False
+        if args.carry_tail is not None:
+            ctor["carry_tail"] = args.carry_tail
         # keep only the options this backend's constructor names; warn
         # about the rest instead of silently changing the run (the
         # tuning knobs vary per backend; every registered backend's ctor

@@ -551,51 +551,28 @@ def _host_tail_finish_pos(P, loP, hiP, n: int, size: int, pos_host):
     return jnp.asarray(newP)
 
 
-def fold_edges_adaptive_pos(
+def _fold_adaptive_pos_impl(
     P: jax.Array,
     loP: jax.Array,
     hiP: jax.Array,
     n: int,
-    lift_levels: int = 0,
-    segment_rounds: int = 2,
-    descent: str = "auto",
-    max_rounds: int = 1 << 20,
-    small_size: int = 1 << 14,
-    small_jumps: int = 16,
-    host_tail: bool = True,
-    host_tail_threshold: int = 0,
-    warm_schedule: tuple = (),
-    pos_host=None,
-    stats=None,
+    lift_levels: int,
+    segment_rounds: int,
+    descent: str,
+    max_rounds: int,
+    small_size: int,
+    small_jumps: int,
+    host_tail: bool,
+    host_tail_threshold: int,
+    warm_schedule: tuple,
+    pos_host,
+    stats,
+    carry_out: bool,
 ):
-    """Host-driven fixpoint with active-set compaction and a host-finished
-    tail — same unique forest as :func:`fold_edges`, far less work.
-    Everything stays in position space; callers carry P across chunks and
-    convert to the vertex-space minp encoding only at phase boundaries.
-
-    Measured motivation (RMAT-18, cpu-jax): 106 of 122 rounds had < 4k
-    live constraints out of a 4.2M buffer, so >85% of build time was
-    climbing dead slots and rebuilding lifting tables for them; at
-    RMAT-20 the tail cascade alone was 6.8k rounds. Schedule:
-
-    - warm phase: ``warm_schedule`` = ((rounds, lift_levels), ...)
-      segments run FIRST with few lifting levels — on the real chip a
-      full-buffer round's cost is ~linear in lift_levels x buffer width,
-      and the bulk of the buffer retires in the first rounds without
-      needing long jumps, so cheap warm rounds + compaction shrink the
-      buffer before any full-depth round pays for it
-    - full mode: lifting-table segments on the current buffer
-    - after each segment, if live count <= size/2, compact the buffer to
-      max(small_size, 2*live) rounded up to a power of two (each size is
-      one extra compiled program; sizes shrink geometrically, so at most
-      ~log4(C) programs exist)
-    - once live <= ``host_tail_threshold`` and the native core is
-      available, finish on host (:func:`_host_tail_finish_pos`): the
-      displacement cascade is sequential work the CPU does in O(chain),
-      for one O(V) table round-trip per chunk
-    - fallback (no native core): jump-mode rounds at ``small_size`` —
-      O(C') gathers per round, independent of V
-    """
+    """Shared adaptive-fixpoint loop; returns (P, total, carry) where
+    ``carry`` is None (converged / host-finished) or a compacted
+    (carry_loP, carry_hiP) of the still-live constraints (carry_out mode,
+    see :func:`fold_edges_adaptive_pos_carry`)."""
     from sheep_tpu.core import native
 
     # the CLI validates R:L >= 1 at parse time; validate the Python API
@@ -648,17 +625,36 @@ def fold_edges_adaptive_pos(
         changed, r, live = (int(x) for x in np.asarray(sv))
         total += r
         stats["device_rounds"] = stats.get("device_rounds", 0) + r
-        if not changed or total >= max_rounds:
-            return P, total
-        if use_host_tail and live <= host_tail_threshold:
-            stats["host_tails"] = stats.get("host_tails", 0) + 1
-            stats["host_tail_live"] = stats.get("host_tail_live", 0) + live
-            # size the pull by the live count, not the threshold: the
-            # tail ships two O(size) arrays over the host link
-            pull = pow2_at_least(live, floor=1 << 14)
-            return (_host_tail_finish_pos(P, loP, hiP, n,
-                                          min(pull, size), pos_host),
-                    total)
+        # live == 0 is the fixpoint too (the table only changes through
+        # a retiring slot): return immediately rather than paying an
+        # empty host tail / an all-dead carry buffer / one extra
+        # confirming segment
+        if not changed or live == 0 or total >= max_rounds:
+            return P, total, None
+        if live <= host_tail_threshold:
+            if carry_out:
+                # hand the still-live tail to the NEXT chunk's fold
+                # instead of the host: the displaced cascade keeps
+                # climbing inside the next chunk's (efficient, wide)
+                # rounds, and the per-chunk O(V) table round-trip +
+                # sequential native pass disappear. Sound because the
+                # fixpoint is a property of the inserted constraint
+                # multiset, not of when each constraint resolves.
+                stats["carried_tails"] = stats.get("carried_tails", 0) + 1
+                stats["carried_live"] = stats.get("carried_live", 0) + live
+                cap = min(pow2_at_least(live, floor=1 << 14), size)
+                return P, total, compact_actives(loP, hiP, n, cap,
+                                                 dedup=True)
+            if use_host_tail:
+                stats["host_tails"] = stats.get("host_tails", 0) + 1
+                stats["host_tail_live"] = \
+                    stats.get("host_tail_live", 0) + live
+                # size the pull by the live count, not the threshold:
+                # the tail ships two O(size) arrays over the host link
+                pull = pow2_at_least(live, floor=1 << 14)
+                return (_host_tail_finish_pos(P, loP, hiP, n,
+                                              min(pull, size), pos_host),
+                        total, None)
         if size > small_size and live <= size // 2:
             new_size = pow2_at_least(2 * live, floor=small_size)
             if new_size < size:
@@ -666,6 +662,92 @@ def fold_edges_adaptive_pos(
                                            dedup=True)
                 size = new_size
                 stats["compactions"] = stats.get("compactions", 0) + 1
+
+
+def fold_edges_adaptive_pos(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 2,
+    descent: str = "auto",
+    max_rounds: int = 1 << 20,
+    small_size: int = 1 << 14,
+    small_jumps: int = 16,
+    host_tail: bool = True,
+    host_tail_threshold: int = 0,
+    warm_schedule: tuple = (),
+    pos_host=None,
+    stats=None,
+):
+    """Host-driven fixpoint with active-set compaction and a host-finished
+    tail — same unique forest as :func:`fold_edges`, far less work.
+    Everything stays in position space; callers carry P across chunks and
+    convert to the vertex-space minp encoding only at phase boundaries.
+
+    Measured motivation (RMAT-18, cpu-jax): 106 of 122 rounds had < 4k
+    live constraints out of a 4.2M buffer, so >85% of build time was
+    climbing dead slots and rebuilding lifting tables for them; at
+    RMAT-20 the tail cascade alone was 6.8k rounds. Schedule:
+
+    - warm phase: ``warm_schedule`` = ((rounds, lift_levels), ...)
+      segments run FIRST with few lifting levels — on the real chip a
+      full-buffer round's cost is ~linear in lift_levels x buffer width,
+      and the bulk of the buffer retires in the first rounds without
+      needing long jumps, so cheap warm rounds + compaction shrink the
+      buffer before any full-depth round pays for it
+    - full mode: lifting-table segments on the current buffer
+    - after each segment, if live count <= size/2, compact the buffer to
+      max(small_size, 2*live) rounded up to a power of two (each size is
+      one extra compiled program; sizes shrink geometrically, so at most
+      ~log4(C) programs exist)
+    - once live <= ``host_tail_threshold`` and the native core is
+      available, finish on host (:func:`_host_tail_finish_pos`): the
+      displacement cascade is sequential work the CPU does in O(chain),
+      for one O(V) table round-trip per chunk
+    - fallback (no native core): jump-mode rounds at ``small_size`` —
+      O(C') gathers per round, independent of V
+    """
+    P, total, _ = _fold_adaptive_pos_impl(
+        P, loP, hiP, n, lift_levels, segment_rounds, descent, max_rounds,
+        small_size, small_jumps, host_tail, host_tail_threshold,
+        warm_schedule, pos_host, stats, carry_out=False)
+    return P, total
+
+
+def fold_edges_adaptive_pos_carry(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    **opts,
+):
+    """Carry-out variant of :func:`fold_edges_adaptive_pos` for
+    intermediate stream chunks: instead of host-finishing the tail, the
+    still-live constraints are compacted and RETURNED as
+    ``(P, rounds, (carry_loP, carry_hiP))`` for the caller to prepend to
+    the next chunk's actives (empty carry when converged). Eliminates the
+    per-chunk O(V) device->host->device table round-trip and the
+    serialized native tail pass; only the stream's FINAL fold (on the
+    last carry, via the plain entry point) pays one host tail. The final
+    forest is identical — the fixpoint is determined by the inserted
+    constraint multiset, not by when each constraint resolves
+    (tests/test_tpu_ops.py pins streaming-vs-batch equality with carry
+    on)."""
+    args = (opts.pop("lift_levels", 0), opts.pop("segment_rounds", 2),
+            opts.pop("descent", "auto"), opts.pop("max_rounds", 1 << 20),
+            opts.pop("small_size", 1 << 14), opts.pop("small_jumps", 16),
+            opts.pop("host_tail", True), opts.pop("host_tail_threshold", 0),
+            opts.pop("warm_schedule", ()), opts.pop("pos_host", None),
+            opts.pop("stats", None))
+    if opts:  # reject typos BEFORE the (potentially minutes-long) fold
+        raise TypeError(f"unknown options: {sorted(opts)}")
+    P, total, carry = _fold_adaptive_pos_impl(P, loP, hiP, n, *args,
+                                              carry_out=True)
+    if carry is None:
+        carry = (jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32))
+    return P, total, carry
 
 
 def fold_edges_adaptive(
@@ -867,13 +949,25 @@ def build_chunk_step_adaptive_pos(
     and converts to/from the vertex-space minp encoding only at phase
     (and checkpoint) boundaries, so the steady-state loop runs zero
     vertex<->position conversions. Extra ``fold_opts`` (e.g.
-    host_tail_threshold) forward to :func:`fold_edges_adaptive_pos`."""
+    host_tail_threshold) forward to :func:`fold_edges_adaptive_pos`.
+
+    ``carry`` = (loP, hiP) actives carried over from the previous
+    chunk's fold (prepended to this chunk's oriented actives);
+    ``carry_out=True`` selects the carry-returning variant — the step
+    then returns (P, rounds, carry) instead of (P, rounds)."""
+    carry = fold_opts.pop("carry", None)
+    carry_out = fold_opts.pop("carry_out", False)
     loP, hiP = orient_edges_pos(chunk, pos, n)
-    return fold_edges_adaptive_pos(P, loP, hiP, n, lift_levels=lift_levels,
-                                   segment_rounds=segment_rounds,
-                                   warm_schedule=warm_schedule,
-                                   pos_host=pos_host, stats=stats,
-                                   **fold_opts)
+    if carry is not None and int(carry[0].shape[0]):
+        loP = jnp.concatenate([loP, carry[0]])
+        hiP = jnp.concatenate([hiP, carry[1]])
+    fold = fold_edges_adaptive_pos_carry if carry_out \
+        else fold_edges_adaptive_pos
+    return fold(P, loP, hiP, n, lift_levels=lift_levels,
+                segment_rounds=segment_rounds,
+                warm_schedule=warm_schedule,
+                pos_host=pos_host, stats=stats,
+                **fold_opts)
 
 
 @partial(jax.jit, static_argnames=("n", "lift_levels"))

@@ -78,13 +78,15 @@ from sheep_tpu.parallel.mesh import SHARD_AXIS
 class BigVPipeline:
     """Compiled vertex-sharded pipeline for a fixed (n, chunk_edges, mesh).
 
-    ``jumps`` = single-step parent climbs per fixpoint round (the routed
-    substitute for binary lifting); more jumps = fewer rounds but more
-    collectives per round.
+    ``jumps`` = single-step parent climbs per TAIL-phase round (bulk
+    rounds use stream-descent lifting with ``lift_levels`` tables — see
+    ``_make_fold_lift``); more tail jumps = fewer tail rounds at ~flat
+    collective bytes.
     """
 
-    def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 4,
-                 max_rounds: int = 1 << 20, segment_rounds: int = 16):
+    def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 32,
+                 max_rounds: int = 1 << 20, segment_rounds: int = 16,
+                 dedup_compact: bool = True, lift_levels: int = 0):
         d = mesh.devices.size
         self.n = n
         self.cs = chunk_edges
@@ -98,6 +100,11 @@ class BigVPipeline:
         # n_local contiguous mesh rows (jax.devices() orders by process),
         # so its local span of any block-sharded table is
         # [proc * n_local * B, (proc+1) * n_local * B)
+        self.dedup_compact = dedup_compact
+        # bulk-phase stream-descent lifting depth (0 = auto: enough to
+        # cover any ancestor chain in one round, like single-chip)
+        self.lift_levels = lift_levels if lift_levels > 0 \
+            else max(1, int(n).bit_length())
         self.procs = len({dev.process_index for dev in mesh.devices.flat})
         self.proc = jax.process_index() if self.procs > 1 else 0
         self.n_local = (sum(1 for dev in mesh.devices.flat
@@ -110,7 +117,7 @@ class BigVPipeline:
         self.batch_sharding = NamedSharding(mesh, P(SHARD_AXIS, None, None))
         self.repl = NamedSharding(mesh, P())
 
-        n_, B, D, jumps_ = self.n, self.B, d, jumps
+        n_, B, D = self.n, self.B, d
 
         # ---- routed primitives (shard_map bodies) ------------------------
 
@@ -202,90 +209,160 @@ class BigVPipeline:
 
         seg_ = self.segment_rounds
 
-        @partial(jax.jit,
-                 in_shardings=(self.shard, act, act),
-                 out_shardings=(self.shard, act, act, self.repl,
-                                self.repl, self.repl))
-        def fold_seg_step(P_sh, lo_all, hi_all):
-            """At most ``segment_rounds`` routed fixpoint rounds in one
-            device execution; the psum'd live count is the collective
-            continue signal, identical on every device/process, so the
-            host loop segment boundaries stay in lockstep. Same
-            retire/displace/climb semantics as the single-chip
-            _pos_small_round_body, with the table lookups routed."""
-            def f(P_local, lo_l, hi_l):
-                lo0, hi0 = lo_l[0], hi_l[0]
+        def _make_fold(climb):
+            """Segment program factory: at most ``segment_rounds`` routed
+            fixpoint rounds in one device execution; the psum'd live
+            count is the collective continue signal, identical on every
+            device/process, so the host loop segment boundaries stay in
+            lockstep. Retire/displace semantics match the single-chip
+            _pos_small_round_body with the table lookups routed; the ONE
+            varying piece is ``climb(P_l, cur, hi_) -> cur`` — built by
+            :func:`_make_fold_seg` (fixed jump count) or
+            :func:`_make_fold_lift` (stream-descent lifting) so the two
+            kernels cannot drift apart."""
 
-                def body(state):
-                    lo_, hi_, P_l, _, rounds = state
-                    P_l, old, new = _scatter_min(P_l, lo_, hi_)
+            @partial(jax.jit,
+                     in_shardings=(self.shard, act, act),
+                     out_shardings=(self.shard, act, act, self.repl,
+                                    self.repl, self.repl))
+            def fold_seg_step(P_sh, lo_all, hi_all):
+                def f(P_local, lo_l, hi_l):
+                    lo0, hi0 = lo_l[0], hi_l[0]
 
-                    retire = hi_ == new
-                    displaced = retire & (new < old) & (old < n_)
+                    def body(state):
+                        lo_, hi_, P_l, _, rounds = state
+                        P_l, old, new = _scatter_min(P_l, lo_, hi_)
 
-                    # climb: first step from the scatter reply, further
-                    # single steps via routed P lookups (one collective
-                    # pair per step — position space needs no order[])
-                    can0 = new < hi_
-                    cur = jnp.where(can0, new, lo_)
-                    for _ in range(jumps_ - 1):
-                        p_next = _lookup(P_l, cur)
-                        cur = jnp.where(p_next < hi_, p_next, cur)
-                    became_loop = cur == hi_
-                    climb_lo = jnp.where(became_loop, n_, cur)
-                    climb_hi = jnp.where(became_loop, n_, hi_)
+                        retire = hi_ == new
+                        displaced = retire & (new < old) & (old < n_)
 
-                    # displaced constraint: (new, old-parent position)
-                    out_lo = jnp.where(
-                        retire, jnp.where(displaced, new, n_),
-                        climb_lo).astype(jnp.int32)
-                    out_hi = jnp.where(
-                        retire, jnp.where(displaced, old, n_),
-                        climb_hi).astype(jnp.int32)
-                    live = lax.psum(jnp.sum(out_lo != n_), SHARD_AXIS)
-                    return out_lo, out_hi, P_l, live, rounds + 1
+                        # climb: first step from the scatter reply, the
+                        # rest from the pluggable climb body
+                        can0 = new < hi_
+                        cur = jnp.where(can0, new, lo_)
+                        cur = climb(P_l, cur, hi_)
+                        became_loop = cur == hi_
+                        climb_lo = jnp.where(became_loop, n_, cur)
+                        climb_hi = jnp.where(became_loop, n_, hi_)
 
-                def cond(state):
-                    _, _, _, live, rounds = state
-                    return (live > 0) & (rounds < seg_)
+                        # displaced constraint: (new, old-parent pos)
+                        out_lo = jnp.where(
+                            retire, jnp.where(displaced, new, n_),
+                            climb_lo).astype(jnp.int32)
+                        out_hi = jnp.where(
+                            retire, jnp.where(displaced, old, n_),
+                            climb_hi).astype(jnp.int32)
+                        live = lax.psum(jnp.sum(out_lo != n_), SHARD_AXIS)
+                        return out_lo, out_hi, P_l, live, rounds + 1
 
-                live0 = lax.psum(jnp.sum(lo0 != n_), SHARD_AXIS)
-                state = (lo0, hi0, P_local, live0,
-                         (live0 * 0).astype(jnp.int32))
-                lo_f, hi_f, P_f, live_f, rounds = \
-                    lax.while_loop(cond, body, state)
-                max_live = lax.pmax(jnp.sum(lo_f != n_), SHARD_AXIS)
-                return (P_f, lo_f[None], hi_f[None],
-                        live_f, lax.pmax(rounds, SHARD_AXIS), max_live)
+                    def cond(state):
+                        _, _, _, live, rounds = state
+                        return (live > 0) & (rounds < seg_)
 
-            return shard_map(
-                f, mesh=mesh,
-                in_specs=(P(SHARD_AXIS),
-                          P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
-                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None),
-                           P(SHARD_AXIS, None), P(), P(), P()))(
-                    P_sh, lo_all, hi_all)
+                    live0 = lax.psum(jnp.sum(lo0 != n_), SHARD_AXIS)
+                    state = (lo0, hi0, P_local, live0,
+                             (live0 * 0).astype(jnp.int32))
+                    lo_f, hi_f, P_f, live_f, rounds = \
+                        lax.while_loop(cond, body, state)
+                    max_live = lax.pmax(jnp.sum(lo_f != n_), SHARD_AXIS)
+                    return (P_f, lo_f[None], hi_f[None],
+                            live_f, lax.pmax(rounds, SHARD_AXIS), max_live)
+
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS),
+                              P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
+                    out_specs=(P(SHARD_AXIS), P(SHARD_AXIS, None),
+                               P(SHARD_AXIS, None), P(), P(), P()))(
+                        P_sh, lo_all, hi_all)
+
+            return fold_seg_step
+
+        def _make_fold_seg(jumps_n: int):
+            """Fold program with ``jumps_n`` single-step climbs per round
+            — the TAIL regime: a displacement cascade of length l costs
+            ~l/j rounds but ~2*l*D*Q collective words regardless of j,
+            so at small Q more jumps cut rounds (and per-op collective
+            latencies) nearly for free (measured: BASELINE.md bigv
+            entry)."""
+
+            def climb(P_l, cur, hi_):
+                for _ in range(jumps_n - 1):
+                    p_next = _lookup(P_l, cur)
+                    cur = jnp.where(p_next < hi_, p_next, cur)
+                return cur
+
+            return _make_fold(climb)
+
+        def _make_fold_lift(levels_n: int):
+            """Fold program whose climb uses STREAM-DESCENT BINARY
+            LIFTING on the distributed table — the single-chip trick
+            (ops/elim.py stream descent: square ONE table in place,
+            t <- t[t], interleaved with jumps) carried to the
+            block-sharded layout, for the BULK regime. A squaring is a
+            routed lookup at the OWNED-rows width B = V/D (D*B = V words
+            per device), *cheaper* than one jump collective at full Q —
+            and lifting collapses the round count the way it does on one
+            chip (measured: 430 jump rounds -> 31 lift rounds at
+            RMAT-15/D=8 with ~6x less total traffic, BASELINE.md).
+            Memory stays O(V/D): exactly one extra table block lives at
+            a time. Every taken jump lands on a genuine ancestor still
+            earlier than hi, so each rewrite is sound and the unique
+            fixpoint is unchanged."""
+
+            def climb(P_l, cur, hi_):
+                t = P_l
+                for j in range(levels_n):
+                    cand = _lookup(t, cur)
+                    cur = jnp.where(cand < hi_, cand, cur)
+                    if j < levels_n - 1:
+                        t = _lookup(t, t)   # routed squaring (width B)
+                return cur
+
+            return _make_fold(climb)
 
         def _make_compact(to_size: int):
-            """Pack each device's live (loP, hiP) actives into a
+            """Dedup + pack each device's live (loP, hiP) actives into a
             (D, to_size) buffer (valid when every device's live count <=
             to_size — the caller checks the pmax). Shrinking Q directly
             shrinks every routed collective: all_gather/all_to_all ship
-            D * Q words per round."""
+            D * Q words per round.
+
+            The dedup (drop duplicate (lo, hi) pairs via one 2-key sort,
+            exactly like the single-chip ``compact_actives(dedup=True)``)
+            is the "dedup requests before the all_gather" lever: after a
+            few rounds many slots have been rewritten to the same
+            (ancestor, hi) constraint — on hub-skewed graphs MOST of
+            them (a star graph's requests all climb to the hub). The
+            constraint closure is a SET property (duplicates retire
+            together and spawn identical displacements), so dropping
+            in-shard duplicates is exact; cross-shard duplicates remain
+            (deduping them would need an extra routed pass). Runs only
+            at compaction cadence, not per round — a per-round sort was
+            measured in seconds at C=2^24 on the v5e (BASELINE.md)."""
             act = NamedSharding(mesh, P(SHARD_AXIS, None))
+
+            dedup = self.dedup_compact
 
             @partial(jax.jit,
                      in_shardings=(act, act),
                      out_shardings=(act, act))
             def compact_step(lo_all, hi_all):
                 def f(lo_l, hi_l):
-                    lo0 = lo_l[0]
+                    lo0, hi0 = lo_l[0], hi_l[0]
+                    if dedup:
+                        lo0, hi0 = lax.sort((lo0, hi0), num_keys=2)
+                        dup = (lo0 == jnp.roll(lo0, 1)) & \
+                            (hi0 == jnp.roll(hi0, 1))
+                        dup = dup.at[0].set(False)
+                        lo0 = jnp.where(dup, n_, lo0)
+                        hi0 = jnp.where(dup, n_, hi0)
                     c = lo0.shape[0]
                     sel = jnp.nonzero(lo0 != n_, size=to_size,
                                       fill_value=c)[0]
                     ext = lambda a: jnp.concatenate(
                         [a, jnp.full(1, n_, a.dtype)])[sel]
-                    return (ext(lo0)[None], ext(hi_l[0])[None])
+                    return (ext(lo0)[None], ext(hi0)[None])
                 return shard_map(
                     f, mesh=mesh,
                     in_specs=(P(SHARD_AXIS, None),) * 2,
@@ -317,33 +394,95 @@ class BigVPipeline:
         self.deg_zeros = deg_zeros
         self.deg_step = deg_step
         self.orient_step = orient_step
-        self.fold_seg_step = fold_seg_step
         self.score_step = score_step
         self.max_rounds = max_rounds
         self._make_compact = _make_compact
         self._compact_cache: dict = {}
+        self._make_fold_seg = _make_fold_seg
+        self._fold_seg_cache: dict = {}
+        self._make_fold_lift = _make_fold_lift
+        self._fold_lift_cache: dict = {}
 
-    MIN_Q = 1 << 12
+    # compaction floor: the tail's collective bytes are ~ops x D x Q x
+    # rounds, and the tail runs hundreds of rounds at the FLOOR width —
+    # measured at RMAT-15/D=8, a 4096 floor put ~3.4 GB of the 4 GB
+    # per-device total in the tail; 512 cuts that ~8x for a handful of
+    # extra (cached, geometrically-sized) compaction programs
+    MIN_Q = 1 << 9
+    # once the active width compacts to <= TAIL_Q, switch from the
+    # lifting program to a jump program with ``self.jumps`` climb steps
+    # per round: the remaining work is displacement cascades (one link
+    # per jump), and at small Q the extra lookups per round are far
+    # cheaper than the rounds they save
+    TAIL_Q = 1 << 13
 
-    def build_step(self, P_sh, pos_sh, batch_dev):
+    def _round_cost(self, q: int, jumps: int, lift: bool):
+        """(collective ops, bytes received per device) for ONE fixpoint
+        round at active width Q: _scatter_min = 2 all_gather +
+        2 all_to_all at Q; a jump round adds (jumps-1) lookup pairs at
+        Q; a lift round adds ``lift_levels`` lookup pairs at Q plus
+        (lift_levels - 1) squaring pairs at the owned-rows width B.
+        Every collective ships (D, width) int32 — the D*Q-words trade
+        documented in the module docstring, now *measured* per chunk
+        (diagnostics) instead of only documented."""
+        d = self.n_devices
+        if lift:
+            L = self.lift_levels
+            ops = 4 + 2 * L + 2 * (L - 1)
+            words = d * (4 * q + 2 * L * q + 2 * (L - 1) * self.B)
+        else:
+            ops = 4 + 2 * (jumps - 1)
+            words = d * ops * q
+        return ops, 4 * words
+
+    def build_step(self, P_sh, pos_sh, batch_dev, stats=None):
         """Fold one sharded batch into the distributed forest via
         host-bounded segments. Returns (P_sh, total_rounds) — identical
         to running the whole fixpoint in one execution, but no single
         device call exceeds ``segment_rounds`` rounds, and the active
-        buffers compact to the pmax live width as the set collapses (every
-        routed collective ships D*Q words, so smaller Q = proportionally
-        less ICI/DCN traffic per tail round)."""
+        buffers compact (with in-shard dedup) to the pmax live width as
+        the set collapses (every routed collective ships D*Q words, so
+        smaller Q = proportionally less ICI/DCN traffic per tail round).
+
+        ``stats``: accumulates collective_ops / collective_bytes /
+        compactions / q_rounds (sum of Q over rounds) for the run
+        diagnostics."""
+        if stats is None:
+            stats = {}
         lo_a, hi_a = self.orient_step(pos_sh, batch_dev)
         size = int(lo_a.shape[-1])
+        # orient: 2 routed lookups (u, v) at chunk width
+        stats["collective_ops"] = stats.get("collective_ops", 0) + 4
+        stats["collective_bytes"] = stats.get("collective_bytes", 0) \
+            + 4 * 4 * self.n_devices * size
         total = 0
         while True:
-            P_sh, lo_a, hi_a, live, r, max_live = \
-                self.fold_seg_step(P_sh, lo_a, hi_a)
-            total += int(r)
+            # bulk: stream-descent lifting (few rounds, +V squaring
+            # words/round); tail: many-jump rounds (no V-term at all)
+            lift = size > self.TAIL_Q
+            if lift:
+                fold = self._fold_lift_cache.get(self.lift_levels)
+                if fold is None:
+                    fold = self._fold_lift_cache[self.lift_levels] = \
+                        self._make_fold_lift(self.lift_levels)
+                jumps = 0
+            else:
+                jumps = self.jumps
+                fold = self._fold_seg_cache.get(jumps)
+                if fold is None:
+                    fold = self._fold_seg_cache[jumps] = \
+                        self._make_fold_seg(jumps)
+            P_sh, lo_a, hi_a, live, r, max_live = fold(P_sh, lo_a, hi_a)
+            r = int(r)
+            total += r
+            ops, byts = self._round_cost(size, jumps, lift)
+            stats["collective_ops"] += ops * r
+            stats["collective_bytes"] += byts * r
+            stats["q_rounds"] = stats.get("q_rounds", 0) + size * r
             if int(live) == 0 or total >= self.max_rounds:
                 return P_sh, total
             ml = int(max_live)
-            if size > self.MIN_Q and ml <= size // 4:
+            if size > self.MIN_Q and ml <= size // 2:
                 new_size = pow2_at_least(2 * ml, floor=self.MIN_Q)
                 if new_size < size:
                     fn = self._compact_cache.get(new_size)
@@ -352,6 +491,7 @@ class BigVPipeline:
                             self._make_compact(new_size)
                     lo_a, hi_a = fn(lo_a, hi_a)
                     size = new_size
+                    stats["compactions"] = stats.get("compactions", 0) + 1
 
     # ---- host-side helpers ----------------------------------------------
     def _put(self, sharding, arr: np.ndarray):
@@ -484,6 +624,7 @@ class BigVPipeline:
         # pass 2: the single distributed forest (position-indexed table)
         t0 = time.perf_counter()
         total_rounds = 0
+        build_stats: dict = {}
         if state and from_phase >= 2:
             P_sh = self._put(self.shard, state.arrays["ptable_local"])
         else:
@@ -496,7 +637,8 @@ class BigVPipeline:
             nb = 0
             for batch in batches(start):
                 P_sh, rounds = self.build_step(
-                    P_sh, pos_sh, self._put(self.batch_sharding, batch))
+                    P_sh, pos_sh, self._put(self.batch_sharding, batch),
+                    stats=build_stats)
                 total_rounds += rounds
                 nb += 1
                 maybe_fail("build", nb)
@@ -581,4 +723,5 @@ class BigVPipeline:
             "pos": pos_np, "degrees": deg_host, "edge_cut": cut,
             "total_edges": total, "balance": balance, "comm_volume": cv,
             "k": k, "fixpoint_rounds": total_rounds,
+            "build_stats": build_stats,
         }

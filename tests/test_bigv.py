@@ -131,3 +131,21 @@ def test_bigv_per_device_tables_are_sharded():
     shard_shapes = {s.data.shape for s in sharded.addressable_shards}
     assert shard_shapes == {(pipe.B,)}
     assert pipe.B < (n + 1) / 4  # 8 devices -> each holds ~1/8
+
+
+def test_bigv_lift_bulk_and_compaction_paths():
+    """Exercise the bulk-phase stream-descent LIFT kernel and the
+    dedup'd compaction in the default suite: every other test here uses
+    tiny chunks (Q <= TAIL_Q), which run jump rounds only. RMAT-13 ef16
+    at D=8 gives a per-device Q of 16384 > TAIL_Q = 8192, so the first
+    segments run the lifting climb, then the live-set collapse triggers
+    compaction (with in-shard dedup) and the jump tail. The forest must
+    still match the oracle exactly."""
+    n = 1 << 13
+    e = generators.rmat(13, 16, seed=41)
+    out = _run(e, n, chunk_edges=len(e))
+    _, expect_parent = _oracle(e, n)
+    np.testing.assert_array_equal(out["parent"], expect_parent)
+    st = out["build_stats"]
+    assert st.get("compactions", 0) >= 1, st
+    assert st.get("collective_bytes", 0) > 0

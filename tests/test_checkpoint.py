@@ -219,3 +219,48 @@ def test_cli_checkpoint_resume(tmp_path, monkeypatch):
                      "--output", out2, "--json"]) == 0
     assert np.array_equal(formats.read_partition(out1),
                           formats.read_partition(out2))
+
+
+@pytest.mark.parametrize("phase", ["build", "score"])
+def test_fault_then_resume_carry_mode(tmp_path, phase, monkeypatch):
+    """Kill+resume with carry-over tails: the in-flight carried actives
+    are checkpointed state, so the resumed run must still match the
+    uninterrupted one exactly."""
+    if "tpu" not in list_backends():
+        pytest.skip("tpu backend unavailable")
+    es = graph()
+    kw = {"chunk_edges": CHUNK, "carry_tail": True}
+    expect = get_backend("tpu", **kw).partition(es, K, comm_volume=True)
+
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, f"{phase}:2")
+    with pytest.raises(InjectedFault):
+        get_backend("tpu", **kw).partition(
+            es, K, comm_volume=True, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    assert ck.load() is not None
+
+    res = get_backend("tpu", **kw).partition(
+        es, K, comm_volume=True, checkpointer=ck, resume=True)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert res.edge_cut == expect.edge_cut
+    assert res.comm_volume == expect.comm_volume
+
+
+def test_carry_checkpoint_gated_from_no_carry_resume(tmp_path, monkeypatch):
+    """state_format distinguishes carry-mode checkpoints, so a checkpoint
+    written with carry_tail=True refuses a carry_tail=False resume
+    (different in-flight state shape) instead of silently dropping the
+    carried constraints."""
+    if "tpu" not in list_backends():
+        pytest.skip("tpu backend unavailable")
+    es = graph()
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, "build:2")
+    with pytest.raises(InjectedFault):
+        get_backend("tpu", chunk_edges=CHUNK, carry_tail=True).partition(
+            es, K, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    with pytest.raises(ValueError, match="does not match"):
+        get_backend("tpu", chunk_edges=CHUNK, carry_tail=False).partition(
+            es, K, checkpointer=ck, resume=True)

@@ -406,3 +406,44 @@ def test_sortmerge_round_bit_identical(graph, jumps):
         for name, x, y in zip(("loP", "hiP", "P", "stats"), a, b):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
                                           err_msg=f"{name} diverged")
+
+
+def test_streaming_carry_matches_batch(graph):
+    """Carry-over streaming (intermediate chunks hand their live tail to
+    the NEXT chunk's fold instead of host-finishing) must converge to the
+    identical forest: the fixpoint is a property of the inserted
+    constraint multiset, not of when each constraint resolves."""
+    e, n = graph
+    pos, order = _device_order(e, n)
+    pos_host = np.asarray(pos[:n])
+    whole, _ = elim_ops.build_chunk_step(
+        jnp.full(n + 1, n, dtype=jnp.int32), pad_chunk(e, len(e), n),
+        pos, order, n)
+    P = jnp.full(n + 1, n, dtype=jnp.int32)
+    carry = None
+    size = 37
+    # tiny threshold + tiny small_size force the carry branch to trigger
+    # on every chunk rather than converging within the chunk
+    for off in range(0, len(e), size):
+        P, _, carry = elim_ops.build_chunk_step_adaptive_pos(
+            P, pad_chunk(e[off:off + size], size, n), pos, pos_host, n,
+            warm_schedule=((1, 2),), host_tail_threshold=size,
+            small_size=8, carry=carry, carry_out=True)
+    if int(carry[0].shape[0]):
+        P, _ = elim_ops.fold_edges_adaptive_pos(
+            P, carry[0], carry[1], n, pos_host=pos_host)
+    np.testing.assert_array_equal(np.asarray(P[pos]), np.asarray(whole))
+
+
+@pytest.mark.parametrize("carry_tail", [True, False])
+def test_tpu_backend_carry_modes_match_oracle(graph, carry_tail):
+    """End-to-end backend equality in both tail modes on multi-chunk
+    streams (cpu-jax default is carry_tail=False, so True is forced)."""
+    e, n = graph
+    es = EdgeStream.from_array(e, n_vertices=n)
+    res = TpuBackend(chunk_edges=64, carry_tail=carry_tail).partition(
+        es, 4, comm_volume=True)
+    ref = pure.partition_arrays(e, 4, n=n)
+    np.testing.assert_array_equal(res.assignment, ref.assignment)
+    assert res.edge_cut == ref.edge_cut
+    assert res.comm_volume == ref.comm_volume

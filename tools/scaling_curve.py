@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Multi-chip scaling evidence S(D) on the virtual CPU mesh (VERDICT r2
+item 3): for D in 1,2,4,8 run tpu-sharded and tpu-bigv at a fixed graph
+and record what transfers to real hardware — per-phase wall (reference
+only on cpu-jax), fixpoint rounds, merge payload bytes (sharded),
+collective ops/bytes (bigv) — plus the cross-D correctness assert
+(identical cut at every D; sharded is bit-identical to D=1 by the
+existing test suite).
+
+The absolute wall numbers on a virtual mesh are NOT chip predictions;
+the collective counts and payload bytes ARE the quantities the ICI cost
+model consumes (BASELINE.md "revised 10x thesis").
+
+Usage:
+    python tools/scaling_curve.py [--scale 18] [--ef 16] [--k 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = os.environ.get("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+from sheep_tpu.utils.platform import pin_platform  # noqa: E402
+
+pin_platform(os.environ["JAX_PLATFORMS"])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=18)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--backends", default="tpu-sharded,tpu-bigv")
+    args = ap.parse_args()
+
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io import generators
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    n = 1 << args.scale
+    e = generators.rmat(args.scale, args.ef, seed=21)
+    cuts = {}
+    for backend in args.backends.split(","):
+        for d in (1, 2, 4, 8):
+            es = EdgeStream.from_array(e, n_vertices=n)
+            kw = {"n_devices": d, "chunk_edges": max(4096, len(e) // d)}
+            t0 = time.perf_counter()
+            res = get_backend(backend, **kw).partition(
+                es, args.k, comm_volume=False)
+            wall = time.perf_counter() - t0
+            rec = {"backend": backend, "D": d,
+                   "wall_s": round(wall, 2),
+                   "phases": {p: round(s, 2)
+                              for p, s in res.phase_times.items()},
+                   "edge_cut": res.edge_cut,
+                   **{k_: v for k_, v in (res.diagnostics or {}).items()}}
+            cuts.setdefault(backend, set()).add(res.edge_cut)
+            print(json.dumps(rec), flush=True)
+    for backend, cs in cuts.items():
+        assert len(cs) == 1, f"{backend}: cut varies across D: {cs}"
+    print(json.dumps({"summary": "cut identical across all D per backend",
+                      "cuts": {b: list(c)[0] for b, c in cuts.items()}}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
