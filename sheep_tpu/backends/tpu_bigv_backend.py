@@ -26,7 +26,7 @@ class TpuBigVBackend(Partitioner):
     supports_multidevice = True
 
     def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
-                 jumps: int = 32, n_devices: int | None = None):
+                 jumps: int = 128, n_devices: int | None = None):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
         self.jumps = jumps

@@ -59,4 +59,6 @@ class TpuShardedBackend(Partitioner):
             cut_ratio=out["edge_cut"] / max(out["total_edges"], 1),
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
+            diagnostics={k_: (v if isinstance(v, (int, float)) else str(v))
+                         for k_, v in out.get("merge_stats", {}).items()},
         )

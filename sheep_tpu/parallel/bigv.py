@@ -84,7 +84,7 @@ class BigVPipeline:
     collective bytes.
     """
 
-    def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 32,
+    def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 128,
                  max_rounds: int = 1 << 20, segment_rounds: int = 16,
                  dedup_compact: bool = True, lift_levels: int = 0):
         d = mesh.devices.size
