@@ -216,6 +216,83 @@ def _run_segment(body, P, loP, hiP, n: int, segment_rounds: int):
     return loP, hiP, P, stats
 
 
+def _pos_round_body_stale(n: int, tables: tuple):
+    """Round body for :func:`fold_segment_pos_hoisted`: identical
+    retire/displace semantics to :func:`_pos_round_body` (exact
+    descent), but the lifting tables above level 0 are STALE closures —
+    built once per segment — while level 0 is always the CURRENT table.
+    Sound because ancestor-ship is permanent (when a parent improves,
+    the displaced constraint re-links the old parent above the new
+    one), so a stale table's jumps land on genuine — just possibly
+    non-maximal — ancestors; any progress missed is caught after the
+    next rebuild. Saves (R-1)/R of the L x V squaring gathers per
+    segment, the round's dominant V-term (BASELINE.md 'stale lifting
+    tables')."""
+
+    def body(state):
+        lo_, hi_, P_, _, rounds = state
+        old_at_lo = P_[lo_]
+        newP = P_.at[lo_].min(hi_, mode="drop")
+        now = newP[lo_]
+
+        cur = lo_
+        for t in reversed(tables):
+            cand = t[cur]
+            cur = jnp.where(cand < hi_, cand, cur)
+        # level 0 last and CURRENT: guarantees one-step progress per
+        # live slot even right after a displacement spawn
+        cand = newP[cur]
+        cur = jnp.where(cand < hi_, cand, cur)
+        became_loop = cur == hi_
+        climb_lo = jnp.where(became_loop, n, cur)
+        climb_hi = jnp.where(became_loop, n, hi_)
+
+        retire = hi_ == now
+        displaced = retire & (now < old_at_lo) & (old_at_lo < n)
+        out_lo = jnp.where(retire,
+                           jnp.where(displaced, now, n),
+                           climb_lo).astype(jnp.int32)
+        out_hi = jnp.where(retire,
+                           jnp.where(displaced, old_at_lo, n),
+                           climb_hi).astype(jnp.int32)
+        changed = jnp.any((out_lo != lo_) | (out_hi != hi_))
+        return out_lo, out_hi, newP, changed, rounds + 1
+
+    return body
+
+
+@partial(jax.jit, static_argnames=("n", "lift_levels", "segment_rounds"))
+def fold_segment_pos_hoisted(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 32,
+):
+    """:func:`fold_segment_pos` (exact descent) with the lifting-table
+    stack HOISTED out of the round loop: tables t_1..t_{L-1} are built
+    once from the entry table and stay fixed for the whole segment;
+    only level 0 (the table itself) is current inside rounds. Same
+    (loP, hiP, P, stats) contract. The final forest is the same unique
+    fixpoint (stale jumps are sound, see :func:`_pos_round_body_stale`);
+    per-round trajectories may differ from the fresh-table body, so the
+    adaptive driver treats round counts as diagnostics, not contracts.
+
+    Fixpoint-exit soundness: the driver loop only stops on a segment
+    reporting no change, and every segment starts with tables freshly
+    built from its entry table — a first round that changes nothing ran
+    with a fully-current view, so 'no change' is a genuine fixpoint."""
+    lift_levels, _ = _resolve(n, lift_levels, "exact")
+    t = P.astype(jnp.int32)
+    tables = []
+    for _ in range(lift_levels - 1):
+        t = t[t]
+        tables.append(t)
+    body = _pos_round_body_stale(n, tuple(tables))
+    return _run_segment(body, P, loP, hiP, n, segment_rounds)
+
+
 @partial(jax.jit, static_argnames=("n", "lift_levels", "segment_rounds",
                                    "descent"))
 def fold_segment_pos(
@@ -568,6 +645,7 @@ def _fold_adaptive_pos_impl(
     pos_host,
     stats,
     carry_out: bool,
+    stale_tables: bool = True,
 ):
     """Shared adaptive-fixpoint loop; returns (P, total, carry) where
     ``carry`` is None (converged / host-finished) or a compacted
@@ -607,9 +685,18 @@ def _fold_adaptive_pos_impl(
             stats["warm_segments"] = stats.get("warm_segments", 0) + 1
         elif size > small_size:
             seg = min(segment_rounds, max_rounds - total)
-            loP, hiP, P, sv = fold_segment_pos(
-                P, loP, hiP, n, lift_levels=lift_levels,
-                segment_rounds=seg, descent=descent)
+            rl, rd = _resolve(n, lift_levels, descent)
+            if stale_tables and rd == "exact" and seg > 1:
+                # exact descent with per-SEGMENT (stale) tables: saves
+                # (seg-1)/seg of the L x V squaring gathers — the
+                # round's dominant V-term (same unique fixpoint; see
+                # fold_segment_pos_hoisted)
+                loP, hiP, P, sv = fold_segment_pos_hoisted(
+                    P, loP, hiP, n, lift_levels=rl, segment_rounds=seg)
+            else:
+                loP, hiP, P, sv = fold_segment_pos(
+                    P, loP, hiP, n, lift_levels=lift_levels,
+                    segment_rounds=seg, descent=descent)
             stats["full_segments"] = stats.get("full_segments", 0) + 1
         else:
             seg = min(max(segment_rounds, 64), max_rounds - total)
@@ -680,6 +767,7 @@ def fold_edges_adaptive_pos(
     warm_schedule: tuple = (),
     pos_host=None,
     stats=None,
+    stale_tables: bool = True,
 ):
     """Host-driven fixpoint with active-set compaction and a host-finished
     tail — same unique forest as :func:`fold_edges`, far less work.
@@ -712,7 +800,8 @@ def fold_edges_adaptive_pos(
     P, total, _ = _fold_adaptive_pos_impl(
         P, loP, hiP, n, lift_levels, segment_rounds, descent, max_rounds,
         small_size, small_jumps, host_tail, host_tail_threshold,
-        warm_schedule, pos_host, stats, carry_out=False)
+        warm_schedule, pos_host, stats, carry_out=False,
+        stale_tables=stale_tables)
     return P, total
 
 
@@ -741,10 +830,12 @@ def fold_edges_adaptive_pos_carry(
             opts.pop("host_tail", True), opts.pop("host_tail_threshold", 0),
             opts.pop("warm_schedule", ()), opts.pop("pos_host", None),
             opts.pop("stats", None))
+    stale = opts.pop("stale_tables", True)
     if opts:  # reject typos BEFORE the (potentially minutes-long) fold
         raise TypeError(f"unknown options: {sorted(opts)}")
     P, total, carry = _fold_adaptive_pos_impl(P, loP, hiP, n, *args,
-                                              carry_out=True)
+                                              carry_out=True,
+                                              stale_tables=stale)
     if carry is None:
         carry = (jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32))
     return P, total, carry
