@@ -162,14 +162,20 @@ def test_soak_bigv_mesh_mid_scale():
     res = None
     with tempfile.TemporaryDirectory() as d:
         ck = Checkpointer(d, every=8)
-        os.environ[ENV_VAR] = "build:1"
+        # build:2, not build:1 — maybe_fail("build", nb) runs BEFORE the
+        # nb-th save, so a fault at nb=1 would fire before ANY build
+        # checkpoint exists and resume would restore the degrees phase,
+        # skipping the ptable_local build-restore branch this test covers
+        os.environ[ENV_VAR] = "build:2"
         try:
             with pytest.raises(InjectedFault):
-                get_backend("tpu-bigv", chunk_edges=1 << 20).partition(
+                get_backend("tpu-bigv", chunk_edges=1 << 20,
+                            n_devices=8).partition(
                     es, 64, comm_volume=False, checkpointer=ck)
         finally:
             del os.environ[ENV_VAR]
-        res = get_backend("tpu-bigv", chunk_edges=1 << 20).partition(
+        res = get_backend("tpu-bigv", chunk_edges=1 << 20,
+                          n_devices=8).partition(
             es, 64, comm_volume=False, checkpointer=ck, resume=True)
     if native.available():
         ref = get_backend("cpu", chunk_edges=1 << 22).partition(

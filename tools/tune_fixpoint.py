@@ -60,6 +60,14 @@ def main():
     ap.add_argument("--tail-divisors", default="8",
                     help="comma list d: host_tail_threshold = C/d "
                          "(0 = keep the auto default)")
+    ap.add_argument("--stale", default="1",
+                    help="comma list of 0/1: per-segment stale lifting "
+                         "tables on full exact-descent segments "
+                         "(BASELINE.md 'stale lifting tables' A/B)")
+    ap.add_argument("--carry", default="0",
+                    help="comma list of 0/1: carry-over tails between "
+                         "chunks instead of per-chunk host tails "
+                         "(BASELINE.md 'carry-over tails' A/B)")
     ap.add_argument("--reps", type=int, default=1)
     args = ap.parse_args()
 
@@ -94,7 +102,7 @@ def main():
     pos, order = order_ops.elimination_order(deg[:n], n)
     pos_host = np.asarray(pos[:n])
 
-    def run(chunk_log, warm_name, seg_rounds, lift, tail_div):
+    def run(chunk_log, warm_name, seg_rounds, lift, tail_div, stale, carry):
         cs = 1 << chunk_log
         # pre-pad + pre-upload all chunks so only fold time is measured
         dev_chunks = [jnp.asarray(pad_chunk(edges[i:i + cs], cs, n))
@@ -103,14 +111,28 @@ def main():
         stats: dict = {}
         P = jnp.full(n + 1, n, dtype=jnp.int32)
         total = 0
+        carried = None
         t0 = time.perf_counter()
         for d in dev_chunks:
-            P, rounds = elim_ops.build_chunk_step_adaptive_pos(
+            step = elim_ops.build_chunk_step_adaptive_pos(
                 P, d, pos, pos_host, n,
                 lift_levels=lift,
                 segment_rounds=seg_rounds,
                 warm_schedule=WARM_SCHEDULES[warm_name], stats=stats,
-                host_tail_threshold=(cs // tail_div if tail_div else 0))
+                host_tail_threshold=(cs // tail_div if tail_div else 0),
+                stale_tables=bool(stale),
+                carry=carried, carry_out=bool(carry))
+            if carry:
+                P, rounds, carried = step
+            else:
+                P, rounds = step
+            total += int(rounds)
+        if carry and carried is not None and int(carried[0].shape[0]):
+            P, rounds = elim_ops.fold_edges_adaptive_pos(
+                P, carried[0], carried[1], n, lift_levels=lift,
+                segment_rounds=seg_rounds,
+                host_tail_threshold=(cs // tail_div if tail_div else 0),
+                pos_host=pos_host, stats=stats, stale_tables=bool(stale))
             total += int(rounds)
         np.asarray(P[:8])  # force completion (block_until_ready lies
         # through the tunnel; see tools/microbench_fixpoint.py)
@@ -123,14 +145,17 @@ def main():
     seg_rounds_list = [int(x) for x in args.segment_rounds.split(",")]
     lifts = [int(x) for x in args.lift_levels.split(",")]
     tail_divs = [int(x) for x in args.tail_divisors.split(",")]
+    stales = [int(x) for x in args.stale.split(",")]
+    carries = [int(x) for x in args.carry.split(",")]
 
     reference = None
     best = None
-    for cl, wn, sr, lv, td in itertools.product(
-            chunk_logs, warm_names, seg_rounds_list, lifts, tail_divs):
+    for cl, wn, sr, lv, td, st, ca in itertools.product(
+            chunk_logs, warm_names, seg_rounds_list, lifts, tail_divs,
+            stales, carries):
         dts = []
         for rep in range(args.reps):
-            P, dt, total, stats = run(cl, wn, sr, lv, td)
+            P, dt, total, stats = run(cl, wn, sr, lv, td, st, ca)
             dts.append(dt)
         dt = min(dts)
         P_np = np.asarray(P)
@@ -138,14 +163,15 @@ def main():
             reference = P_np
         else:
             assert np.array_equal(reference, P_np), \
-                f"schedule {wn} changed the forest!"
+                (f"config warm={wn} seg={sr} L={lv} td={td} stale={st} "
+                 f"carry={ca} changed the forest!")
         line = {"chunk_log": cl, "warm": wn, "segment_rounds": sr,
-                "lift_levels": lv, "tail_div": td,
-                "build_s": round(dt, 2), "rounds": total,
+                "lift_levels": lv, "tail_div": td, "stale": st,
+                "carry": ca, "build_s": round(dt, 2), "rounds": total,
                 "platform": plat, **{k: int(v) for k, v in stats.items()}}
         print(json.dumps(line), flush=True)
-        log(f"chunk=2^{cl} warm={wn:5s} seg={sr} L={lv} td={td}: "
-            f"{dt:7.2f}s rounds={total} {stats}")
+        log(f"chunk=2^{cl} warm={wn:5s} seg={sr} L={lv} td={td} st={st} "
+            f"ca={ca}: {dt:7.2f}s rounds={total} {stats}")
         if best is None or dt < best[0]:
             best = (dt, line)
     log(f"best: {best[1]}")
