@@ -21,9 +21,14 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     out="tools/out/$ts"
     mkdir -p "$out"
     echo "tunnel healthy at $ts; capturing" | tee "$out/watch.log"
+    # chunk-log 23 FIRST: its programs are warm in the persistent
+    # compilation cache from any prior bench.py run, so the sweep's
+    # first lines land within minutes — the tunnel has twice wedged
+    # mid-capture while cold 2^24 programs compiled (r3: 30 min of
+    # remote_compile then connection refused, zero lines landed)
     timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
-      --chunk-logs 24,23 --warm w1,w44,w8 --segment-rounds 2 \
-      --lift-levels 0 --tail-divisors 2 \
+      --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
+      --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
       >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
     tune_rc=$?
     timeout 3600 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
