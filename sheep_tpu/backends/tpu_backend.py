@@ -157,7 +157,8 @@ class TpuBackend(Partitioner):
                  alpha: float = 1.0, segment_rounds: int = 2,
                  warm_schedule=None, cache_chunks: bool = True,
                  host_tail_threshold: int = -1,
-                 carry_tail: Optional[bool] = None):
+                 carry_tail: Optional[bool] = None,
+                 tail_overlap: Optional[bool] = None):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -191,6 +192,18 @@ class TpuBackend(Partitioner):
         # expensive (tunnel-grade links) — sweep --carry-tail on-chip
         # before ever defaulting it on.
         self.carry_tail = carry_tail
+        # overlap each chunk's host tail with the NEXT chunk's device
+        # rounds: the tail is resolved by the native pass in a worker
+        # thread and re-enters a later fold as O(changed) delta
+        # constraints (ops/elim.py host_tail_delta) instead of an O(V)
+        # table push — the device never waits for the host. Same unique
+        # forest (constraint-multiset argument; pinned by
+        # tests/test_tail_overlap.py). Default OFF pending the on-chip
+        # sweep; mutually exclusive with carry_tail.
+        self.tail_overlap = tail_overlap
+        if carry_tail and tail_overlap:
+            raise ValueError("carry_tail and tail_overlap are mutually "
+                             "exclusive tail strategies")
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -285,27 +298,72 @@ class TpuBackend(Partitioner):
             tail_at = self.host_tail_threshold
             if tail_at < 0:
                 tail_at = cs // 2 if jax.default_backend() != "cpu" else 0
-            for padded in _device_chunks(stream, cs, n, cache, start):
-                step = elim_ops.build_chunk_step_adaptive_pos(
-                    P, padded, pos, pos_host_cache, n,
-                    lift_levels=self.lift_levels,
-                    segment_rounds=self.segment_rounds,
-                    warm_schedule=self.warm_schedule, stats=build_stats,
-                    host_tail_threshold=tail_at,
-                    carry=carry, carry_out=carry_mode)
-                if carry_mode:
-                    P, rounds, carry = step
-                else:
-                    P, rounds = step
-                total_rounds += int(rounds)
-                idx += 1
-                maybe_fail("build", idx - start)
-                if checkpointer is not None and checkpointer.due(idx - start):
-                    arrays = {"deg": deg_host, "minp": np.asarray(P[pos])}
+            from contextlib import nullcontext
+
+            from sheep_tpu.core import native as native_mod
+
+            overlap = (bool(self.tail_overlap) and not carry_mode
+                       and native_mod.available())
+            ov_ctx = elim_ops.TailOverlap(n, pos_host_cache) if overlap \
+                else nullcontext()
+
+            with ov_ctx as ov:
+
+                def _flush_deltas() -> None:
+                    # resolve everything still in flight into P,
+                    # synchronously (checkpoint boundaries and the end of
+                    # the stream: saved state must be the complete
+                    # constraint multiset)
+                    nonlocal P, total_rounds
+                    ov.drain(True)
+                    inj = ov.take_inject()
+                    if inj is not None:
+                        P, r = elim_ops.fold_edges_adaptive_pos(
+                            P, inj[0], inj[1], n,
+                            lift_levels=self.lift_levels,
+                            segment_rounds=self.segment_rounds,
+                            host_tail_threshold=tail_at,
+                            pos_host=pos_host_cache, stats=build_stats)
+                        total_rounds += int(r)
+
+                for padded in _device_chunks(stream, cs, n, cache, start):
+                    if overlap:
+                        # pick up any host-resolved tails without waiting;
+                        # they enter this fold as ordinary actives
+                        ov.drain(False)
+                        carry = ov.take_inject()
+                    step = elim_ops.build_chunk_step_adaptive_pos(
+                        P, padded, pos, pos_host_cache, n,
+                        lift_levels=self.lift_levels,
+                        segment_rounds=self.segment_rounds,
+                        warm_schedule=self.warm_schedule, stats=build_stats,
+                        host_tail_threshold=tail_at,
+                        carry=carry, carry_out=carry_mode or overlap)
                     if carry_mode:
-                        arrays["carry_lo"] = np.asarray(carry[0])
-                        arrays["carry_hi"] = np.asarray(carry[1])
-                    checkpointer.save("build", idx, arrays, meta)
+                        P, rounds, carry = step
+                    elif overlap:
+                        P, rounds, tail = step
+                        carry = None
+                        if int(tail[0].shape[0]):
+                            build_stats["overlap_tails"] = \
+                                build_stats.get("overlap_tails", 0) + 1
+                            ov.submit(P, tail[0], tail[1])
+                    else:
+                        P, rounds = step
+                    total_rounds += int(rounds)
+                    idx += 1
+                    maybe_fail("build", idx - start)
+                    if checkpointer is not None and \
+                            checkpointer.due(idx - start):
+                        if overlap:
+                            _flush_deltas()
+                        arrays = {"deg": deg_host, "minp": np.asarray(P[pos])}
+                        if carry_mode:
+                            arrays["carry_lo"] = np.asarray(carry[0])
+                            arrays["carry_hi"] = np.asarray(carry[1])
+                        checkpointer.save("build", idx, arrays, meta)
+                if overlap:
+                    _flush_deltas()
             if carry_mode and carry is not None and int(carry[0].shape[0]):
                 # resolve the final carried tail (the stream's ONE host
                 # tail); plain entry point = host-finish semantics

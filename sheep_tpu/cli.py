@@ -59,6 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-carry-tail", dest="carry_tail",
                    action="store_false",
                    help="host-finish every chunk's tail (see --carry-tail)")
+    p.add_argument("--tail-overlap", dest="tail_overlap",
+                   action="store_true", default=None,
+                   help="resolve each chunk's fixpoint tail on host in a "
+                        "worker thread while the device folds the next "
+                        "chunk; resolved links re-enter a later fold as "
+                        "O(changed) delta constraints (tpu backend; same "
+                        "forest bit-for-bit; excludes --carry-tail)")
+    p.add_argument("--no-tail-overlap", dest="tail_overlap",
+                   action="store_false",
+                   help="serialize host tails (see --tail-overlap)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
     p.add_argument("--refine", type=int, default=0, metavar="N",
@@ -139,6 +149,9 @@ def main(argv=None) -> int:
         build_parser().error("--input and --k are required")
     if args.resume and not args.checkpoint_dir:
         build_parser().error("--resume requires --checkpoint-dir")
+    if args.carry_tail and args.tail_overlap:
+        build_parser().error("--carry-tail and --tail-overlap are mutually "
+                             "exclusive tail strategies")
 
     is_main = True
     process_id = 0
@@ -195,6 +208,8 @@ def main(argv=None) -> int:
             ctor["cache_chunks"] = False
         if args.carry_tail is not None:
             ctor["carry_tail"] = args.carry_tail
+        if args.tail_overlap is not None:
+            ctor["tail_overlap"] = args.tail_overlap
         # keep only the options this backend's constructor names; warn
         # about the rest instead of silently changing the run (the
         # tuning knobs vary per backend; every registered backend's ctor

@@ -628,6 +628,109 @@ def _host_tail_finish_pos(P, loP, hiP, n: int, size: int, pos_host):
     return jnp.asarray(newP)
 
 
+def host_tail_delta(P_snap, loP, hiP, n: int, pos_host):
+    """Resolve a compacted fixpoint tail on HOST and return it as DELTA
+    constraints instead of a replacement table.
+
+    Same native Liu pass as :func:`_host_tail_finish_pos`, but the result
+    is the set of (position, new_parent_position) pairs whose parent
+    CHANGED — exactly the tree edges the resolution added. Injecting
+    those pairs as ordinary actives into any later fold yields the same
+    unique fixpoint (the forest is a function of the inserted constraint
+    multiset; a resolved link is a derived tree edge of a sub-multiset,
+    which is what :func:`merge_forests` folds), so the caller can run
+    the native pass in a worker thread while the device folds the next
+    chunk, and ship an O(changed) delta instead of the O(V) table push.
+
+    Inputs must be HOST-safe snapshots (jax arrays are immutable, so the
+    device arrays themselves are safe); everything here is numpy + the
+    native core — no jax dispatch — making it executor-thread-friendly
+    apart from the initial np.asarray pulls."""
+    from sheep_tpu.core import native
+
+    lo_np = np.asarray(loP)
+    hi_np = np.asarray(hiP)
+    mask = lo_np != n
+    pos_host = np.asarray(pos_host)
+    order_host = _order_host(pos_host, n)
+    edges = np.stack([order_host[lo_np[mask]], order_host[hi_np[mask]]],
+                     axis=1)
+    P_np = np.asarray(P_snap)  # O(V) pull overlapped with device work
+    pp = P_np[pos_host]
+    parent = np.where(pp < n, order_host[np.minimum(pp, n)],
+                      NO_PARENT).astype(np.int64)
+    # native.build_elim_tree writes into a contiguous int64 parent array
+    # IN PLACE (and returns it) — diff against a snapshot, not the alias
+    new_parent = native.build_elim_tree(edges, pos_host, parent.copy())
+    ch = np.nonzero(new_parent != parent)[0]
+    # links are only ever added or improved, never removed
+    assert len(ch) == 0 or new_parent[ch].min() >= 0
+    dlo = pos_host[ch].astype(np.int32)
+    dhi = pos_host[new_parent[ch]].astype(np.int32)
+    return dlo, dhi
+
+
+def pad_actives_pow2(dlo, dhi, n: int, floor: int = 1 << 14):
+    """Pad host (dlo, dhi) constraint arrays to a power-of-two length
+    with the inert (n, n) sentinel so injected carries come from a small
+    set of static shapes (one compile per bucket, not per delta)."""
+    size = pow2_at_least(max(1, len(dlo)), floor=floor)
+    out_lo = np.full(size, n, dtype=np.int32)
+    out_hi = np.full(size, n, dtype=np.int32)
+    out_lo[: len(dlo)] = dlo
+    out_hi[: len(dhi)] = dhi
+    return jnp.asarray(out_lo), jnp.asarray(out_hi)
+
+
+class TailOverlap:
+    """Worker-thread host-tail pipeline shared by the tpu backend and the
+    tuning tool: submit compacted tails to :func:`host_tail_delta`, drain
+    finished resolutions, and hand them back as padded injection carries.
+
+    Use as a context manager so the single worker thread (and any
+    in-flight O(V) pull) is released even when the driving loop raises —
+    a leaked non-daemon thread blocks interpreter exit until its pending
+    job finishes, which on a wedged device link means a hang instead of
+    a fast failure."""
+
+    def __init__(self, n: int, pos_host):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.n = n
+        self.pos_host = pos_host
+        self._executor = ThreadPoolExecutor(max_workers=1)
+        self._pending: list = []   # in-flight futures, FIFO
+        self._deltas: list = []    # resolved (dlo, dhi) awaiting injection
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._executor.shutdown(wait=True)
+        return False
+
+    def submit(self, P, loP, hiP) -> None:
+        """Queue a compacted live tail (device arrays are immutable, so
+        the P snapshot is safe to pull from the worker thread)."""
+        self._pending.append(self._executor.submit(
+            host_tail_delta, P, loP, hiP, self.n, self.pos_host))
+
+    def drain(self, block: bool) -> None:
+        while self._pending and (block or self._pending[0].done()):
+            d = self._pending.pop(0).result()
+            if len(d[0]):
+                self._deltas.append(d)
+
+    def take_inject(self):
+        """All drained deltas as one padded (loP, hiP) carry, or None."""
+        if not self._deltas:
+            return None
+        dlo = np.concatenate([d[0] for d in self._deltas])
+        dhi = np.concatenate([d[1] for d in self._deltas])
+        self._deltas.clear()
+        return pad_actives_pow2(dlo, dhi, self.n)
+
+
 def _fold_adaptive_pos_impl(
     P: jax.Array,
     loP: jax.Array,

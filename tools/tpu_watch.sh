@@ -29,6 +29,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
       --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
       --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
+      --overlap 0,1 \
       >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
     tune_rc=$?
     timeout 3600 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
