@@ -1,0 +1,129 @@
+"""RMAT-30-class capability run: V = 2^30 through tpu-bigv
+(BASELINE.json eval config 5's vertex scale).
+
+The single-chip streaming build caps at V = 2^29 on a 16 GiB chip and
+the tpu-sharded pipeline replicates tables per device, so neither can
+hold the RMAT-30 class (BASELINE.md HBM table). tpu-bigv exists to
+remove that ceiling: pos/P/deg block-sharded across the mesh (B =
+(V+1)/D rows per device), ONE distributed forest via routed
+collectives. This driver proves it at the real vertex scale on the
+8-device virtual CPU mesh:
+
+- graph: a PREFIX of the rmat_stream(30, ef=1) edge stream (Graph500
+  R-MAT parameters, so the hub skew of the scale-30 class is real),
+  edge count bounded so the run fits CI-hours on one host core;
+- tpu-bigv partitions it at k=1024 (the config-5 part count);
+- the native cpu backend partitions the same stream; the parent
+  forests and scores must agree EXACTLY.
+
+Results -> tools/out/soak/bigv_s30.json.
+
+Usage:
+    python tools/bigv_scale30.py [--edge-chunks 16] [--k 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=30)
+    ap.add_argument("--edge-chunks", type=int, default=16,
+                    help="number of 2^22-edge rmat_stream chunks to take "
+                         "(16 -> 67M edges over 1.07B vertices)")
+    ap.add_argument("--k", type=int, default=1024)
+    ap.add_argument("--chunk-edges", type=int, default=1 << 22)
+    ap.add_argument("--skip-oracle", action="store_true")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    from sheep_tpu.utils.platform import pin_platform
+
+    pin_platform("cpu")
+    import jax
+
+    assert jax.device_count() >= 8, jax.devices()
+
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io import generators
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    n = 1 << args.scale
+    gen_chunk = 1 << 22
+    m = args.edge_chunks * gen_chunk
+
+    def prefix():
+        from itertools import islice
+
+        yield from islice(
+            generators.rmat_stream(args.scale, 1, seed=42, chunk=gen_chunk),
+            args.edge_chunks)
+
+    def stream():
+        return EdgeStream.from_generator(prefix, n_vertices=n, num_edges=m)
+
+    result = {"scale": args.scale, "n_vertices": n, "n_edges": m,
+              "k": args.k, "devices": jax.device_count(),
+              "chunk_edges": args.chunk_edges}
+    print(f"V=2^{args.scale} = {n:,}  E={m:,}  k={args.k}  "
+          f"devices={jax.device_count()}", flush=True)
+
+    t0 = time.perf_counter()
+    big = get_backend("tpu-bigv", chunk_edges=args.chunk_edges,
+                      n_devices=8).partition(
+        stream(), args.k, comm_volume=False)
+    result["bigv"] = {
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "edge_cut": int(big.edge_cut), "total_edges": int(big.total_edges),
+        "balance": round(float(big.balance), 4),
+        "phases": {p: round(s, 1) for p, s in big.phase_times.items()},
+        "diagnostics": {k: int(v) for k, v in big.diagnostics.items()},
+        "peak_rss_gb": round(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1e6, 1),
+    }
+    print("bigv:", json.dumps(result["bigv"]), flush=True)
+
+    if not args.skip_oracle:
+        from sheep_tpu.core import native
+
+        assert native.available(), "native core needed for the oracle"
+        t0 = time.perf_counter()
+        ref = get_backend("cpu", chunk_edges=args.chunk_edges).partition(
+            stream(), args.k, comm_volume=False)
+        result["native_oracle"] = {
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "edge_cut": int(ref.edge_cut),
+            "balance": round(float(ref.balance), 4),
+        }
+        print("oracle:", json.dumps(result["native_oracle"]), flush=True)
+        assert big.edge_cut == ref.edge_cut, \
+            (big.edge_cut, ref.edge_cut)
+        assert np.array_equal(big.assignment, ref.assignment), \
+            "bigv assignment != native oracle at V=2^30"
+        result["oracle_equal"] = True
+
+    out = os.path.join(REPO, "tools", "out", "soak",
+                       f"bigv_s{args.scale}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    print(f"written to {out}")
+
+
+if __name__ == "__main__":
+    main()
