@@ -91,6 +91,12 @@ int main() {
                    assign.data());
   for (i64 v = 0; v < n; ++v)
     CHECK(assign[v] >= 0 && assign[v] < k, "assignment in range");
+  // w == nullptr is the unit-weight fast path; must match explicit ones
+  std::vector<i32> assign0(n, -1);
+  sheep_tree_split(parent.data(), pos.data(), nullptr, n, k, 1.0,
+                   assign0.data());
+  CHECK(std::memcmp(assign0.data(), assign.data(), n * sizeof(i32)) == 0,
+        "null weights == explicit unit weights");
 
   i64 cut = 0, total = 0;
   sheep_score_chunk(edges.data(), m, assign.data(), n, &cut, &total);

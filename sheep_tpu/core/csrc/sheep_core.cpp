@@ -170,18 +170,41 @@ void sheep_merge_trees(i64* parent, const i64* other, const i64* pos, i64 n) {
 // least-loaded part. See that docstring for the invariants.
 void sheep_tree_split(const i64* parent, const i64* pos, const double* w,
                       i64 n, i64 k, double alpha, i32* assign) {
+  // w == nullptr means unit weights — callers need not materialize an
+  // O(n) array of ones (8 GB at n = 2^30)
+  auto W = [&](i64 v) { return w ? w[v] : 1.0; };
+
+  // pos is a permutation of [0, n), so the position-order walk is its
+  // inverse — O(n) fill instead of an O(n log n) comparator sort
   std::vector<i64> order(n);
-  std::iota(order.begin(), order.end(), 0);
-  std::sort(order.begin(), order.end(),
-            [&](i64 a, i64 b) { return pos[a] < pos[b]; });
+  for (i64 v = 0; v < n; ++v) order[pos[v]] = v;
 
   double total = 0;
-  for (i64 v = 0; v < n; ++v) total += w[v];
+  for (i64 v = 0; v < n; ++v) total += W(v);
   double cap = std::max(alpha * total / double(k), 1.0);
 
+  // children of v, position-ordered, in CSR layout: vertices are
+  // processed in position order and every child precedes its parent,
+  // so the original per-vertex push_back discovery order IS position
+  // order — and "still uncut when the parent processes" is exactly
+  // cut_part[c] < 0 at that moment. One flat array replaces the old
+  // vector-of-vectors (whose 24 B/vertex of headers alone was 26 GB
+  // at n = 2^30, the RMAT-30 class this split must handle).
+  std::vector<i64> kid_off(n + 1, 0);
+  for (i64 v = 0; v < n; ++v)
+    if (parent[v] >= 0) ++kid_off[parent[v] + 1];
+  for (i64 v = 0; v < n; ++v) kid_off[v + 1] += kid_off[v];
+  std::vector<i64> kid_list(kid_off[n]);
+  {
+    std::vector<i64> fill(kid_off.begin(), kid_off.end() - 1);
+    for (i64 idx = 0; idx < n; ++idx) {
+      i64 v = order[idx];
+      if (parent[v] >= 0) kid_list[fill[parent[v]]++] = v;
+    }
+  }
+
   std::vector<double> rem(n);
-  for (i64 v = 0; v < n; ++v) rem[v] = w[v];
-  std::vector<std::vector<i64>> uncut_kids(n);
+  for (i64 v = 0; v < n; ++v) rem[v] = W(v);
   std::vector<i32> cut_part(n, -1);
 
   // least-loaded part heap: (load, part), min by load then part id
@@ -198,16 +221,19 @@ void sheep_tree_split(const i64* parent, const i64* pos, const double* w,
   };
 
   std::vector<i64> bag;
+  std::vector<i64> kids;  // reused scratch: the uncut children of v
   for (i64 idx = 0; idx < n; ++idx) {
     i64 v = order[idx];
-    auto& kids = uncut_kids[v];
-    double tot = w[v];
+    kids.clear();
+    for (i64 j = kid_off[v]; j < kid_off[v + 1]; ++j) {
+      i64 c = kid_list[j];
+      if (cut_part[c] < 0) kids.push_back(c);
+    }
+    double tot = W(v);
     for (i64 c : kids) tot += rem[c];
     bool is_root = parent[v] < 0;
     if (tot < cap && !is_root) {
       rem[v] = tot;
-      uncut_kids[parent[v]].push_back(v);
-      std::vector<i64>().swap(kids);
       continue;
     }
     // stable: equal-rem ties keep discovery order, matching the Python
@@ -225,13 +251,11 @@ void sheep_tree_split(const i64* parent, const i64* pos, const double* w,
       bag.push_back(c);
       bagw += rem[c];
     }
-    if (is_root || bagw + w[v] >= cap) {
-      flush(bag, v, bagw + w[v]);
+    if (is_root || bagw + W(v) >= cap) {
+      flush(bag, v, bagw + W(v));
     } else {
-      rem[v] = bagw + w[v];
-      uncut_kids[parent[v]].push_back(v);
+      rem[v] = bagw + W(v);
     }
-    std::vector<i64>().swap(kids);
   }
 
   // top-down labeling: nearest cut ancestor owns the vertex

@@ -24,6 +24,17 @@ _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
 
 
+class _f64p_or_null(_f64p):
+    """float64 ndpointer that also accepts None (passed as NULL) — for
+    C functions whose array argument is optional, e.g. unit weights."""
+
+    @classmethod
+    def from_param(cls, obj):
+        if obj is None:
+            return None
+        return _f64p.from_param(obj)
+
+
 def _build() -> None:
     src = os.path.join(_CSRC, "sheep_core.cpp")
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(src):
@@ -53,7 +64,8 @@ def load() -> ctypes.CDLL:
     lib.sheep_elim_order.argtypes = [_i64p, c_i64, _i64p]
     lib.sheep_build_elim_tree.argtypes = [_i64p, c_i64, _i64p, c_i64, _i64p]
     lib.sheep_merge_trees.argtypes = [_i64p, _i64p, _i64p, c_i64]
-    lib.sheep_tree_split.argtypes = [_i64p, _i64p, _f64p, c_i64, c_i64, ctypes.c_double, _i32p]
+    lib.sheep_tree_split.argtypes = [_i64p, _i64p, _f64p_or_null, c_i64,
+                                     c_i64, ctypes.c_double, _i32p]
     lib.sheep_score_chunk.argtypes = [_i64p, c_i64, _i32p, c_i64,
                                       ctypes.POINTER(c_i64), ctypes.POINTER(c_i64)]
     lib.sheep_cut_pairs.argtypes = [_i64p, c_i64, _i32p, c_i64, c_i64, _i64p]
@@ -121,12 +133,15 @@ def tree_split(parent: np.ndarray, pos: np.ndarray, k: int,
                weights: Optional[np.ndarray] = None, alpha: float = 1.0) -> np.ndarray:
     lib = load()
     n = len(parent)
-    w = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
+    # weights=None -> NULL: the C side treats it as unit weights without
+    # either side materializing an O(n) ones array (8 GB at n = 2^30)
+    w = None if weights is None \
+        else np.ascontiguousarray(weights, dtype=np.float64)
     assign = np.empty(n, dtype=np.int32)
     lib.sheep_tree_split(
         np.ascontiguousarray(parent, dtype=np.int64),
         np.ascontiguousarray(pos, dtype=np.int64),
-        np.ascontiguousarray(w, dtype=np.float64), n, k, alpha, assign)
+        w, n, k, alpha, assign)
     return assign
 
 

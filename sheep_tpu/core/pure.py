@@ -35,17 +35,23 @@ def degrees(edges: np.ndarray, n: int) -> np.ndarray:
     return np.bincount(np.asarray(edges).ravel(), minlength=n).astype(np.int64)
 
 
-def elimination_order(deg: np.ndarray) -> np.ndarray:
+def elimination_order(deg: np.ndarray, dtype=np.int64) -> np.ndarray:
     """pos[v] = rank of v ordered by (degree asc, id asc).
 
     Ties broken by id so the order is a pure function of the degree table —
     every shard/backend derives the identical global order, which is what
     makes partial trees mergeable.
+
+    A STABLE argsort ties by original index by definition, so it equals
+    the old ``lexsort((arange(n), deg))`` exactly while allocating one
+    temp fewer — at the RMAT-30 class (n = 2^30) the arange key alone
+    was 8 GB. ``dtype`` sizes the returned ranks (int32 suffices for
+    every TPU-backend graph; the default stays int64 for the oracle).
     """
     n = len(deg)
-    order = np.lexsort((np.arange(n), deg))  # vertex ids in elimination order
-    pos = np.empty(n, dtype=np.int64)
-    pos[order] = np.arange(n)
+    order = np.argsort(deg, kind="stable")  # vertex ids in elimination order
+    pos = np.empty(n, dtype=dtype)
+    pos[order] = np.arange(n, dtype=dtype)
     return pos
 
 

@@ -32,8 +32,9 @@ shard_map over the ``shards`` axis):
      the single-chip displacement rules; liveness is a psum, so the
      while_loop terminates collectively.
 
-The elimination order is computed on HOST (numpy argsort over the int64
-degree table — hosts hold hundreds of GB; one sort per run, amortized
+The elimination order is computed on HOST (one stable numpy argsort
+over the degree table — hosts hold hundreds of GB; one sort per run,
+amortized
 over the whole stream) and only the pos block shard is pushed to
 devices (position space needs no device-side order table). The split
 likewise runs on host over the O(V) parent array (native C++).
@@ -540,10 +541,12 @@ class BigVPipeline:
             resume: bool = False):
         """Full vertex-sharded partition run.
 
-        Checkpoint state is the per-process LOCAL block (deg_local int64,
-        ptable_local int32 — O(V/P) per process, the bigv scaling story
-        carried through to recovery); the cadence/fingerprint/reconcile
-        machinery is shared with the other backends (utils/checkpoint)."""
+        Checkpoint state is the per-process LOCAL block (deg_local —
+        int32 when the stream's edge bound proves no overflow, int64
+        otherwise; ptable_local int32 — O(V/P) per process, the bigv
+        scaling story carried through to recovery); the cadence/
+        fingerprint/reconcile machinery is shared with the other
+        backends (utils/checkpoint)."""
         from sheep_tpu.core import pure
         from sheep_tpu.ops import score as score_ops
         from sheep_tpu.ops.split import tree_split_host
@@ -580,15 +583,23 @@ class BigVPipeline:
             state = ckpt.reconcile_multihost_resume(checkpointer, state, meta)
         from_phase = ckpt.phase_index(state.phase) if state else 0
 
-        # pass 1: degrees (block-sharded int32 accumulator + int64 host
-        # fold of the LOCAL block; resets are jitted on-device zeros, no
+        # pass 1: degrees (block-sharded int32 accumulator + host fold of
+        # the LOCAL block, int32 when the edge bound proves no overflow;
+        # resets are jitted on-device zeros, no
         # host zero uploads; one final allgather assembles the table)
         t0 = time.perf_counter()
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
         if state:
             deg_local = state.arrays["deg_local"].copy()
         else:
-            deg_local = np.zeros(self.n_local * self.B, dtype=np.int64)
+            # int32 host accumulator when the stream's edge bound proves
+            # no vertex can see 2^31 endpoints — at the RMAT-30 class the
+            # int64 table alone is 8 GB/process; resume keeps the saved
+            # dtype so checkpoints stay self-consistent
+            ub = stream.num_edges_upper_bound
+            deg_dtype = np.int64 if ub is None or 2 * ub >= 2**31 \
+                else np.int32
+            deg_local = np.zeros(self.n_local * self.B, dtype=deg_dtype)
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             deg_sh = self.deg_zeros()
@@ -602,23 +613,30 @@ class BigVPipeline:
                 at_ckpt = (checkpointer is not None and
                            checkpointer.due_span((nb - 1) * d, nb * d))
                 if since >= flush_every or at_ckpt:
-                    deg_local += self._local_block(deg_sh).astype(np.int64)
+                    deg_local += self._local_block(deg_sh).astype(deg_local.dtype)
                     deg_sh = self.deg_zeros()
                     since = 0
                 if at_ckpt:
                     checkpointer.save("degrees", start + nb * d,
                                       {"deg_local": deg_local}, meta)
-            deg_local += self._local_block(deg_sh).astype(np.int64)
+            deg_local += self._local_block(deg_sh).astype(deg_local.dtype)
+            deg_sh = None  # free the block-sharded device accumulator
         deg_host = self._allgather_table(deg_local)[:n]
 
-        # host-side elimination order: one argsort over (deg, id); hosts
-        # hold hundreds of GB, and the sort is once per run. Only pos is
-        # pushed to devices — position space needs no order table there.
-        pos_np = pure.elimination_order(deg_host)
-        order_np = np.full(n + 1, n, dtype=np.int64)
-        order_np[pos_np] = np.arange(n)
-        pos_sh = self._shard_table(
-            np.concatenate([pos_np, [n]]).astype(np.int32))
+        # host-side elimination order: one stable argsort over degrees;
+        # hosts hold hundreds of GB, and the sort is once per run. Only
+        # pos is pushed to devices — position space needs no order table
+        # there. Everything host-side is int32 (n < 2^31 is enforced at
+        # backend entry): at V=2^30 the old int64 pos/order pair alone
+        # was 17 GB.
+        pos_np = pure.elimination_order(deg_host, dtype=np.int32)
+        order_np = np.full(n + 1, n, dtype=np.int32)
+        order_np[pos_np] = np.arange(n, dtype=np.int32)
+        pos_pad = np.empty(n + 1, dtype=np.int32)
+        pos_pad[:n] = pos_np
+        pos_pad[n] = n
+        pos_sh = self._shard_table(pos_pad)
+        del pos_pad
         t["degrees+sort"] = time.perf_counter() - t0
 
         # pass 2: the single distributed forest (position-indexed table)
@@ -657,6 +675,10 @@ class BigVPipeline:
         t0 = time.perf_counter()
         pp = P_host[pos_np]
         parent = np.where(pp < n, order_np[np.minimum(pp, n)], -1)
+        # the native split upcasts parent/pos to int64 copies; drop the
+        # tables it does not take first so the split-time peak at the
+        # RMAT-30 class stays below the old all-int64 path's
+        del pp, order_np
         w = deg_host.astype(np.float64) if weights == "degree" else None
         assign_host = tree_split_host(parent, pos_np, k, weights=w,
                                       alpha=alpha)
