@@ -113,13 +113,21 @@ def measure(scale: int, platform: str) -> dict:
     from sheep_tpu.io import generators
     from sheep_tpu.io.edgestream import EdgeStream
 
+    # Counter-based R-MAT: the accelerated side materializes chunks ON
+    # DEVICE (generators.rmat_hash_chunk_device) so the bench measures the
+    # pipeline, not the host link — through the axon tunnel the chunk
+    # upload alone was 92 s of a 254 s run (tools/out/20260731T010412/);
+    # on a co-located host it hides a PCIe pass. The CPU baseline gets
+    # the IDENTICAL edges (bit-equal host twin), materialized once so its
+    # passes read memory rather than re-hashing.
     t0 = time.perf_counter()
-    edges = generators.rmat(scale, edge_factor, seed=42)
     n = 1 << scale
+    dev_stream = generators.RmatHashStream(scale, edge_factor, seed=42)
+    edges = dev_stream.read_all()
     es = EdgeStream.from_array(edges, n_vertices=n)
     m = len(edges)
-    log(f"graph: RMAT-{scale} ef={edge_factor}  V={n:,} E={m:,}  "
-        f"(gen {time.perf_counter() - t0:.1f}s)  k={k}")
+    log(f"graph: RMAT-{scale} ef={edge_factor} (counter-hash)  "
+        f"V={n:,} E={m:,}  (gen {time.perf_counter() - t0:.1f}s)  k={k}")
 
     # --- CPU single-socket baseline (the denominator) ---------------------
     cpu = get_backend(base_name, chunk_edges=1 << 24)
@@ -149,10 +157,10 @@ def measure(scale: int, platform: str) -> dict:
     accel_chunk = 1 << (23 if platform != "cpu" else 22)
     tpu = get_backend("tpu", chunk_edges=min(accel_chunk, m))
     t0 = time.perf_counter()
-    tpu.partition(es, k, comm_volume=False)  # compile warm-up
+    tpu.partition(dev_stream, k, comm_volume=False)  # compile warm-up
     warm_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    res_tpu = tpu.partition(es, k, comm_volume=False)
+    res_tpu = tpu.partition(dev_stream, k, comm_volume=False)
     tpu_s = time.perf_counter() - t0
     tpu_eps = m / tpu_s
     log(f"{platform}: {tpu_s:.2f}s = {tpu_eps / 1e6:.2f} Me/s (warm-up {warm_s:.1f}s)  "

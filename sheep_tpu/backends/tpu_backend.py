@@ -74,25 +74,39 @@ class _ChunkCache:
         self.complete = False
 
 
+def _upload_chunks(stream, cs: int, n: int, start_chunk: int):
+    """Padded (cs, 2) int32 DEVICE chunks from ``start_chunk`` on.
+
+    Streams with a ``device_chunk`` method (synthetic counter-based
+    generators, e.g. :class:`~sheep_tpu.io.generators.RmatHashStream`)
+    materialize each chunk directly in device memory — no host
+    generation, no host->device upload (measured 92 s of a 254 s
+    RMAT-22 bench through a degraded tunnel link). File/memory streams
+    take the host path: read + parse + pad of chunk i+1 overlaps the
+    device work on chunk i via :func:`prefetch`, and jnp.asarray issues
+    the (async) upload."""
+    dev = getattr(stream, "device_chunk", None)
+    if dev is not None:
+        for i in range(start_chunk, stream.num_device_chunks(cs)):
+            yield dev(i, cs, n)
+        return
+    for padded in prefetch(pad_chunk(c, cs, n)
+                           for c in stream.chunks(cs,
+                                                  start_chunk=start_chunk)):
+        yield jnp.asarray(padded)
+
+
 def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
     """Yield padded (cs, 2) int32 chunks as DEVICE arrays, serving and
-    filling ``cache`` when iterating from the stream head. Host read +
-    parse + pad of chunk i+1 overlaps the device work on chunk i via
-    :func:`prefetch`; jnp.asarray issues the (async) upload."""
+    filling ``cache`` when iterating from the stream head."""
     if cache is None or start_chunk != 0:
-        for padded in prefetch(pad_chunk(c, cs, n)
-                               for c in stream.chunks(cs,
-                                                      start_chunk=start_chunk)):
-            yield jnp.asarray(padded)
+        yield from _upload_chunks(stream, cs, n, start_chunk)
         return
     yield from cache.chunks
     if cache.complete:
         return
     grow = True
-    for padded in prefetch(pad_chunk(c, cs, n)
-                           for c in stream.chunks(
-                               cs, start_chunk=len(cache.chunks))):
-        d = jnp.asarray(padded)
+    for d in _upload_chunks(stream, cs, n, len(cache.chunks)):
         nb = int(d.size) * 4
         if grow and cache.used + nb <= cache.budget:
             cache.chunks.append(d)

@@ -26,11 +26,19 @@ class TpuBigVBackend(Partitioner):
     supports_multidevice = True
 
     def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
-                 jumps: int = 128, n_devices: int | None = None):
+                 jumps: int = 128, n_devices: int | None = None,
+                 lift_levels: int = 0, segment_rounds: int = 16):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
         self.jumps = jumps
         self.n_devices = n_devices
+        # memory/speed trade of the routed fixpoint: each lifting level
+        # is a (D, B)-shaped routed lookup inside one compiled program
+        # (auto depth at V=2^30 OOM-killed a 125 GB virtual-mesh host —
+        # tools/bigv_scale30.py), and segment_rounds bounds rounds per
+        # device execution the same way. 0 = auto depth.
+        self.lift_levels = lift_levels
+        self.segment_rounds = segment_rounds
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -42,7 +50,9 @@ class TpuBigVBackend(Partitioner):
         m_cheap = stream.num_edges_cheap
         if m_cheap is not None:
             cs = min(cs, max(1024, -(-m_cheap // mesh.devices.size)))
-        pipe = BigVPipeline(n, cs, mesh, jumps=self.jumps)
+        pipe = BigVPipeline(n, cs, mesh, jumps=self.jumps,
+                            lift_levels=self.lift_levels,
+                            segment_rounds=self.segment_rounds)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,

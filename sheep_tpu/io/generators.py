@@ -7,6 +7,8 @@ and the soak-test generator. All generators are deterministic under a seed.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 # Zachary karate club, 34 vertices / 78 undirected edges (0-indexed).
@@ -114,3 +116,288 @@ def rmat_stream(
         cnt = min(chunk, m - off)
         rng = np.random.default_rng(np.random.SeedSequence([seed, i]))
         yield _rmat_batch(scale, cnt, rng, a, b, c)
+
+
+# ---------------------------------------------------------------------------
+# Counter-based R-MAT: one stateless hash per (edge index, level), so any
+# edge RANGE is computable independently — on host (numpy) or ON DEVICE
+# (jnp), bit-identically. This is what lets the TPU backend materialize
+# synthetic chunks in HBM instead of generating on host and paying the
+# host->device upload for every chunk (measured 92 s of a 254 s RMAT-22
+# run through a degraded tunnel link, tools/out/20260731T010412/), and
+# what makes RMAT-30-class synthetic streams (eval config 5) feedable at
+# HBM rate rather than host-numpy rate.
+#
+# The recursive quadrant choice matches :func:`_rmat_batch`: per bit
+# level, u's bit is 1 with probability c+d, then v's bit is 1 with
+# probability b/(a+b) (u bit 0) or d/(c+d) (u bit 1). Here the two
+# uniforms are the 16-bit halves of one 32-bit hash and the thresholds
+# are integers, so numpy and jnp agree exactly (uint32 wraparound
+# arithmetic only — no floats anywhere).
+# ---------------------------------------------------------------------------
+
+_M32 = 0xFFFFFFFF
+
+
+def _mix32_int(x: int) -> int:
+    """murmur3 fmix32 on a Python int (key premixing, host side)."""
+    x &= _M32
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & _M32
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & _M32
+    x ^= x >> 16
+    return x
+
+
+def _rmat_hash_keys(scale: int, seed: int):
+    """Per-level uint32 keys derived from the seed (Python ints)."""
+    s = _mix32_int((seed & _M32) ^ 0x9E3779B9)
+    return [_mix32_int(s + 0x9E3779B9 * (lvl + 1)) for lvl in range(scale)]
+
+
+def _rmat_hash_thresholds(a: float, b: float, c: float):
+    """16-bit integer thresholds for the quadrant choice."""
+    d = 1.0 - a - b - c
+    t_u = min(65535, max(0, round((c + d) * 65536)))       # P(ubit = 1)
+    t_v0 = min(65535, max(0, round(b / (a + b) * 65536)))  # P(vbit=1 | u=0)
+    t_v1 = min(65535, max(0, round(d / (c + d) * 65536)))  # P(vbit=1 | u=1)
+    return t_u, t_v0, t_v1
+
+
+def _rmat_hash_uv(xp, elo, ehi, keys, thresholds, dtype):
+    """Shared numpy/jnp body: map edge-counter words (elo, ehi) to (u, v).
+
+    ``xp`` is the array namespace (numpy or jax.numpy); all arithmetic is
+    uint32 with wraparound, so both namespaces produce identical bits.
+    """
+    t_u, t_v0, t_v1 = (xp.uint32(t) for t in thresholds)
+    u = xp.zeros(elo.shape, dtype=xp.uint32)
+    v = xp.zeros(elo.shape, dtype=xp.uint32)
+    one = xp.uint32(1)
+    for bit, key in enumerate(keys):
+        # murmur3 fmix32 over (elo ^ key), folded with ehi mid-mix so
+        # both counter words reach every output bit
+        h = elo ^ xp.uint32(key)
+        h = h ^ (h >> xp.uint32(16))
+        h = h * xp.uint32(0x85EBCA6B)
+        h = h ^ (ehi ^ xp.uint32(_mix32_int(key ^ 0x7FEB352D)))
+        h = h ^ (h >> xp.uint32(13))
+        h = h * xp.uint32(0xC2B2AE35)
+        h = h ^ (h >> xp.uint32(16))
+        hu = h >> xp.uint32(16)          # 16-bit uniform for u's bit
+        hv = h & xp.uint32(0xFFFF)       # 16-bit uniform for v's bit
+        ubit = (hu < t_u).astype(xp.uint32)
+        t_v = xp.where(ubit == one, t_v1, t_v0)
+        vbit = (hv < t_v).astype(xp.uint32)
+        u = u | (ubit << xp.uint32(bit))
+        v = v | (vbit << xp.uint32(bit))
+    return u.astype(dtype), v.astype(dtype)
+
+
+def rmat_hash_range(
+    scale: int,
+    start: int,
+    count: int,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+) -> np.ndarray:
+    """Edges [start, start+count) of the counter-based R-MAT stream, as a
+    (count, 2) int64 array (numpy host twin of the device generator)."""
+    keys = _rmat_hash_keys(scale, seed)
+    th = _rmat_hash_thresholds(a, b, c)
+    idx = start + np.arange(count, dtype=np.int64)
+    elo = (idx & _M32).astype(np.uint32)
+    ehi = (idx >> 32).astype(np.uint32)
+    u, v = _rmat_hash_uv(np, elo, ehi, keys, th, np.int64)
+    return np.stack([u, v], axis=1)
+
+
+_DEVICE_CHUNK_FN = None
+
+
+def _device_chunk_fn():
+    """The jitted device-chunk kernel, created once — jax.jit caches on
+    the wrapper object, so the wrapper must be a module singleton or
+    every chunk would retrace + recompile the scale-deep unrolled hash
+    (jax stays a lazy import: this module is numpy-first)."""
+    global _DEVICE_CHUNK_FN
+    if _DEVICE_CHUNK_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+        def _chunk(start_words, count, pad_to, keys, th, n):
+            lo0, hi0 = start_words
+            i = jnp.arange(pad_to, dtype=jnp.uint32)
+            elo = lo0 + i
+            ehi = hi0 + (elo < lo0).astype(jnp.uint32)  # 64-bit carry
+            u, v = _rmat_hash_uv(jnp, elo, ehi, list(keys), th,
+                                 jnp.int32)
+            e = jnp.stack([u, v], axis=1)
+            return jnp.where((i < jnp.uint32(count))[:, None], e,
+                             jnp.int32(n))
+
+        _DEVICE_CHUNK_FN = _chunk
+    return _DEVICE_CHUNK_FN
+
+
+def rmat_hash_chunk_device(
+    scale: int,
+    start: int,
+    count: int,
+    pad_to: int,
+    n: int,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+):
+    """Device twin of :func:`rmat_hash_range`: a (pad_to, 2) int32 chunk
+    materialized ON DEVICE (rows past ``count`` hold the sentinel vertex
+    ``n``). One compile per (scale, count, pad_to, seed/abc) combination
+    — ``start`` is a traced pair of uint32 words (the 64-bit edge
+    counter split for 32-bit jax), so streaming a graph reuses one
+    compiled program for every full chunk."""
+    import jax.numpy as jnp
+
+    keys = tuple(_rmat_hash_keys(scale, seed))
+    th = _rmat_hash_thresholds(a, b, c)
+    start_words = (jnp.uint32(start & _M32), jnp.uint32(start >> 32))
+    return _device_chunk_fn()(start_words, count, pad_to, keys, th, n)
+
+
+class RmatHashStream:
+    """An :class:`~sheep_tpu.io.edgestream.EdgeStream`-compatible synthetic
+    stream over the counter-based R-MAT (:func:`rmat_hash_range`), with a
+    DEVICE fast path: ``device_chunk(idx, cs, n)`` materializes the padded
+    chunk directly in accelerator memory (:func:`rmat_hash_chunk_device`),
+    bit-identical to the host chunks every other backend reads — so
+    cross-backend equality holds while the TPU path skips the
+    host->device upload entirely.
+
+    Chunk access is random (any [start, start+count) range hashes
+    independently), which also makes checkpoint resume and round-robin
+    sharding exact rather than replay-based.
+    """
+
+    def __init__(self, scale: int, edge_factor: int = 16, a: float = 0.57,
+                 b: float = 0.19, c: float = 0.19, seed: int = 0):
+        from sheep_tpu.io.edgestream import EdgeStream  # avoid io cycle
+
+        self.scale = int(scale)
+        self.edge_factor = int(edge_factor)
+        self.abc = (float(a), float(b), float(c))
+        self.seed = int(seed)
+        self._m = self.edge_factor << self.scale
+        self._n = 1 << self.scale
+
+        def factory(chunk: int = 1 << 22):
+            for off in range(0, self._m, chunk):
+                yield rmat_hash_range(self.scale, off,
+                                      min(chunk, self._m - off),
+                                      *self.abc, seed=self.seed)
+
+        self._inner = EdgeStream.from_generator(
+            factory, n_vertices=self._n, num_edges=self._m)
+        # EdgeStream API delegation (stream_meta fingerprints _factory)
+        self._factory = self._inner._factory
+        self._edges = None
+        self.path = None
+        self.fmt = "generator"
+
+    # -- EdgeStream surface -------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @property
+    def num_edges(self) -> int:
+        return self._m
+
+    @property
+    def num_edges_cheap(self):
+        return self._m
+
+    @property
+    def num_edges_upper_bound(self):
+        return self._m
+
+    @property
+    def num_vertices(self) -> int:
+        return self._n
+
+    def clamp_chunk_edges(self, chunk_edges: int, parts: int = 1,
+                          floor: int = 1024) -> int:
+        return min(chunk_edges, max(floor, -(-self._m // parts)))
+
+    def chunks(self, chunk_edges: int = 1 << 22, shard: int = 0,
+               num_shards: int = 1, start_chunk: int = 0,
+               byte_range: bool = False):
+        """Host chunks by direct range hashing (no generator replay: chunk
+        i is rmat_hash_range(i*cs, cs), so skipping ahead is O(1))."""
+        if not (0 <= shard < num_shards):
+            raise ValueError(f"bad shard {shard}/{num_shards}")
+        cs = int(chunk_edges)
+        n_chunks = -(-self._m // cs) if self._m else 0
+        for i in range(start_chunk, n_chunks):
+            if (i % num_shards) == shard:
+                yield rmat_hash_range(self.scale, i * cs,
+                                      min(cs, self._m - i * cs),
+                                      *self.abc, seed=self.seed)
+
+    def count_edges_in_span(self, shard: int, num_shards: int) -> int:
+        """O(1) arithmetic (EdgeStream replays the generator to count;
+        here chunk ownership is round-robin over fixed-size chunks, so
+        the owned-edge total is pure arithmetic — matching what
+        summing len(c) over chunks(DEFAULT, shard, num_shards) yields).
+
+        NOTE: like EdgeStream's version, the count assumes
+        DEFAULT_CHUNK_EDGES ownership granularity — the method exists
+        for the byte-range text path's lockstep accounting and is
+        unreachable for path-less streams today; it keeps exact parity
+        with the base class's replay semantics."""
+        from sheep_tpu.io.edgestream import DEFAULT_CHUNK_EDGES as cs
+
+        n_chunks = -(-self._m // cs)
+        owned = len(range(shard, n_chunks, num_shards))
+        total = owned * cs
+        last = n_chunks - 1
+        if n_chunks and (last % num_shards) == shard:
+            total -= n_chunks * cs - self._m  # short final chunk
+        return total
+
+    def read_all(self) -> np.ndarray:
+        return rmat_hash_range(self.scale, 0, self._m, *self.abc,
+                               seed=self.seed)
+
+    # -- device fast path ---------------------------------------------------
+    def content_fingerprint(self) -> str:
+        """Cheap stable identity for checkpoint fingerprints: the
+        generator parameters plus a hashed 4096-edge prefix (the full
+        first-chunk hash the generic generator fallback would pay costs
+        a scale-deep pass over a default-size chunk per partition())."""
+        import hashlib
+
+        sample = rmat_hash_range(self.scale, 0, min(4096, self._m),
+                                 *self.abc, seed=self.seed)
+        tag = (f"rmat_hash/s{self.scale}/ef{self.edge_factor}/"
+               f"{self.abc}/{self.seed}/")
+        return tag + hashlib.sha1(
+            np.ascontiguousarray(sample).tobytes()).hexdigest()
+
+    def device_chunk(self, idx: int, chunk_edges: int, n: int):
+        """Padded (chunk_edges, 2) int32 device chunk for global chunk
+        ``idx`` — the TPU backend substitutes this for host pad+upload."""
+        cs = int(chunk_edges)
+        start = idx * cs
+        count = max(0, min(cs, self._m - start))
+        return rmat_hash_chunk_device(self.scale, start, count, cs, n,
+                                      *self.abc, seed=self.seed)
+
+    def num_device_chunks(self, chunk_edges: int) -> int:
+        return -(-self._m // int(chunk_edges))

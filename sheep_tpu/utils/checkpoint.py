@@ -240,6 +240,12 @@ def stream_meta(stream, k: int, chunk_edges: int, weights: str,
         e = stream._edges
         sample = np.ascontiguousarray(np.concatenate([e[:4096], e[-4096:]]))
         meta["content_sha1"] = hashlib.sha1(sample.tobytes()).hexdigest()
+    elif getattr(stream, "content_fingerprint", None) is not None:
+        # streams that know a cheap stable identity (e.g. RmatHashStream:
+        # parameters + a small hashed prefix) provide it directly — the
+        # factory fallback below would materialize a full default-size
+        # chunk inside every timed partition() call
+        meta["content_sha1"] = str(stream.content_fingerprint())
     elif getattr(stream, "_factory", None) is not None:
         # generator stream: hash the first block (factories replay
         # deterministically, so this is a stable content fingerprint)

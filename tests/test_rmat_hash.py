@@ -1,0 +1,164 @@
+"""Counter-based R-MAT (generators.rmat_hash_*, RmatHashStream).
+
+The contract that makes the device fast path sound: the numpy twin and
+the jnp device generator produce IDENTICAL bits, any chunking of the
+edge-index range concatenates to the same sequence, and the stream
+plugs into every backend with exact cross-backend equality (SURVEY.md
+§4.3 — here exact, not tolerance-based, because both sides consume the
+same edge multiset).
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import generators
+from sheep_tpu.io.generators import (RmatHashStream, rmat_hash_chunk_device,
+                                     rmat_hash_range)
+
+
+def test_range_chunking_invariance():
+    full = rmat_hash_range(8, 0, 4096, seed=3)
+    pieces = [rmat_hash_range(8, s, c, seed=3)
+              for s, c in ((0, 1000), (1000, 96), (1096, 3000))]
+    np.testing.assert_array_equal(full, np.concatenate(pieces))
+
+
+def test_determinism_and_seed_sensitivity():
+    a = rmat_hash_range(10, 500, 2048, seed=7)
+    b = rmat_hash_range(10, 500, 2048, seed=7)
+    c = rmat_hash_range(10, 500, 2048, seed=8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert a.dtype == np.int64 and a.shape == (2048, 2)
+    assert a.min() >= 0 and a.max() < 1 << 10
+
+
+def test_device_chunk_bit_identical_to_host_twin():
+    cs, n = 1 << 12, 1 << 9
+    stream = RmatHashStream(9, edge_factor=16, seed=11)
+    host = list(stream.chunks(cs))
+    for i, h in enumerate(host):
+        d = np.asarray(stream.device_chunk(i, cs, n))
+        assert d.shape == (cs, 2) and d.dtype == np.int32
+        np.testing.assert_array_equal(d[: len(h)], h)
+        assert np.all(d[len(h):] == n)  # sentinel padding
+
+
+def test_device_chunk_64bit_counter_carry():
+    # a start index straddling the 2^32 boundary must hash the same as
+    # the numpy twin (the device carries the counter as two uint32 words)
+    start = (1 << 32) - 100
+    host = rmat_hash_range(20, start, 256, seed=5)
+    dev = np.asarray(rmat_hash_chunk_device(20, start, 256, 256, 1 << 20,
+                                            seed=5))
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_power_law_degree_skew():
+    # Graph500 parameters concentrate edges on hub vertices: the max
+    # degree must dwarf the mean by orders of magnitude
+    e = rmat_hash_range(14, 0, 16 << 14, seed=1)
+    deg = np.bincount(e.ravel(), minlength=1 << 14)
+    assert deg.max() > 40 * deg.mean()
+    # both uniform halves are exercised: u and v marginals differ but
+    # both cover the low id range densely (a-quadrant recursion)
+    assert (deg[: 1 << 7] > 0).mean() > 0.9
+
+
+def test_stream_edgestream_surface():
+    s = RmatHashStream(8, edge_factor=4, seed=2)
+    assert s.num_edges == 4 << 8
+    assert s.num_vertices == 1 << 8
+    assert s.num_edges_cheap == s.num_edges_upper_bound == s.num_edges
+    assert s.clamp_chunk_edges(1 << 22) == 4 << 8
+    # round-robin sharding covers every edge exactly once
+    cs = 128
+    all_edges = np.concatenate(list(s.chunks(cs)))
+    shard_union = np.concatenate(
+        [c for p in range(3) for c in s.chunks(cs, shard=p, num_shards=3)])
+    assert len(shard_union) == len(all_edges)
+    np.testing.assert_array_equal(
+        np.sort(all_edges.view("i8,i8"), axis=0),
+        np.sort(shard_union.view("i8,i8"), axis=0))
+    # start_chunk resume skips exactly the first chunks
+    resumed = np.concatenate(list(s.chunks(cs, start_chunk=2)))
+    np.testing.assert_array_equal(resumed, all_edges[2 * cs:])
+    np.testing.assert_array_equal(s.read_all(), all_edges)
+
+
+def test_count_edges_in_span_matches_replay():
+    # the O(1) arithmetic must equal what summing owned chunks yields
+    from sheep_tpu.io.edgestream import DEFAULT_CHUNK_EDGES
+
+    s = RmatHashStream(8, edge_factor=5, seed=13)  # 1280 edges
+    for num_shards in (1, 2, 3, 8):
+        for shard in range(num_shards):
+            replay = sum(len(c) for c in s.chunks(
+                DEFAULT_CHUNK_EDGES, shard=shard, num_shards=num_shards))
+            assert s.count_edges_in_span(shard, num_shards) == replay
+
+
+def test_device_chunk_fn_is_cached():
+    # one jitted wrapper for all chunks (a per-call closure would
+    # retrace + recompile the scale-deep hash for every chunk)
+    from sheep_tpu.io.generators import _device_chunk_fn
+
+    rmat_hash_chunk_device(8, 0, 64, 64, 256, seed=1)
+    assert _device_chunk_fn() is _device_chunk_fn()
+
+
+@pytest.mark.parametrize("backend_name", ["pure", "tpu"])
+def test_backends_partition_hash_stream(backend_name):
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if backend_name not in list_backends():
+        pytest.skip(f"{backend_name} unavailable")
+    s = RmatHashStream(9, edge_factor=8, seed=4)
+    res = get_backend(backend_name, chunk_edges=1 << 10).partition(s, k=4)
+    e = s.read_all()
+    assert res.total_edges == int((e[:, 0] != e[:, 1]).sum())  # non-loops
+    assert len(res.assignment) == s.num_vertices
+    assert res.assignment.min() >= 0 and res.assignment.max() < 4
+
+
+def test_cross_backend_exact_equality_on_hash_stream():
+    """pure vs tpu on the same RmatHashStream: same edges -> same scores
+    (the tpu side reads device_chunk, the pure side host chunks)."""
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if "tpu" not in list_backends():
+        pytest.skip("tpu backend unavailable")
+    s1 = RmatHashStream(9, edge_factor=8, seed=6)
+    s2 = RmatHashStream(9, edge_factor=8, seed=6)
+    a = get_backend("pure", chunk_edges=1 << 10).partition(s1, k=8)
+    b = get_backend("tpu", chunk_edges=1 << 10).partition(s2, k=8)
+    assert a.edge_cut == b.edge_cut
+    assert a.total_edges == b.total_edges
+    assert a.comm_volume == b.comm_volume
+    np.testing.assert_array_equal(a.assignment, b.assignment)
+
+
+def test_checkpoint_resume_on_hash_stream(tmp_path, monkeypatch):
+    """Fault mid-build, then resume from the checkpoint and match the
+    uninterrupted result (the stream's random-access chunks make resume
+    replay-free)."""
+    from sheep_tpu.backends.base import get_backend, list_backends
+    from sheep_tpu.utils.checkpoint import Checkpointer
+    from sheep_tpu.utils.fault import ENV_VAR, InjectedFault
+
+    if "cpu" not in list_backends():
+        pytest.skip("native cpu backend unavailable")
+    s = RmatHashStream(9, edge_factor=8, seed=9)
+    ref = get_backend("cpu", chunk_edges=1 << 10).partition(s, k=4)
+
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, "build:2")
+    with pytest.raises(InjectedFault):
+        get_backend("cpu", chunk_edges=1 << 10).partition(
+            s, k=4, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    res = get_backend("cpu", chunk_edges=1 << 10).partition(
+        s, k=4, checkpointer=Checkpointer(str(tmp_path), every=1),
+        resume=True)
+    assert res.edge_cut == ref.edge_cut
+    np.testing.assert_array_equal(res.assignment, ref.assignment)
