@@ -25,6 +25,10 @@ from sheep_tpu.backends.base import get_backend, list_backends  # noqa: F401
 def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     """One-call API: partition the graph stored at *path* into *k* parts.
 
+    *path* also accepts the synthetic stream specs of
+    :func:`sheep_tpu.io.edgestream.open_input`
+    (``rmat-hash:SCALE[:EF[:SEED]]`` / ``rmat:SCALE[:EF[:SEED]]``).
+
     ``backend=None`` auto-selects the best registered backend
     (tpu > cpu > pure). Constructor options of the chosen backend (e.g.
     ``chunk_edges``, ``alpha``, ``lift_levels``) and partition options
@@ -38,7 +42,7 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     """
     import inspect
 
-    from sheep_tpu.io.edgestream import EdgeStream
+    from sheep_tpu.io.edgestream import open_input
 
     if backend is None:
         avail = list_backends()
@@ -64,7 +68,7 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
     part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
     be = cls(**ctor_opts)
-    with EdgeStream.open(path) as es:
+    with open_input(path) as es:
         res = be.partition(es, k, **part_opts)
         if refine:
             res = refine_result(res, es, rounds=refine, alpha=refine_alpha,

@@ -25,7 +25,11 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native distributed graph partitioner "
                     "(SHEEP elimination-tree algorithm)",
     )
-    p.add_argument("--input", help="edge list (.edges/.txt text, .bin32/.bin64 binary)")
+    p.add_argument("--input",
+                   help="edge list (.edges/.txt text, .bin32/.bin64 "
+                        "binary), or a synthetic stream spec: "
+                        "rmat-hash:SCALE[:EF[:SEED]] (device-generated "
+                        "chunks on TPU backends) or rmat:SCALE[:EF[:SEED]]")
     p.add_argument("--k", type=int, help="number of parts")
     p.add_argument("--backend", default=None,
                    help="execution backend (default: best available; see --list-backends)")
@@ -138,7 +142,7 @@ def main(argv=None) -> int:
 
     from sheep_tpu import list_backends
     from sheep_tpu.backends.base import get_backend
-    from sheep_tpu.io.edgestream import EdgeStream
+    from sheep_tpu.io.edgestream import open_input
     from sheep_tpu.io.formats import write_partition
     from sheep_tpu.types import UnsupportedGraphError
 
@@ -175,7 +179,7 @@ def main(argv=None) -> int:
         auto = False
 
     t0 = time.perf_counter()
-    with EdgeStream.open(args.input, n_vertices=args.num_vertices) as es:
+    with open_input(args.input, n_vertices=args.num_vertices) as es:
         if auto and backend.startswith("tpu") and "tpu-bigv" in list_backends():
             # replicated vertex tables past the single-chip ceiling need
             # the vertex-sharded mode (BASELINE.md HBM budget); ask the

@@ -374,3 +374,51 @@ class EdgeStream:
         if not out:
             return np.zeros((0, 2), dtype=np.int64)
         return np.concatenate(out, axis=0)
+
+
+def open_input(spec: str, n_vertices: Optional[int] = None):
+    """Open a CLI/API ``--input`` value: a graph file path, or a synthetic
+    stream spec (eval config 5 is RMAT-30 — a trillion-edge-class synthetic
+    needs no file):
+
+    - ``rmat-hash:SCALE[:EF[:SEED]]`` — counter-based R-MAT
+      (:class:`~sheep_tpu.io.generators.RmatHashStream`): random-access
+      chunks, generated ON DEVICE by the TPU backend, replay-free resume.
+    - ``rmat:SCALE[:EF[:SEED]]`` — the PCG replay generator
+      (:func:`~sheep_tpu.io.generators.rmat_stream`) behind a generator
+      EdgeStream (matches the soak artifacts generated with it).
+
+    Anything else is treated as a path (format by extension). A
+    user-supplied ``n_vertices`` must not contradict a synthetic spec's
+    2**SCALE vertex space.
+    """
+    spec = os.fspath(spec)  # pathlib.Path inputs flow through unchanged
+    kind, _, rest = spec.partition(":")
+    if kind in ("rmat-hash", "rmat") and rest:
+        from sheep_tpu.io import generators
+
+        parts = rest.split(":")
+        try:
+            scale = int(parts[0])
+            ef = int(parts[1]) if len(parts) > 1 else 16
+            seed = int(parts[2]) if len(parts) > 2 else 0
+        except ValueError:
+            raise ValueError(
+                f"bad synthetic input spec {spec!r}; want "
+                f"{kind}:SCALE[:EF[:SEED]] with integer fields")
+        # rmat-hash accumulates vertex bits in uint32 (scale > 32 would
+        # silently truncate); the int64 PCG path goes further
+        max_scale = 32 if kind == "rmat-hash" else 40
+        if not (1 <= scale <= max_scale) or ef < 1:
+            raise ValueError(f"bad synthetic input spec {spec!r}: "
+                             f"need 1 <= SCALE <= {max_scale} and EF >= 1")
+        if n_vertices is not None and n_vertices != 1 << scale:
+            raise ValueError(
+                f"--num-vertices {n_vertices} contradicts {spec!r} "
+                f"(2**{scale} = {1 << scale} vertices)")
+        if kind == "rmat-hash":
+            return generators.RmatHashStream(scale, ef, seed=seed)
+        return EdgeStream.from_generator(
+            lambda: generators.rmat_stream(scale, ef, seed=seed),
+            n_vertices=1 << scale, num_edges=ef << scale)
+    return EdgeStream.open(spec, n_vertices=n_vertices)

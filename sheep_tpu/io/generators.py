@@ -285,25 +285,19 @@ class RmatHashStream:
 
     def __init__(self, scale: int, edge_factor: int = 16, a: float = 0.57,
                  b: float = 0.19, c: float = 0.19, seed: int = 0):
-        from sheep_tpu.io.edgestream import EdgeStream  # avoid io cycle
-
+        if not (1 <= scale <= 32):
+            # vertex bits accumulate in uint32 (shifts past bit 31 would
+            # silently drop); the device path is further gated to < 2^31
+            # ids by check_tpu_vertex_range at backend entry
+            raise ValueError(f"rmat-hash scale must be 1..32, got {scale}")
         self.scale = int(scale)
         self.edge_factor = int(edge_factor)
         self.abc = (float(a), float(b), float(c))
         self.seed = int(seed)
         self._m = self.edge_factor << self.scale
         self._n = 1 << self.scale
-
-        def factory(chunk: int = 1 << 22):
-            for off in range(0, self._m, chunk):
-                yield rmat_hash_range(self.scale, off,
-                                      min(chunk, self._m - off),
-                                      *self.abc, seed=self.seed)
-
-        self._inner = EdgeStream.from_generator(
-            factory, n_vertices=self._n, num_edges=self._m)
-        # EdgeStream API delegation (stream_meta fingerprints _factory)
-        self._factory = self._inner._factory
+        # EdgeStream API surface (checkpoint fingerprinting uses
+        # content_fingerprint below; there is no replay factory)
         self._edges = None
         self.path = None
         self.fmt = "generator"

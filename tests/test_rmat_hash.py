@@ -162,3 +162,67 @@ def test_checkpoint_resume_on_hash_stream(tmp_path, monkeypatch):
         resume=True)
     assert res.edge_cut == ref.edge_cut
     np.testing.assert_array_equal(res.assignment, ref.assignment)
+
+
+def test_open_input_specs():
+    from sheep_tpu.io.edgestream import EdgeStream, open_input
+
+    s = open_input("rmat-hash:9:4:3")
+    assert isinstance(s, RmatHashStream)
+    assert (s.num_vertices, s.num_edges, s.seed) == (512, 2048, 3)
+    g = open_input("rmat:8:2")
+    assert isinstance(g, EdgeStream) and g.num_edges == 512
+    np.testing.assert_array_equal(  # defaults: ef=16, seed=0
+        open_input("rmat-hash:8").read_all(),
+        RmatHashStream(8, 16, seed=0).read_all())
+    with pytest.raises(ValueError, match="synthetic input spec"):
+        open_input("rmat-hash:notanint")
+    with pytest.raises(ValueError, match="SCALE"):
+        open_input("rmat:99:1")
+    with pytest.raises(ValueError, match="contradicts"):
+        open_input("rmat-hash:9:4", n_vertices=100)
+    with pytest.raises(FileNotFoundError):
+        open_input("/does/not/exist.bin32").num_edges
+
+
+def test_cli_accepts_synthetic_spec(tmp_path):
+    import json as _json
+    import subprocess
+    import sys
+
+    out = tmp_path / "p.parts"
+    r = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", "rmat-hash:8:4:1",
+         "--k", "4", "--backend", "pure", "--json", "--output", str(out)],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    line = _json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["k"] == 4 and line["n_vertices"] == 256
+    assert len(out.read_text().splitlines()) == 256
+
+
+def test_api_accepts_synthetic_spec():
+    import sheep_tpu
+
+    res = sheep_tpu.partition("rmat-hash:8:4:1", 4, backend="pure")
+    assert res.k == 4 and len(res.assignment) == 256
+
+
+def test_scale_bounds_and_path_inputs(tmp_path):
+    from pathlib import Path
+
+    from sheep_tpu.io import formats
+    from sheep_tpu.io.edgestream import open_input
+
+    # uint32 bit accumulation caps rmat-hash at scale 32 (33 would
+    # silently confine ids below 2^32); the int64 PCG spec goes further
+    with pytest.raises(ValueError, match="SCALE"):
+        open_input("rmat-hash:33")
+    with pytest.raises(ValueError, match="1..32"):
+        RmatHashStream(33)
+    assert open_input("rmat:33:1").num_vertices == 1 << 33
+    # pathlib.Path inputs must keep working through open_input
+    p = tmp_path / "tiny.edges"
+    formats.write_edges(str(p), generators.karate_club())
+    assert open_input(Path(p)).num_edges == 78
