@@ -21,6 +21,7 @@ _lib: Optional[ctypes.CDLL] = None
 
 _i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(dtype=np.uint32, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
 
 
@@ -73,6 +74,9 @@ def load() -> ctypes.CDLL:
     lib.sheep_parse_text.argtypes = [ctypes.c_char_p, c_i64, _i64p, c_i64,
                                      ctypes.POINTER(c_i64)]
     lib.sheep_parse_text.restype = c_i64
+    lib.sheep_rmat_hash_range.argtypes = [c_i64, c_i64, c_i64, _u32p, _u32p,
+                                          ctypes.c_uint32, ctypes.c_uint32,
+                                          ctypes.c_uint32, _i64p]
     _lib = lib
     return lib
 
@@ -173,3 +177,19 @@ def parse_text(data: bytes, max_edges: Optional[int] = None):
     cnt = lib.sheep_parse_text(data, len(data), out.reshape(-1), cap,
                                ctypes.byref(consumed))
     return out[:cnt].copy(), consumed.value
+
+
+def rmat_hash_range(scale: int, start: int, count: int,
+                    keys, keys2, thresholds) -> np.ndarray:
+    """Native twin of generators._rmat_hash_uv over an edge-index range
+    (bit-identical; asserted by tests/test_rmat_hash.py). ``keys``/
+    ``keys2`` are the premixed per-level uint32 constants, ``thresholds``
+    the (t_u, t_v0, t_v1) quadrant cutoffs."""
+    lib = load()
+    out = np.empty((count, 2), dtype=np.int64)
+    lib.sheep_rmat_hash_range(
+        scale, start, count,
+        np.ascontiguousarray(keys, dtype=np.uint32),
+        np.ascontiguousarray(keys2, dtype=np.uint32),
+        int(thresholds[0]), int(thresholds[1]), int(thresholds[2]), out)
+    return out

@@ -398,6 +398,10 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
         from sheep_tpu.io import generators
 
         parts = rest.split(":")
+        if len(parts) > 3:
+            raise ValueError(
+                f"bad synthetic input spec {spec!r}; want "
+                f"{kind}:SCALE[:EF[:SEED]] (got {len(parts)} fields)")
         try:
             scale = int(parts[0])
             ef = int(parts[1]) if len(parts) > 1 else 16

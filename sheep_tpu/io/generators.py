@@ -156,6 +156,14 @@ def _rmat_hash_keys(scale: int, seed: int):
     return [_mix32_int(s + 0x9E3779B9 * (lvl + 1)) for lvl in range(scale)]
 
 
+def _rmat_hash_keys2(keys):
+    """Second per-level constant (folded with the high counter word
+    mid-mix) — ONE definition shared by the numpy body, the native
+    dispatch, and the tests, so the premix cannot drift between the
+    bit-identical implementations."""
+    return [_mix32_int(k ^ 0x7FEB352D) for k in keys]
+
+
 def _rmat_hash_thresholds(a: float, b: float, c: float):
     """16-bit integer thresholds for the quadrant choice."""
     d = 1.0 - a - b - c
@@ -175,13 +183,13 @@ def _rmat_hash_uv(xp, elo, ehi, keys, thresholds, dtype):
     u = xp.zeros(elo.shape, dtype=xp.uint32)
     v = xp.zeros(elo.shape, dtype=xp.uint32)
     one = xp.uint32(1)
-    for bit, key in enumerate(keys):
+    for bit, (key, key2) in enumerate(zip(keys, _rmat_hash_keys2(keys))):
         # murmur3 fmix32 over (elo ^ key), folded with ehi mid-mix so
         # both counter words reach every output bit
         h = elo ^ xp.uint32(key)
         h = h ^ (h >> xp.uint32(16))
         h = h * xp.uint32(0x85EBCA6B)
-        h = h ^ (ehi ^ xp.uint32(_mix32_int(key ^ 0x7FEB352D)))
+        h = h ^ (ehi ^ xp.uint32(key2))
         h = h ^ (h >> xp.uint32(13))
         h = h * xp.uint32(0xC2B2AE35)
         h = h ^ (h >> xp.uint32(16))
@@ -205,9 +213,19 @@ def rmat_hash_range(
     seed: int = 0,
 ) -> np.ndarray:
     """Edges [start, start+count) of the counter-based R-MAT stream, as a
-    (count, 2) int64 array (numpy host twin of the device generator)."""
+    (count, 2) int64 array (host twin of the device generator).
+
+    Large ranges take the native C loop when the core is built (~100x
+    the numpy path, bit-identical — the soak generator's bottleneck was
+    host hashing); small ranges and toolchain-less hosts use numpy."""
     keys = _rmat_hash_keys(scale, seed)
     th = _rmat_hash_thresholds(a, b, c)
+    if count >= 4096:
+        from sheep_tpu.core import native
+
+        if native.available():
+            return native.rmat_hash_range(scale, start, count, keys,
+                                          _rmat_hash_keys2(keys), th)
     idx = start + np.arange(count, dtype=np.int64)
     elo = (idx & _M32).astype(np.uint32)
     ehi = (idx >> 32).astype(np.uint32)

@@ -226,3 +226,25 @@ def test_scale_bounds_and_path_inputs(tmp_path):
     p = tmp_path / "tiny.edges"
     formats.write_edges(str(p), generators.karate_club())
     assert open_input(Path(p)).num_edges == 78
+
+
+def test_native_generator_bit_identical_and_fast():
+    from sheep_tpu.core import native
+    from sheep_tpu.io.generators import (_rmat_hash_keys, _rmat_hash_keys2,
+                                         _rmat_hash_thresholds,
+                                         _rmat_hash_uv)
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    scale, seed, start, count = 20, 17, (1 << 32) - 500, 20000
+    keys = _rmat_hash_keys(scale, seed)
+    th = _rmat_hash_thresholds(0.57, 0.19, 0.19)
+    nat = native.rmat_hash_range(scale, start, count, keys,
+                                 _rmat_hash_keys2(keys), th)
+    idx = start + np.arange(count, dtype=np.int64)
+    u, v = _rmat_hash_uv(np, (idx & 0xFFFFFFFF).astype(np.uint32),
+                         (idx >> 32).astype(np.uint32), keys, th, np.int64)
+    np.testing.assert_array_equal(nat, np.stack([u, v], axis=1))
+    # and the public entry point (which routes large counts natively)
+    np.testing.assert_array_equal(
+        nat, rmat_hash_range(scale, start, count, seed=17))

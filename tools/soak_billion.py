@@ -35,15 +35,30 @@ sys.path.insert(0, REPO)
 
 
 def generate(path: str, scale: int, ef: int, seed: int = 42,
-             chunk: int = 1 << 22) -> float:
-    """Stream RMAT chunks to a .bin32 file; returns wall seconds."""
+             chunk: int = 1 << 22, gen: str = "hash") -> float:
+    """Stream RMAT chunks to a .bin32 file; returns wall seconds.
+
+    ``gen="hash"`` (default) uses the counter-based generator, whose
+    native C loop runs ~3 M edges/s/core — the PCG path (``gen="pcg"``,
+    the r3 soak_s26 artifact's generator) measured ~0.4 M edges/s and
+    made generation, not partitioning, the soak bottleneck."""
     from sheep_tpu.io import generators
+
+    m = ef << scale
+
+    def blocks():
+        if gen == "hash":
+            yield from generators.RmatHashStream(
+                scale, ef, seed=seed).chunks(chunk)
+        else:
+            yield from generators.rmat_stream(scale, ef, seed=seed,
+                                              chunk=chunk)
 
     t0 = time.perf_counter()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         done = 0
-        for block in generators.rmat_stream(scale, ef, seed=seed, chunk=chunk):
+        for block in blocks():
             np.ascontiguousarray(block, dtype="<u4").tofile(f)
             done += len(block)
             if done % (chunk << 5) == 0:
@@ -88,8 +103,10 @@ def orchestrate(args) -> dict:
         print(f"reusing {data}")
         result["gen_seconds"] = None
     else:
-        print(f"generating {m / 1e9:.2f}B edges -> {data}")
-        result["gen_seconds"] = round(generate(data, args.scale, args.ef), 1)
+        print(f"generating {m / 1e9:.2f}B edges -> {data} ({args.gen})")
+        result["gen"] = args.gen
+        result["gen_seconds"] = round(
+            generate(data, args.scale, args.ef, gen=args.gen), 1)
         print(f"  done in {result['gen_seconds']}s")
 
     # fresh run; SIGKILL once the build phase has checkpointed past the
@@ -148,6 +165,9 @@ def main():
     ap.add_argument("--kill-at-chunk", type=int, default=64,
                     help="SIGKILL once a build checkpoint >= this chunk exists")
     ap.add_argument("--timeout", type=float, default=7200)
+    ap.add_argument("--gen", choices=["hash", "pcg"], default="hash",
+                    help="edge generator: counter-hash (native C loop, "
+                         "fast) or the PCG replay generator")
     args = ap.parse_args()
 
     res = orchestrate(args)
