@@ -1,0 +1,253 @@
+"""Memory-mapped CSR graph storage (SURVEY.md §2 #13, §1 storage engine).
+
+The upstream system builds on a memory-mapped multiversion CSR store
+(LLAMA) as its in-memory graph representation [PAPER]/[UNVERIFIED —
+reference mount empty, SURVEY.md §0]. The partitioning pipeline itself
+only ever *streams* edges, so the rebuild descoped a full multiversion
+store (SURVEY.md §7 "What NOT to build"); what this module provides is
+the capability that matters at the EdgeStream boundary: a **single
+snapshot, mmap-backed CSR on-disk format** that
+
+- round-trips the exact edge multiset of any EdgeStream source,
+- answers ``num_vertices`` / ``num_edges`` in O(1) from the header,
+- seeks any edge-id range in O(log V) (one ``searchsorted`` on the
+  mmapped ``indptr``) — so chunked streaming, round-robin sharding and
+  checkpoint resume cost the same as the raw binary formats,
+- serves adjacency queries (``neighbors(u)``, ``out_degree``) that the
+  flat edge-list formats cannot answer without a full scan.
+
+Layout (all little-endian, fixed 32-byte header)::
+
+    magic    8s   = b"SHEEPCSR"
+    version  u32  = 1
+    flags    u32    bit0: indices are int64 (else int32)
+    n_vertices u64
+    n_edges    u64
+    indptr   int64[n_vertices + 1]
+    indices  int32|int64[n_edges]
+
+Vertex ``u`` owns edge ids ``[indptr[u], indptr[u+1])``; ``indices``
+holds the destination of each edge. Source vertices are implicit — the
+~50% size saving vs ``.bin64`` is the point of CSR on disk. Duplicate
+edges and self-loops are preserved verbatim, so conversion is lossless
+up to edge *order* (edges regroup under their source vertex, input
+order preserved within a vertex). The partition pipeline is invariant
+to stream order — the elimination forest is a function of the
+constraint multiset (ops/elim.py), degrees/scores are order-free sums —
+so a converted graph partitions bit-identically to its source
+(tests/test_csr.py asserts this end-to-end).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+from typing import Optional
+
+import numpy as np
+
+MAGIC = b"SHEEPCSR"
+VERSION = 1
+_HEADER = struct.Struct("<8sIIQQ")
+HEADER_BYTES = _HEADER.size  # 32
+FLAG_WIDE = 1  # indices stored as int64 (graphs with >= 2^31 vertices)
+
+
+class CsrHeader:
+    __slots__ = ("n_vertices", "n_edges", "wide")
+
+    def __init__(self, n_vertices: int, n_edges: int, wide: bool):
+        self.n_vertices = n_vertices
+        self.n_edges = n_edges
+        self.wide = wide
+
+    @property
+    def indptr_offset(self) -> int:
+        return HEADER_BYTES
+
+    @property
+    def indices_offset(self) -> int:
+        return HEADER_BYTES + 8 * (self.n_vertices + 1)
+
+    @property
+    def indices_dtype(self) -> np.dtype:
+        return np.dtype("<i8") if self.wide else np.dtype("<i4")
+
+
+def read_header(path: str) -> CsrHeader:
+    with open(path, "rb") as f:
+        raw = f.read(HEADER_BYTES)
+    if len(raw) < HEADER_BYTES:
+        raise ValueError(f"{path!r}: truncated CSR header")
+    magic, version, flags, n, e = _HEADER.unpack(raw)
+    if magic != MAGIC:
+        raise ValueError(f"{path!r}: not a SHEEPCSR file (magic {magic!r})")
+    if version != VERSION:
+        raise ValueError(f"{path!r}: CSR version {version} "
+                         f"(this build reads {VERSION})")
+    return CsrHeader(n, e, bool(flags & FLAG_WIDE))
+
+
+class CsrGraph:
+    """Read-only mmap view of a ``.csr`` file.
+
+    Opens lazily and holds the maps only while alive; EdgeStream's
+    chunk iterators open/close one per pass, keeping the no-persistent-fd
+    contract of the other formats.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.header = read_header(path)
+        h = self.header
+        self._indptr = np.memmap(path, dtype="<i8", mode="r",
+                                 offset=h.indptr_offset,
+                                 shape=(h.n_vertices + 1,))
+        self._indices = np.memmap(path, dtype=h.indices_dtype, mode="r",
+                                  offset=h.indices_offset,
+                                  shape=(h.n_edges,))
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def n_vertices(self) -> int:
+        return self.header.n_vertices
+
+    @property
+    def n_edges(self) -> int:
+        return self.header.n_edges
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self._indptr
+
+    # -- adjacency --------------------------------------------------------
+    def out_degrees(self) -> np.ndarray:
+        return np.diff(self._indptr)
+
+    def out_degree(self, u: int) -> int:
+        return int(self._indptr[u + 1] - self._indptr[u])
+
+    def neighbors(self, u: int) -> np.ndarray:
+        return np.asarray(
+            self._indices[self._indptr[u]:self._indptr[u + 1]],
+            dtype=np.int64)
+
+    # -- edge-id addressing (the EdgeStream seek primitive) ---------------
+    def edge_slice(self, start: int, end: int) -> np.ndarray:
+        """Edges with ids in ``[start, end)`` as an (end-start, 2) int64
+        array. O(log V) to locate the vertex span + O(output)."""
+        e = self.header.n_edges
+        start = max(0, min(start, e))
+        end = max(start, min(end, e))
+        if end == start:
+            return np.zeros((0, 2), dtype=np.int64)
+        indptr = self._indptr
+        lo = int(np.searchsorted(indptr, start, side="right")) - 1
+        hi = int(np.searchsorted(indptr, end, side="left")) - 1
+        starts = np.maximum(np.asarray(indptr[lo:hi + 1], dtype=np.int64),
+                            start)
+        ends = np.minimum(np.asarray(indptr[lo + 1:hi + 2], dtype=np.int64),
+                          end)
+        out = np.empty((end - start, 2), dtype=np.int64)
+        out[:, 0] = np.repeat(np.arange(lo, hi + 1, dtype=np.int64),
+                              ends - starts)
+        out[:, 1] = self._indices[start:end]
+        return out
+
+    def close(self) -> None:
+        # numpy memmaps release on gc; drop refs eagerly so a pass's
+        # mappings do not outlive it
+        self._indptr = self._indices = None  # type: ignore[assignment]
+
+
+def write_csr(path: str, stream, n_vertices: Optional[int] = None,
+              chunk_edges: int = 1 << 22) -> CsrHeader:
+    """Convert any EdgeStream-like source to a ``.csr`` file.
+
+    Two streaming passes, O(V) host memory (degree counters + write
+    cursors), edges written straight into the mmapped indices region —
+    the same bounded-footprint discipline as the partition pipeline, so
+    conversion scales to the billion-edge soak class.
+
+    The write is atomic: everything lands in ``path + '.tmp'`` and is
+    renamed over ``path`` only when complete.
+    """
+    n = stream.num_vertices if n_vertices is None else n_vertices
+    # pass 1: out-degrees
+    deg = np.zeros(n, dtype=np.int64)
+    e_total = 0
+    for chunk in stream.chunks(chunk_edges):
+        if len(chunk) == 0:
+            continue
+        if int(chunk.min()) < 0 or int(chunk.max()) >= n:
+            raise ValueError(f"edge endpoint out of range [0, {n})")
+        u = np.asarray(chunk[:, 0], dtype=np.int64)
+        deg += np.bincount(u, minlength=n)
+        e_total += len(chunk)
+    wide = n > np.iinfo(np.int32).max
+    header = CsrHeader(n, e_total, wide)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=indptr[1:])
+
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, VERSION, FLAG_WIDE if wide else 0,
+                             n, e_total))
+        indptr.astype("<i8", copy=False).tofile(f)
+        f.truncate(header.indices_offset +
+                   e_total * header.indices_dtype.itemsize)
+    # pass 2: scatter destinations into each source's slot range; cursor
+    # tracks the next free slot per vertex, per-chunk stable sort keeps
+    # a vertex's input edge order
+    cursor = indptr[:-1].copy()
+    if e_total:
+        mm = np.memmap(tmp, dtype=header.indices_dtype, mode="r+",
+                       offset=header.indices_offset, shape=(e_total,))
+        for chunk in stream.chunks(chunk_edges):
+            if len(chunk) == 0:
+                continue
+            u = np.asarray(chunk[:, 0], dtype=np.int64)
+            v = np.asarray(chunk[:, 1], dtype=np.int64)
+            order = np.argsort(u, kind="stable")
+            us = u[order]
+            # rank of each edge within its vertex group in this chunk
+            boundary = np.empty(len(us), dtype=bool)
+            boundary[0] = True
+            np.not_equal(us[1:], us[:-1], out=boundary[1:])
+            group_start = np.maximum.accumulate(
+                np.where(boundary, np.arange(len(us)), 0))
+            rank = np.arange(len(us)) - group_start
+            mm[cursor[us] + rank] = v[order]
+            uniq, counts = us[boundary], np.diff(
+                np.append(np.flatnonzero(boundary), len(us)))
+            cursor[uniq] += counts
+        mm.flush()
+        del mm
+    if not np.array_equal(cursor, indptr[1:]):
+        raise RuntimeError("CSR conversion: stream changed between passes")
+    os.replace(tmp, path)
+    return header
+
+
+def main(argv=None) -> int:
+    """``python -m sheep_tpu.io.csr INPUT OUTPUT.csr [NUM_VERTICES]`` —
+    convert any supported input (file path or synthetic spec) to CSR."""
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3):
+        print(__doc__.splitlines()[0], file=sys.stderr)
+        print("usage: python -m sheep_tpu.io.csr INPUT OUTPUT.csr "
+              "[NUM_VERTICES]", file=sys.stderr)
+        return 2
+    from sheep_tpu.io.edgestream import open_input
+
+    n = int(argv[2]) if len(argv) == 3 else None
+    stream = open_input(argv[0], n_vertices=n)
+    h = write_csr(argv[1], stream)
+    print(f"wrote {argv[1]}: {h.n_vertices} vertices, {h.n_edges} edges, "
+          f"{'int64' if h.wide else 'int32'} indices")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,6 +84,10 @@ class EdgeStream:
                 self._n_edges = os.path.getsize(self.path) // 8
             elif self.fmt == "bin64":
                 self._n_edges = os.path.getsize(self.path) // 16
+            elif self.fmt == "csr":
+                from sheep_tpu.io import csr as csr_mod
+
+                self._n_edges = csr_mod.read_header(self.path).n_edges
             else:  # text/generator: one counting pass
                 n = 0
                 for chunk in self.chunks():
@@ -95,7 +99,7 @@ class EdgeStream:
     def num_edges_cheap(self) -> Optional[int]:
         """num_edges when it costs O(1) (binary/memory formats or already
         counted); None when computing it would require a file pass."""
-        if self._n_edges is not None or self.fmt in ("bin32", "bin64"):
+        if self._n_edges is not None or self.fmt in ("bin32", "bin64", "csr"):
             return self.num_edges
         return None
 
@@ -126,8 +130,14 @@ class EdgeStream:
 
     @property
     def num_vertices(self) -> int:
-        """max vertex id + 1; computed by a streaming pass if not provided."""
+        """max vertex id + 1; O(1) from the CSR header, else a streaming
+        pass if not provided."""
         if self._n_vertices is None:
+            if self.fmt == "csr":
+                from sheep_tpu.io import csr as csr_mod
+
+                self._n_vertices = csr_mod.read_header(self.path).n_vertices
+                return self._n_vertices
             m = -1
             for chunk in self.chunks():
                 if len(chunk):
@@ -166,6 +176,8 @@ class EdgeStream:
             yield from self._chunks_memory(chunk_edges, shard, num_shards, start_chunk)
         elif self.fmt in ("bin32", "bin64"):
             yield from self._chunks_binary(chunk_edges, shard, num_shards, start_chunk)
+        elif self.fmt == "csr":
+            yield from self._chunks_csr(chunk_edges, shard, num_shards, start_chunk)
         elif byte_range:
             yield from self._chunks_text_span(chunk_edges, shard, num_shards, start_chunk)
         else:
@@ -234,6 +246,21 @@ class EdgeStream:
                 f.seek(off * pair_bytes)
                 flat = np.fromfile(f, dtype=dtype, count=2 * count)
                 yield flat.reshape(-1, 2).astype(np.int64, copy=False)
+
+    def _chunks_csr(self, chunk_edges, shard, num_shards, start_chunk):
+        """O(log V) seek per chunk via the mmapped indptr (csr.py
+        edge_slice); ownership/indexing identical to _chunks_binary."""
+        from sheep_tpu.io import csr as csr_mod
+
+        g = csr_mod.CsrGraph(self.path)
+        try:
+            total = g.n_edges
+            for idx, off in enumerate(range(0, total, chunk_edges)):
+                if not self._owns(idx, shard, num_shards, start_chunk):
+                    continue
+                yield g.edge_slice(off, min(off + chunk_edges, total))
+        finally:
+            g.close()
 
     def _chunks_text(self, chunk_edges, shard, num_shards, start_chunk):
         try:

@@ -24,6 +24,7 @@ import numpy as np
 TEXT_EXTS = (".edges", ".txt", ".el", ".snap")
 BIN32_EXTS = (".bin32", ".bin")
 BIN64_EXTS = (".bin64",)
+CSR_EXTS = (".csr",)
 
 
 def detect_format(path: str) -> str:
@@ -34,6 +35,8 @@ def detect_format(path: str) -> str:
         return "bin32"
     if ext in BIN64_EXTS:
         return "bin64"
+    if ext in CSR_EXTS:
+        return "csr"
     raise ValueError(f"unknown graph format for {path!r} (ext {ext!r})")
 
 

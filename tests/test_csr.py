@@ -1,0 +1,145 @@
+"""CSR storage engine tests (SURVEY.md §2 #13): lossless conversion,
+O(1)/O(log V) access, EdgeStream parity, and end-to-end partition
+equivalence with the flat formats."""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import csr, formats, generators
+from sheep_tpu.io.edgestream import EdgeStream, open_input
+
+
+def _sorted_rows(e):
+    e = np.asarray(e, dtype=np.int64).reshape(-1, 2)
+    return e[np.lexsort((e[:, 1], e[:, 0]))]
+
+
+@pytest.fixture
+def karate_csr(tmp_path):
+    e = generators.karate_club()
+    p = str(tmp_path / "karate.csr")
+    csr.write_csr(p, EdgeStream.from_array(e))
+    return p, e
+
+
+def test_roundtrip_edge_multiset(karate_csr):
+    p, e = karate_csr
+    back = EdgeStream.open(p).read_all()
+    np.testing.assert_array_equal(_sorted_rows(back), _sorted_rows(e))
+
+
+def test_detect_and_o1_metadata(karate_csr):
+    p, e = karate_csr
+    assert formats.detect_format(p) == "csr"
+    s = EdgeStream.open(p)
+    assert s.num_edges_cheap == len(e)
+    assert s.num_vertices == int(e.max()) + 1
+    assert s.num_edges_upper_bound == len(e)
+
+
+def test_grouped_by_source_input_order_kept(tmp_path):
+    # duplicates + self loop survive; within a vertex, input order holds
+    e = np.array([[2, 0], [0, 5], [2, 9], [0, 3], [2, 9], [1, 1]])
+    p = str(tmp_path / "g.csr")
+    csr.write_csr(p, EdgeStream.from_array(e), n_vertices=10)
+    g = csr.CsrGraph(p)
+    np.testing.assert_array_equal(g.neighbors(0), [5, 3])
+    np.testing.assert_array_equal(g.neighbors(1), [1])
+    np.testing.assert_array_equal(g.neighbors(2), [0, 9, 9])
+    assert g.out_degree(5) == 0
+    np.testing.assert_array_equal(g.out_degrees(),
+                                  [2, 1, 3, 0, 0, 0, 0, 0, 0, 0])
+    g.close()
+
+
+def test_adjacency_matches_bruteforce(karate_csr):
+    p, e = karate_csr
+    g = csr.CsrGraph(p)
+    for u in range(int(e.max()) + 1):
+        expect = e[e[:, 0] == u][:, 1]
+        np.testing.assert_array_equal(np.sort(g.neighbors(u)),
+                                      np.sort(expect))
+    g.close()
+
+
+def test_edge_slice_random_access(karate_csr):
+    p, _ = karate_csr
+    g = csr.CsrGraph(p)
+    full = g.edge_slice(0, g.n_edges)
+    for s, t in [(0, 1), (3, 17), (g.n_edges - 2, g.n_edges),
+                 (5, 5), (0, g.n_edges)]:
+        np.testing.assert_array_equal(g.edge_slice(s, t), full[s:t])
+    g.close()
+
+
+def test_chunked_stream_shard_and_resume(karate_csr):
+    p, _ = karate_csr
+    s = EdgeStream.open(p)
+    whole = s.read_all()
+    # small chunks, round-robin over 3 shards: disjoint cover, in order
+    parts = [list(s.chunks(8, shard, 3)) for shard in range(3)]
+    seen = [None] * (-(-len(whole) // 8))
+    for shard, chunks in enumerate(parts):
+        for j, c in enumerate(chunks):
+            seen[j * 3 + shard] = c
+    np.testing.assert_array_equal(np.concatenate(seen), whole)
+    # start_chunk resume skips exactly the first chunks
+    np.testing.assert_array_equal(
+        np.concatenate(list(s.chunks(8, start_chunk=2))), whole[16:])
+
+
+def test_empty_and_isolated_vertices(tmp_path):
+    p = str(tmp_path / "empty.csr")
+    csr.write_csr(p, EdgeStream.from_array(np.zeros((0, 2), int)),
+                  n_vertices=4)
+    s = EdgeStream.open(p)
+    assert s.num_edges == 0 and s.num_vertices == 4
+    assert list(s.chunks(4)) == []
+
+
+def test_header_rejects_garbage(tmp_path):
+    p = str(tmp_path / "bad.csr")
+    with open(p, "wb") as f:
+        f.write(b"NOTSHEEP" + b"\0" * 40)
+    with pytest.raises(ValueError, match="not a SHEEPCSR"):
+        csr.read_header(p)
+
+
+def test_endpoint_range_validated(tmp_path):
+    p = str(tmp_path / "g.csr")
+    with pytest.raises(ValueError, match="out of range"):
+        csr.write_csr(p, EdgeStream.from_array(np.array([[0, 7]])),
+                      n_vertices=4)
+
+
+def test_wide_dtype_selection():
+    assert csr.CsrHeader(1 << 20, 0, False).indices_dtype == np.dtype("<i4")
+    assert csr.CsrHeader(1 << 32, 0, True).indices_dtype == np.dtype("<i8")
+
+
+def test_converter_main(tmp_path, capsys):
+    e = generators.karate_club()
+    src = str(tmp_path / "g.bin32")
+    formats.write_edges(src, e)
+    dst = str(tmp_path / "g.csr")
+    assert csr.main([src, dst]) == 0
+    assert "34 vertices" in capsys.readouterr().out
+    np.testing.assert_array_equal(
+        _sorted_rows(EdgeStream.open(dst).read_all()), _sorted_rows(e))
+
+
+def test_partition_equivalent_to_bin32(tmp_path):
+    """Stream order changes under CSR regrouping; the partition must not
+    (the forest is a function of the constraint multiset — ops/elim.py)."""
+    from sheep_tpu.backends.base import get_backend
+
+    e = generators.rmat(8, 8, seed=3)
+    src = str(tmp_path / "g.bin32")
+    formats.write_edges(src, e)
+    dst = str(tmp_path / "g.csr")
+    csr.write_csr(dst, EdgeStream.open(src))
+    res_bin = get_backend("pure").partition(open_input(src), 4)
+    res_csr = get_backend("pure").partition(open_input(dst), 4)
+    np.testing.assert_array_equal(res_bin.assignment, res_csr.assignment)
+    assert res_bin.edge_cut == res_csr.edge_cut
+    assert res_bin.balance == res_csr.balance

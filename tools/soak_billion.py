@@ -44,8 +44,6 @@ def generate(path: str, scale: int, ef: int, seed: int = 42,
     made generation, not partitioning, the soak bottleneck."""
     from sheep_tpu.io import generators
 
-    m = ef << scale
-
     def blocks():
         if gen == "hash":
             yield from generators.RmatHashStream(
@@ -91,12 +89,16 @@ def read_manifest(ckpt_dir: str):
 def orchestrate(args) -> dict:
     out_dir = os.path.join(REPO, "tools", "out", "soak")
     os.makedirs(out_dir, exist_ok=True)
-    data = os.path.join(out_dir, f"rmat{args.scale}_ef{args.ef}.bin32")
+    # encode the generator in the artifact name: hash and pcg produce
+    # different streams of the same size, so a cached file from one must
+    # not satisfy a soak requested with the other
+    data = os.path.join(
+        out_dir, f"rmat{args.scale}_ef{args.ef}_{args.gen}.bin32")
     ckpt_dir = os.path.join(out_dir, f"ckpt_s{args.scale}")
     n = 1 << args.scale
     m = args.ef << args.scale
     result = {"scale": args.scale, "ef": args.ef, "k": args.k,
-              "n_vertices": n, "n_edges": m,
+              "n_vertices": n, "n_edges": m, "gen": args.gen,
               "chunk_edges": args.chunk_edges}
 
     if os.path.exists(data) and os.path.getsize(data) == 8 * m:
@@ -104,7 +106,6 @@ def orchestrate(args) -> dict:
         result["gen_seconds"] = None
     else:
         print(f"generating {m / 1e9:.2f}B edges -> {data} ({args.gen})")
-        result["gen"] = args.gen
         result["gen_seconds"] = round(
             generate(data, args.scale, args.ef, gen=args.gen), 1)
         print(f"  done in {result['gen_seconds']}s")
