@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Bench-FIRST tunnel watcher (round 3b). Differences from tpu_watch.sh,
+# learned the hard way:
+#   - the headline bench.py runs FIRST in the healthy window (the sweep
+#     twice outlived the window and cost the round its headline);
+#   - cheap 60s probes between attempts instead of letting bench.py's
+#     30-min attempt timeout block blind (a wedged tunnel hangs clients
+#     at jax init, burning the ladder with zero signal);
+#   - tools/out/CAPTURING flag while working so concurrent dev work can
+#     yield the (single) host core — the CPU baseline leg is
+#     contention-sensitive (r2's numbers were polluted that way);
+#   - JAX_COMPILATION_CACHE_DIR defaults into the repo (.jax_cache) so
+#     machine resets don't re-pay the ~7 min cold warm-up.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+interval=${SHEEP_WATCH_INTERVAL:-180}
+deadline=$(( $(date +%s) + ${SHEEP_WATCH_HOURS:-10} * 3600 ))
+flag=tools/out/CAPTURING
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp, numpy as np
+assert int(np.asarray(jnp.sum(jnp.arange(8)))) == 28
+print('ok')" 2>/dev/null | grep -q ok
+}
+
+cleanup() { rm -f "$flag"; }
+trap cleanup EXIT
+
+have_bench=""
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if probe; then
+    ts=$(date -u +%Y%m%dT%H%M%S)
+    out="tools/out/$ts"
+    mkdir -p "$out"
+    touch "$flag"
+    echo "tunnel healthy at $ts; capturing (bench first)" | tee "$out/watch.log"
+    if [ -z "$have_bench" ]; then
+      timeout 2400 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
+      cat "$out/bench.json" | tee -a "$out/watch.log"
+      if grep -q '"vs_baseline"' "$out/bench.json" && \
+         ! grep -q '"value": 0.0' "$out/bench.json" && \
+         ! grep -q '"platform": "cpu"' "$out/bench.json"; then
+        have_bench=yes
+        echo "HEADLINE LANDED" | tee -a "$out/watch.log"
+      else
+        echo "bench incomplete; resuming poll" | tee -a "$out/watch.log"
+        rm -f "$flag"
+        sleep "$interval"
+        continue
+      fi
+    fi
+    # headline on file: best-effort extras in priority order. Each gets
+    # its own timeout; a wedge mid-extra keeps the headline.
+    timeout 1500 python tools/microbench_fixpoint.py --scale 22 \
+      --chunk-log 23 --profile-dir "$out/xprof" \
+      >"$out/microbench.jsonl" 2>>"$out/watch.log"
+    echo "microbench rc=$?" | tee -a "$out/watch.log"
+    timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
+      --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
+      --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
+      --overlap 0,1 \
+      >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
+    echo "tune rc=$?" | tee -a "$out/watch.log"
+    if [ -s "$out/microbench.jsonl" ] && [ -s "$out/tune22_post.jsonl" ]; then
+      echo "full capture complete" | tee -a "$out/watch.log"
+      rm -f "$flag"
+      exit 0
+    fi
+    rm -f "$flag"
+  fi
+  sleep "$interval"
+done
+echo "deadline reached"
+exit 1
