@@ -244,6 +244,22 @@ class ShardedPipeline:
                         P_all, lo_all, hi_all)
             return fold_seg_step
 
+        # pmax'd live count of a (D, W) active buffer — one tiny
+        # replicated scalar, no fold. Lets the merge right-size a
+        # received buffer BEFORE paying a full-width fold segment (merge
+        # buffers are usually nearly empty: O(boundary) pairs in an
+        # O(V)-capacity exchange). One instance serves every width: jit
+        # caches an executable per input shape.
+        @partial(jax.jit, in_shardings=(self.state_sharding,),
+                 out_shardings=self.repl_sharding)
+        def live_count(lo_all):
+            def f(lo_local):
+                live = jnp.sum(lo_local[0] != n_, dtype=jnp.int32)
+                return lax.pmax(live, SHARD_AXIS)
+            return shard_map(
+                f, mesh=mesh, in_specs=(P(SHARD_AXIS, None),),
+                out_specs=P())(lo_all)
+
         def _make_compact(to_size: int):
             @partial(jax.jit,
                      in_shardings=(self.state_sharding, self.state_sharding),
@@ -263,6 +279,7 @@ class ShardedPipeline:
         self.orient_step = orient_step
         self._fold_full = _make_fold_seg(False)
         self._fold_small = _make_fold_seg(True)
+        self._live_count = live_count
         self._fold_warm = [
             _make_fold_seg(False, warm_levels=wl, warm_rounds=wr)
             for wr, wl in self.warm_schedule]
@@ -373,7 +390,7 @@ class ShardedPipeline:
 
     SMALL_SIZE = 1 << 14
 
-    def _fold_actives(self, P_all, lo_all, hi_all):
+    def _fold_actives(self, P_all, lo_all, hi_all, skip_warm: bool = False):
         """Adaptive host-driven fold of (D, W) active-constraint buffers
         into the per-device forests (same unique forests as a monolithic
         while_loop): compact every device's buffer to the same smaller
@@ -382,9 +399,11 @@ class ShardedPipeline:
         lifting-table rebuild). The pmax'd flags keep all devices and
         processes in lockstep; a host tail is not used here because the
         forests are per-device (pulling D of them would cost O(V*D)
-        transfers) — the jump-mode tail is the sharded equivalent."""
+        transfers) — the jump-mode tail is the sharded equivalent.
+        ``skip_warm`` (merge folds): the buffer was already right-sized
+        by the caller, go straight to the resolved schedule."""
         size = int(lo_all.shape[-1])
-        warm = list(self._fold_warm)
+        warm = [] if skip_warm else list(self._fold_warm)
         while True:
             if warm and size > self.SMALL_SIZE:
                 step = warm.pop(0)
@@ -455,7 +474,24 @@ class ShardedPipeline:
                 fn = self._exchange_cache[(cap0, r)] = \
                     self._make_exchange(cap0, r)
             lo_all, hi_all = fn(P_all)
-            P_all = self._fold_actives(P_all, lo_all, hi_all)
+            # received buffers are usually nearly empty (O(boundary)
+            # pairs in the exchange's power-of-2 capacity): right-size
+            # BEFORE the first fold segment instead of paying one
+            # full-width round to discover the live count, and skip the
+            # chunk-oriented warm schedule (warm rounds earn their keep
+            # on fresh C-width chunks, not on a boundary tail)
+            width = int(lo_all.shape[-1])
+            live = int(self._live_count(lo_all))
+            if live == 0:
+                continue
+            tgt = elim_ops.pow2_at_least(2 * live, floor=self.SMALL_SIZE)
+            if tgt < width:
+                cfn = self._compact_cache.get(tgt)
+                if cfn is None:
+                    cfn = self._compact_cache[tgt] = self._make_compact(tgt)
+                lo_all, hi_all = cfn(lo_all, hi_all)
+            P_all = self._fold_actives(P_all, lo_all, hi_all,
+                                       skip_warm=True)
         merged = self._extract_merged(P_all)
         if stats is not None:
             total = 0

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Run a long CPU job that yields the (single) host core to TPU captures:
+# SIGSTOP the whole process group while tools/out/CAPTURING exists
+# (raised by tpu_watch2.sh), SIGCONT when it clears. The soak pipeline
+# is checkpointed and kill-tolerant, so a pause is strictly safe.
+# Usage: run_paused_aware.sh LOGFILE CMD ARGS...
+set -u
+cd "$(dirname "$0")/.."
+log=$1; shift
+flag=tools/out/CAPTURING
+setsid "$@" >"$log" 2>&1 &
+pid=$!
+pgid=$(ps -o pgid= -p "$pid" | tr -d ' ')
+stopped=0
+while kill -0 "$pid" 2>/dev/null; do
+  if [ -e "$flag" ] && [ "$stopped" = 0 ]; then
+    kill -STOP -- "-$pgid" 2>/dev/null && stopped=1
+    echo "[pause-wrapper] STOPPED for capture $(date -u +%H:%M:%S)" >>"$log"
+  elif [ ! -e "$flag" ] && [ "$stopped" = 1 ]; then
+    kill -CONT -- "-$pgid" 2>/dev/null && stopped=0
+    echo "[pause-wrapper] RESUMED $(date -u +%H:%M:%S)" >>"$log"
+  fi
+  sleep 5
+done
+wait "$pid"
+echo "[pause-wrapper] job exited rc=$?" >>"$log"

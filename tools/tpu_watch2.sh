@@ -29,6 +29,8 @@ cleanup() { rm -f "$flag"; }
 trap cleanup EXIT
 
 have_bench=""
+have_micro=""
+have_tune=""
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe; then
     ts=$(date -u +%Y%m%dT%H%M%S)
@@ -43,7 +45,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
          ! grep -q '"value": 0.0' "$out/bench.json" && \
          ! grep -q '"platform": "cpu"' "$out/bench.json"; then
         have_bench=yes
-        echo "HEADLINE LANDED" | tee -a "$out/watch.log"
+        echo "HEADLINE LANDED in $out" | tee -a "$out/watch.log"
       else
         echo "bench incomplete; resuming poll" | tee -a "$out/watch.log"
         rm -f "$flag"
@@ -51,20 +53,31 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         continue
       fi
     fi
-    # headline on file: best-effort extras in priority order. Each gets
-    # its own timeout; a wedge mid-extra keeps the headline.
-    timeout 1500 python tools/microbench_fixpoint.py --scale 22 \
-      --chunk-log 23 --profile-dir "$out/xprof" \
-      >"$out/microbench.jsonl" 2>>"$out/watch.log"
-    echo "microbench rc=$?" | tee -a "$out/watch.log"
-    timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
-      --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
-      --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
-      --overlap 0,1 \
-      >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
-    echo "tune rc=$?" | tee -a "$out/watch.log"
-    if [ -s "$out/microbench.jsonl" ] && [ -s "$out/tune22_post.jsonl" ]; then
-      echo "full capture complete" | tee -a "$out/watch.log"
+    # headline on file: extras in priority order. Each leg counts as
+    # done only on rc=0 (a timeout-killed sweep is a PARTIAL artifact:
+    # keep the jsonl as data but retry the leg next healthy window);
+    # completed legs never re-run.
+    if [ -z "$have_micro" ]; then
+      timeout 1500 python tools/microbench_fixpoint.py --scale 22 \
+        --chunk-log 23 --profile-dir "$out/xprof" \
+        >"$out/microbench.jsonl" 2>>"$out/watch.log"
+      rc=$?
+      echo "microbench rc=$rc" | tee -a "$out/watch.log"
+      [ "$rc" = 0 ] && [ -s "$out/microbench.jsonl" ] && have_micro=yes
+    fi
+    if [ -z "$have_tune" ]; then
+      timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
+        --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
+        --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
+        --overlap 0,1 \
+        >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
+      rc=$?
+      echo "tune rc=$rc" | tee -a "$out/watch.log"
+      [ "$rc" = 0 ] && [ -s "$out/tune22_post.jsonl" ] && have_tune=yes
+    fi
+    if [ -n "$have_micro" ] && [ -n "$have_tune" ]; then
+      echo "full capture complete (bench+microbench+tune)" \
+        | tee -a "$out/watch.log"
       rm -f "$flag"
       exit 0
     fi
@@ -72,5 +85,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   fi
   sleep "$interval"
 done
-echo "deadline reached"
+echo "deadline reached: bench=${have_bench:-no} micro=${have_micro:-no}" \
+     "tune=${have_tune:-no}"
+# exit 0 if the one critical artifact (the headline bench) landed
+[ -n "$have_bench" ] && exit 0
 exit 1
