@@ -27,7 +27,8 @@ class TpuBigVBackend(Partitioner):
 
     def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
                  jumps: int = 128, n_devices: int | None = None,
-                 lift_levels: int = 0, segment_rounds: int = 16):
+                 lift_levels: int = 0, segment_rounds: int = 16,
+                 hoist_bytes: int | None = None):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
         self.jumps = jumps
@@ -39,6 +40,10 @@ class TpuBigVBackend(Partitioner):
         # device execution the same way. 0 = auto depth.
         self.lift_levels = lift_levels
         self.segment_rounds = segment_rounds
+        # per-device HBM budget for the per-segment (stale) lifting
+        # stack; default 0 = per-round squaring — hoisting measured
+        # WORSE below the V-dominant regime (see BigVPipeline)
+        self.hoist_bytes = hoist_bytes
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -52,7 +57,8 @@ class TpuBigVBackend(Partitioner):
             cs = min(cs, max(1024, -(-m_cheap // mesh.devices.size)))
         pipe = BigVPipeline(n, cs, mesh, jumps=self.jumps,
                             lift_levels=self.lift_levels,
-                            segment_rounds=self.segment_rounds)
+                            segment_rounds=self.segment_rounds,
+                            hoist_bytes=self.hoist_bytes)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,

@@ -87,7 +87,8 @@ class BigVPipeline:
 
     def __init__(self, n: int, chunk_edges: int, mesh, jumps: int = 128,
                  max_rounds: int = 1 << 20, segment_rounds: int = 16,
-                 dedup_compact: bool = True, lift_levels: int = 0):
+                 dedup_compact: bool = True, lift_levels: int = 0,
+                 hoist_bytes: Optional[int] = None):
         d = mesh.devices.size
         self.n = n
         self.cs = chunk_edges
@@ -106,6 +107,26 @@ class BigVPipeline:
         # cover any ancestor chain in one round, like single-chip)
         self.lift_levels = lift_levels if lift_levels > 0 \
             else max(1, int(n).bit_length())
+        # hoisted-stack HBM budget per device (stale lifting tables,
+        # _make_fold_lift_hoisted): each hoisted level keeps one B-row
+        # int32 block alive for the whole segment. Default 0 = per-round
+        # squaring: MEASURED at RMAT-16/D=8 (tools/bigv_collectives.py),
+        # hoisting LOST — q_rounds 1.06M -> 2.1M, 1540 -> 2651 MB/device
+        # — because 16 rounds of stack staleness delay the live-set
+        # collapse at bulk width, while the squaring term it amortizes
+        # is only V words/round (small next to D*Q lookups when V << Q).
+        # The trade can only reverse in the V-dominant regime (B >> Q,
+        # the RMAT-30 class); enable there explicitly via hoist_bytes /
+        # SHEEP_BIGV_HOIST_BYTES and re-measure (BASELINE.md bigv).
+        # env is a fallback for the DEFAULT only; an explicit ctor value
+        # always wins (review finding: an exported experiment var must
+        # not silently override TpuBigVBackend(hoist_bytes=X))
+        import os as _os
+
+        self.hoist_bytes = hoist_bytes if hoist_bytes is not None \
+            else int(_os.environ.get("SHEEP_BIGV_HOIST_BYTES", "0"))
+        self.hoist_levels = min(self.lift_levels - 1,
+                                max(0, self.hoist_bytes // (4 * self.B)))
         self.procs = len({dev.process_index for dev in mesh.devices.flat})
         self.proc = jax.process_index() if self.procs > 1 else 0
         self.n_local = (sum(1 for dev in mesh.devices.flat
@@ -210,17 +231,19 @@ class BigVPipeline:
 
         seg_ = self.segment_rounds
 
-        def _make_fold(climb):
+        def _make_fold(climb, prepare=None):
             """Segment program factory: at most ``segment_rounds`` routed
             fixpoint rounds in one device execution; the psum'd live
             count is the collective continue signal, identical on every
             device/process, so the host loop segment boundaries stay in
             lockstep. Retire/displace semantics match the single-chip
             _pos_small_round_body with the table lookups routed; the ONE
-            varying piece is ``climb(P_l, cur, hi_) -> cur`` — built by
-            :func:`_make_fold_seg` (fixed jump count) or
+            varying piece is ``climb(ctx, P_l, cur, hi_) -> cur`` — built
+            by :func:`_make_fold_seg` (fixed jump count) or
             :func:`_make_fold_lift` (stream-descent lifting) so the two
-            kernels cannot drift apart."""
+            kernels cannot drift apart. ``prepare(P_local) -> ctx`` runs
+            ONCE per segment before the round loop (hoisted lifting
+            stacks); its outputs enter the while_loop as constants."""
 
             @partial(jax.jit,
                      in_shardings=(self.shard, act, act),
@@ -229,6 +252,7 @@ class BigVPipeline:
             def fold_seg_step(P_sh, lo_all, hi_all):
                 def f(P_local, lo_l, hi_l):
                     lo0, hi0 = lo_l[0], hi_l[0]
+                    ctx = prepare(P_local) if prepare is not None else None
 
                     def body(state):
                         lo_, hi_, P_l, _, rounds = state
@@ -241,7 +265,7 @@ class BigVPipeline:
                         # rest from the pluggable climb body
                         can0 = new < hi_
                         cur = jnp.where(can0, new, lo_)
-                        cur = climb(P_l, cur, hi_)
+                        cur = climb(ctx, P_l, cur, hi_)
                         became_loop = cur == hi_
                         climb_lo = jnp.where(became_loop, n_, cur)
                         climb_hi = jnp.where(became_loop, n_, hi_)
@@ -287,7 +311,7 @@ class BigVPipeline:
             latencies) nearly for free (measured: BASELINE.md bigv
             entry)."""
 
-            def climb(P_l, cur, hi_):
+            def climb(ctx, P_l, cur, hi_):
                 for _ in range(jumps_n - 1):
                     p_next = _lookup(P_l, cur)
                     cur = jnp.where(p_next < hi_, p_next, cur)
@@ -311,7 +335,7 @@ class BigVPipeline:
             earlier than hi, so each rewrite is sound and the unique
             fixpoint is unchanged."""
 
-            def climb(P_l, cur, hi_):
+            def climb(ctx, P_l, cur, hi_):
                 t = P_l
                 for j in range(levels_n):
                     cand = _lookup(t, cur)
@@ -321,6 +345,54 @@ class BigVPipeline:
                 return cur
 
             return _make_fold(climb)
+
+        def _make_fold_lift_hoisted(levels_n: int, hoist_n: int):
+            """:func:`_make_fold_lift` with the squared tables HOISTED
+            out of the round loop — the bigv port of the single-chip
+            stale-tables trick (ops/elim.py fold_segment_pos_hoisted).
+            The per-round climb above re-squares the table every round:
+            2*(levels-1) routed B-width collectives shipping ~V words
+            per device PER ROUND — the dominant V-term of the bulk
+            phase (BASELINE.md bigv entry: 'the remaining question at
+            RMAT-30 scale is the V-word squaring term'). Here the stack
+            of ``hoist_n`` squared tables is built ONCE per segment
+            (stale between rounds; level 0 = the live table stays
+            current), so the squaring traffic amortizes over
+            ``segment_rounds`` rounds. Sound for the same reason as the
+            single-chip variant: ancestor-ship is permanent, so a stale
+            jump lands on a genuine (possibly non-maximal) ancestor; the
+            fixpoint exit stays exact because the segment loop only
+            exits on live == 0 (all constraints retired), which is
+            table-freshness-independent. ``hoist_n`` < levels-1 caps the
+            stack's HBM at hoist_bytes (scale-30 tables cannot afford a
+            full log2(V) stack per device); shorter reach just means a
+            long cascade takes extra (cheap, stackless) rounds."""
+
+            def prepare(P_local):
+                stack = []
+                t = P_local
+                for _ in range(hoist_n):
+                    t = _lookup(t, t)   # routed squaring (width B)
+                    stack.append(t)
+                return tuple(stack)
+
+            def climb(stack, P_l, cur, hi_):
+                cand = _lookup(P_l, cur)        # level 0: CURRENT table
+                cur = jnp.where(cand < hi_, cand, cur)
+                for t in stack:                 # stale hoisted levels
+                    cand = _lookup(t, cur)
+                    cur = jnp.where(cand < hi_, cand, cur)
+                # reach beyond the byte-capped stack: keep squaring
+                # dynamically from the deepest hoisted table (per-round
+                # cost returns, but only for the levels past the cap)
+                t = stack[-1] if stack else P_l
+                for _ in range(hoist_n + 1, levels_n):
+                    t = _lookup(t, t)
+                    cand = _lookup(t, cur)
+                    cur = jnp.where(cand < hi_, cand, cur)
+                return cur
+
+            return _make_fold(climb, prepare=prepare)
 
         def _make_compact(to_size: int):
             """Dedup + pack each device's live (loP, hiP) actives into a
@@ -402,6 +474,7 @@ class BigVPipeline:
         self._make_fold_seg = _make_fold_seg
         self._fold_seg_cache: dict = {}
         self._make_fold_lift = _make_fold_lift
+        self._make_fold_lift_hoisted = _make_fold_lift_hoisted
         self._fold_lift_cache: dict = {}
 
     # compaction floor: the tail's collective bytes are ~ops x D x Q x
@@ -421,20 +494,30 @@ class BigVPipeline:
         """(collective ops, bytes received per device) for ONE fixpoint
         round at active width Q: _scatter_min = 2 all_gather +
         2 all_to_all at Q; a jump round adds (jumps-1) lookup pairs at
-        Q; a lift round adds ``lift_levels`` lookup pairs at Q plus
-        (lift_levels - 1) squaring pairs at the owned-rows width B.
-        Every collective ships (D, width) int32 — the D*Q-words trade
-        documented in the module docstring, now *measured* per chunk
-        (diagnostics) instead of only documented."""
+        Q; a lift round adds ``lift_levels`` lookup pairs at Q plus the
+        NON-hoisted squaring pairs at the owned-rows width B (the
+        ``hoist_levels`` hoisted squarings are paid once per SEGMENT —
+        :func:`_segment_cost`). Every collective ships (D, width) int32
+        — the D*Q-words trade documented in the module docstring, now
+        *measured* per chunk (diagnostics) instead of only documented."""
         d = self.n_devices
         if lift:
-            L = self.lift_levels
-            ops = 4 + 2 * L + 2 * (L - 1)
-            words = d * (4 * q + 2 * L * q + 2 * (L - 1) * self.B)
+            L, K = self.lift_levels, self.hoist_levels
+            ops = 4 + 2 * L + 2 * (L - 1 - K)
+            words = d * (4 * q + 2 * L * q + 2 * (L - 1 - K) * self.B)
         else:
             ops = 4 + 2 * (jumps - 1)
             words = d * ops * q
         return ops, 4 * words
+
+    def _segment_cost(self, lift: bool):
+        """(ops, bytes/device) paid once per fold CALL: the hoisted
+        lifting stack is built per segment, 2 routed collectives per
+        hoisted level at width B."""
+        if not lift or not self.hoist_levels:
+            return 0, 0
+        K = self.hoist_levels
+        return 2 * K, 4 * self.n_devices * 2 * K * self.B
 
     def build_step(self, P_sh, pos_sh, batch_dev, stats=None):
         """Fold one sharded batch into the distributed forest via
@@ -462,9 +545,13 @@ class BigVPipeline:
             # words/round); tail: many-jump rounds (no V-term at all)
             lift = size > self.TAIL_Q
             if lift:
-                fold = self._fold_lift_cache.get(self.lift_levels)
+                key = (self.lift_levels, self.hoist_levels)
+                fold = self._fold_lift_cache.get(key)
                 if fold is None:
-                    fold = self._fold_lift_cache[self.lift_levels] = \
+                    fold = self._fold_lift_cache[key] = \
+                        self._make_fold_lift_hoisted(
+                            self.lift_levels, self.hoist_levels) \
+                        if self.hoist_levels else \
                         self._make_fold_lift(self.lift_levels)
                 jumps = 0
             else:
@@ -477,8 +564,9 @@ class BigVPipeline:
             r = int(r)
             total += r
             ops, byts = self._round_cost(size, jumps, lift)
-            stats["collective_ops"] += ops * r
-            stats["collective_bytes"] += byts * r
+            seg_ops, seg_bytes = self._segment_cost(lift)
+            stats["collective_ops"] += ops * r + seg_ops
+            stats["collective_bytes"] += byts * r + seg_bytes
             stats["q_rounds"] = stats.get("q_rounds", 0) + size * r
             if int(live) == 0 or total >= self.max_rounds:
                 return P_sh, total

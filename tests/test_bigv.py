@@ -149,3 +149,27 @@ def test_bigv_lift_bulk_and_compaction_paths():
     st = out["build_stats"]
     assert st.get("compactions", 0) >= 1, st
     assert st.get("collective_bytes", 0) > 0
+
+
+def test_bigv_hoisted_lifting_ab_identical():
+    """The per-segment (stale) lifting stack must not change the forest:
+    hoist_bytes=0 (per-round squaring, the round-2 behavior) vs the
+    default hoisted stack, same graph, bulk-regime chunk width. Also
+    pins the byte-cap arithmetic: the stack never exceeds the budget
+    and never exceeds lift_levels - 1."""
+    n = 1 << 13
+    e = generators.rmat(13, 16, seed=5)
+    mesh = shards_mesh(8)
+    outs = {}
+    for hb in (0, 1 << 30):
+        pipe = BigVPipeline(n, len(e), mesh, hoist_bytes=hb)
+        assert pipe.hoist_levels == (0 if hb == 0 else pipe.lift_levels - 1)
+        assert pipe.hoist_levels * 4 * pipe.B <= max(hb, 0)
+        outs[hb] = pipe.run(EdgeStream.from_array(e, n_vertices=n), k=8)
+    np.testing.assert_array_equal(outs[0]["parent"], outs[1 << 30]["parent"])
+    np.testing.assert_array_equal(outs[0]["assignment"],
+                                  outs[1 << 30]["assignment"])
+    # a byte budget smaller than one table block disables hoisting
+    tiny = BigVPipeline(n, len(e), mesh, hoist_bytes=4 * 100)
+    assert 4 * 100 < 4 * tiny.B  # premise: budget < one block
+    assert tiny.hoist_levels == 0

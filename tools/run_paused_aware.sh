@@ -10,7 +10,9 @@ log=$1; shift
 flag=tools/out/CAPTURING
 setsid "$@" >"$log" 2>&1 &
 pid=$!
-pgid=$(ps -o pgid= -p "$pid" | tr -d ' ')
+# setsid makes the child its own process-group leader, so pgid == pid —
+# race-free, unlike reading ps before the exec has happened
+pgid=$pid
 stopped=0
 while kill -0 "$pid" 2>/dev/null; do
   if [ -e "$flag" ] && [ "$stopped" = 0 ]; then
