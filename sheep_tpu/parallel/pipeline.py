@@ -417,15 +417,22 @@ class ShardedPipeline:
                 return P_all
             live = int(max_live)
             if size > self.SMALL_SIZE and live <= size // 4:
-                new_size = elim_ops.pow2_at_least(2 * live,
-                                                  floor=self.SMALL_SIZE)
-                if new_size < size:
-                    fn = self._compact_cache.get(new_size)
-                    if fn is None:
-                        fn = self._compact_cache[new_size] = \
-                            self._make_compact(new_size)
-                    lo_all, hi_all = fn(lo_all, hi_all)
-                    size = new_size
+                lo_all, hi_all, size = self._compact_to(lo_all, hi_all,
+                                                        live, size)
+
+    def _compact_to(self, lo_all, hi_all, live: int, size: int):
+        """Compact (D, size) buffers to the cached power-of-2 program for
+        ``2 * live`` (no-op when that is not smaller). One home for the
+        capacity rule + program cache shared by the chunk fold and the
+        merge's pre-fold right-sizing."""
+        new_size = elim_ops.pow2_at_least(2 * live, floor=self.SMALL_SIZE)
+        if new_size >= size:
+            return lo_all, hi_all, size
+        fn = self._compact_cache.get(new_size)
+        if fn is None:
+            fn = self._compact_cache[new_size] = self._make_compact(new_size)
+        lo_all, hi_all = fn(lo_all, hi_all)
+        return lo_all, hi_all, new_size
 
     def build_step(self, P_all, batch_dev, pos):
         """Fold one sharded batch into the per-device forests."""
@@ -480,16 +487,11 @@ class ShardedPipeline:
             # full-width round to discover the live count, and skip the
             # chunk-oriented warm schedule (warm rounds earn their keep
             # on fresh C-width chunks, not on a boundary tail)
-            width = int(lo_all.shape[-1])
             live = int(self._live_count(lo_all))
             if live == 0:
                 continue
-            tgt = elim_ops.pow2_at_least(2 * live, floor=self.SMALL_SIZE)
-            if tgt < width:
-                cfn = self._compact_cache.get(tgt)
-                if cfn is None:
-                    cfn = self._compact_cache[tgt] = self._make_compact(tgt)
-                lo_all, hi_all = cfn(lo_all, hi_all)
+            lo_all, hi_all, _ = self._compact_to(
+                lo_all, hi_all, live, int(lo_all.shape[-1]))
             P_all = self._fold_actives(P_all, lo_all, hi_all,
                                        skip_warm=True)
         merged = self._extract_merged(P_all)
