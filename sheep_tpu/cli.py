@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-tail-overlap", dest="tail_overlap",
                    action="store_false",
                    help="serialize host tails (see --tail-overlap)")
+    p.add_argument("--lift-levels", type=int, default=None,
+                   help="binary-lifting depth of the fixpoint climb "
+                        "(0 = auto; tpu and tpu-bigv backends)")
+    p.add_argument("--jumps", type=int, default=None,
+                   help="tpu-bigv: single-step climbs per tail round")
+    p.add_argument("--hoist-bytes", type=int, default=None,
+                   help="tpu-bigv: per-device HBM budget for the "
+                        "per-segment stale lifting stack (0 = per-round "
+                        "squaring, the measured default; see BASELINE.md)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
     p.add_argument("--refine", type=int, default=0, metavar="N",
@@ -214,6 +223,18 @@ def main(argv=None) -> int:
             ctor["carry_tail"] = args.carry_tail
         if args.tail_overlap is not None:
             ctor["tail_overlap"] = args.tail_overlap
+        if args.lift_levels is not None:
+            if args.lift_levels < 0:
+                parser.error("--lift-levels must be >= 0")
+            ctor["lift_levels"] = args.lift_levels
+        if args.jumps is not None:
+            if args.jumps < 1:
+                parser.error("--jumps must be >= 1")
+            ctor["jumps"] = args.jumps
+        if args.hoist_bytes is not None:
+            if args.hoist_bytes < 0:
+                parser.error("--hoist-bytes must be >= 0")
+            ctor["hoist_bytes"] = args.hoist_bytes
         # keep only the options this backend's constructor names; warn
         # about the rest instead of silently changing the run (the
         # tuning knobs vary per backend; every registered backend's ctor
