@@ -172,7 +172,8 @@ class TpuBackend(Partitioner):
                  warm_schedule=None, cache_chunks: bool = True,
                  host_tail_threshold: int = -1,
                  carry_tail: Optional[bool] = None,
-                 tail_overlap: Optional[bool] = None):
+                 tail_overlap: Optional[bool] = None,
+                 stale_reuse: int = 1):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -215,6 +216,10 @@ class TpuBackend(Partitioner):
         # tests/test_tail_overlap.py). Default OFF pending the on-chip
         # sweep; mutually exclusive with carry_tail.
         self.tail_overlap = tail_overlap
+        # full segments per lifting-stack rebuild (1 = per-segment
+        # hoisting; K > 1 reuses the stack across K segments — see
+        # elim.py fold_segment_pos_stale; A/B axis in tune_fixpoint)
+        self.stale_reuse = stale_reuse
         if carry_tail and tail_overlap:
             raise ValueError("carry_tail and tail_overlap are mutually "
                              "exclusive tail strategies")
@@ -337,6 +342,7 @@ class TpuBackend(Partitioner):
                             lift_levels=self.lift_levels,
                             segment_rounds=self.segment_rounds,
                             host_tail_threshold=tail_at,
+                            stale_reuse=self.stale_reuse,
                             pos_host=pos_host_cache, stats=build_stats)
                         total_rounds += int(r)
 
@@ -352,6 +358,7 @@ class TpuBackend(Partitioner):
                         segment_rounds=self.segment_rounds,
                         warm_schedule=self.warm_schedule, stats=build_stats,
                         host_tail_threshold=tail_at,
+                        stale_reuse=self.stale_reuse,
                         carry=carry, carry_out=carry_mode or overlap)
                     if carry_mode:
                         P, rounds, carry = step
@@ -386,6 +393,7 @@ class TpuBackend(Partitioner):
                     lift_levels=self.lift_levels,
                     segment_rounds=self.segment_rounds,
                     host_tail_threshold=tail_at,
+                    stale_reuse=self.stale_reuse,
                     pos_host=pos_host_cache, stats=build_stats)
                 total_rounds += int(rounds)
             minp = P[pos]

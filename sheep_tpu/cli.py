@@ -73,6 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-tail-overlap", dest="tail_overlap",
                    action="store_false",
                    help="serialize host tails (see --tail-overlap)")
+    p.add_argument("--stale-reuse", type=int, default=None,
+                   help="tpu backend: full segments per lifting-stack "
+                        "rebuild (1 = per-segment hoisting; K > 1 reuses "
+                        "one stale stack across K segments)")
     p.add_argument("--lift-levels", type=int, default=None,
                    help="binary-lifting depth of the fixpoint climb "
                         "(0 = auto; tpu and tpu-bigv backends)")
@@ -223,6 +227,10 @@ def main(argv=None) -> int:
             ctor["carry_tail"] = args.carry_tail
         if args.tail_overlap is not None:
             ctor["tail_overlap"] = args.tail_overlap
+        if args.stale_reuse is not None:
+            if args.stale_reuse < 1:
+                parser.error("--stale-reuse must be >= 1")
+            ctor["stale_reuse"] = args.stale_reuse
         if args.lift_levels is not None:
             if args.lift_levels < 0:
                 parser.error("--lift-levels must be >= 0")

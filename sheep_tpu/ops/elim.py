@@ -283,12 +283,46 @@ def fold_segment_pos_hoisted(
     reporting no change, and every segment starts with tables freshly
     built from its entry table — a first round that changes nothing ran
     with a fully-current view, so 'no change' is a genuine fixpoint."""
+    return fold_segment_pos_stale(P, loP, hiP,
+                                  build_lift_tables(P, n, lift_levels),
+                                  n, segment_rounds=segment_rounds)
+
+
+@partial(jax.jit, static_argnames=("n", "lift_levels"))
+def build_lift_tables(P: jax.Array, n: int, lift_levels: int = 0):
+    """The exact-descent lifting stack t_1..t_{L-1} as a standalone
+    program, for CROSS-SEGMENT reuse (``stale_reuse`` > 1 in the
+    adaptive driver): (L-1) x V squaring gathers once per rebuild
+    instead of once per segment."""
     lift_levels, _ = _resolve(n, lift_levels, "exact")
     t = P.astype(jnp.int32)
     tables = []
     for _ in range(lift_levels - 1):
         t = t[t]
         tables.append(t)
+    return tuple(tables)
+
+
+@partial(jax.jit, static_argnames=("n", "segment_rounds"))
+def fold_segment_pos_stale(
+    P: jax.Array,
+    loP: jax.Array,
+    hiP: jax.Array,
+    tables: tuple,
+    n: int,
+    segment_rounds: int = 32,
+):
+    """:func:`fold_segment_pos_hoisted` with the stack passed IN
+    (:func:`build_lift_tables`) so the driver can reuse it across
+    several segments. Soundness is the stronger form the stale round
+    body already satisfies: level 0 is always current (one-step
+    progress per live slot, so no livelock — a constraint whose level-0
+    jump is inadmissible retires by scatter-min within two rounds), and
+    a no-change segment is a genuine fixpoint REGARDLESS of stack
+    freshness, because slots only change toward progress and the table
+    only changes through a retiring slot (see _pos_round_body). Stale
+    jumps land on genuine ancestors (permanence), so the unique
+    fixpoint is unchanged; only round counts differ."""
     body = _pos_round_body_stale(n, tuple(tables))
     return _run_segment(body, P, loP, hiP, n, segment_rounds)
 
@@ -749,11 +783,19 @@ def _fold_adaptive_pos_impl(
     stats,
     carry_out: bool,
     stale_tables: bool = True,
+    stale_reuse: int = 1,
 ):
     """Shared adaptive-fixpoint loop; returns (P, total, carry) where
     ``carry`` is None (converged / host-finished) or a compacted
     (carry_loP, carry_hiP) of the still-live constraints (carry_out mode,
-    see :func:`fold_edges_adaptive_pos_carry`)."""
+    see :func:`fold_edges_adaptive_pos_carry`).
+
+    ``stale_reuse`` = full segments per lifting-stack rebuild (exact
+    descent with stale_tables only). 1 = the landed per-segment
+    hoisting; K > 1 reuses one stack across K segments
+    (:func:`fold_segment_pos_stale`), cutting the (L-1) x V squaring
+    gathers — the dominant V-term — by a further factor K at the price
+    of weaker (never unsound) jumps between rebuilds."""
     from sheep_tpu.core import native
 
     # the CLI validates R:L >= 1 at parse time; validate the Python API
@@ -778,6 +820,8 @@ def _fold_adaptive_pos_impl(
         # cheaper relative to the host pass, so callers may lower it
         host_tail_threshold = max(1 << 16, size // 8)
     warm = list(warm_schedule)
+    lift_stack = None
+    segs_on_stack = 0
     while True:
         if warm and size > small_size:
             wrounds, wlevels = warm.pop(0)
@@ -794,8 +838,22 @@ def _fold_adaptive_pos_impl(
                 # (seg-1)/seg of the L x V squaring gathers — the
                 # round's dominant V-term (same unique fixpoint; see
                 # fold_segment_pos_hoisted)
-                loP, hiP, P, sv = fold_segment_pos_hoisted(
-                    P, loP, hiP, n, lift_levels=rl, segment_rounds=seg)
+                if stale_reuse > 1:
+                    if lift_stack is None or segs_on_stack >= stale_reuse:
+                        # release the old stack BEFORE building the new
+                        # one: both alive at once would transiently
+                        # double the (EXACT_TABLE_BYTES-scale) footprint
+                        lift_stack = None
+                        lift_stack = build_lift_tables(P, n, rl)
+                        segs_on_stack = 0
+                        stats["stack_rebuilds"] = \
+                            stats.get("stack_rebuilds", 0) + 1
+                    loP, hiP, P, sv = fold_segment_pos_stale(
+                        P, loP, hiP, lift_stack, n, segment_rounds=seg)
+                    segs_on_stack += 1
+                else:
+                    loP, hiP, P, sv = fold_segment_pos_hoisted(
+                        P, loP, hiP, n, lift_levels=rl, segment_rounds=seg)
             else:
                 loP, hiP, P, sv = fold_segment_pos(
                     P, loP, hiP, n, lift_levels=lift_levels,
@@ -871,6 +929,7 @@ def fold_edges_adaptive_pos(
     pos_host=None,
     stats=None,
     stale_tables: bool = True,
+    stale_reuse: int = 1,
 ):
     """Host-driven fixpoint with active-set compaction and a host-finished
     tail — same unique forest as :func:`fold_edges`, far less work.
@@ -904,7 +963,7 @@ def fold_edges_adaptive_pos(
         P, loP, hiP, n, lift_levels, segment_rounds, descent, max_rounds,
         small_size, small_jumps, host_tail, host_tail_threshold,
         warm_schedule, pos_host, stats, carry_out=False,
-        stale_tables=stale_tables)
+        stale_tables=stale_tables, stale_reuse=stale_reuse)
     return P, total
 
 
@@ -934,11 +993,13 @@ def fold_edges_adaptive_pos_carry(
             opts.pop("warm_schedule", ()), opts.pop("pos_host", None),
             opts.pop("stats", None))
     stale = opts.pop("stale_tables", True)
+    reuse = opts.pop("stale_reuse", 1)
     if opts:  # reject typos BEFORE the (potentially minutes-long) fold
         raise TypeError(f"unknown options: {sorted(opts)}")
     P, total, carry = _fold_adaptive_pos_impl(P, loP, hiP, n, *args,
                                               carry_out=True,
-                                              stale_tables=stale)
+                                              stale_tables=stale,
+                                              stale_reuse=reuse)
     if carry is None:
         carry = (jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32))
     return P, total, carry

@@ -447,3 +447,45 @@ def test_tpu_backend_carry_modes_match_oracle(graph, carry_tail):
     np.testing.assert_array_equal(res.assignment, ref.assignment)
     assert res.edge_cut == ref.edge_cut
     assert res.comm_volume == ref.comm_volume
+
+
+@pytest.mark.parametrize("stale_reuse", [2, 4])
+def test_stale_reuse_matches_oracle(graph, stale_reuse):
+    """Cross-segment stale-stack reuse (stale_reuse > 1) must reach the
+    same unique fixpoint as the fresh/per-segment paths: level 0 stays
+    current, stale jumps land on genuine ancestors, and the no-change
+    exit is a fixpoint regardless of stack freshness
+    (elim.py fold_segment_pos_stale). Multi-chunk backend run so stack
+    rebuild cadence spans chunk boundaries and host tails interleave."""
+    e, n = graph
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    es = EdgeStream.from_array(e, n_vertices=n)
+    base = TpuBackend(chunk_edges=64, segment_rounds=3).partition(es, 4)
+    reused = TpuBackend(chunk_edges=64, segment_rounds=3,
+                        stale_reuse=stale_reuse).partition(es, 4)
+    np.testing.assert_array_equal(base.assignment, reused.assignment)
+    assert base.edge_cut == reused.edge_cut
+    assert base.comm_volume == reused.comm_volume
+
+
+def test_stale_reuse_rebuild_cadence():
+    """The stack rebuild counter fires every K full segments (stats
+    diagnostic), and the forest equals the fresh-table fold."""
+    e, n = _cases()["rmat"]
+    pos, order = _device_order(e, n)
+    pos_host = np.asarray(pos[:n])
+    loP, hiP = elim_ops.orient_edges_pos(
+        jnp.asarray(pad_chunk(e, len(e), n)), pos, n)
+    stats: dict = {}
+    P0 = jnp.full(n + 1, n, dtype=jnp.int32)
+    P_fresh, _ = elim_ops.fold_edges_adaptive_pos(
+        P0, loP, hiP, n, segment_rounds=2, small_size=8, host_tail=False,
+        stale_tables=False)
+    P_reuse, _ = elim_ops.fold_edges_adaptive_pos(
+        P0, loP, hiP, n, segment_rounds=2, small_size=8, host_tail=False,
+        stale_reuse=3, stats=stats)
+    np.testing.assert_array_equal(np.asarray(P_fresh), np.asarray(P_reuse))
+    full = stats.get("full_segments", 0)
+    assert full > 0, "config must exercise the full-segment stale path"
+    assert stats.get("stack_rebuilds", 0) == -(-full // 3)

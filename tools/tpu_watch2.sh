@@ -68,8 +68,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     if [ -z "$have_tune" ]; then
       timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
         --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
-        --lift-levels 0 --tail-divisors 2 --stale 1,0 --carry 0,1 \
-        --overlap 0,1 \
+        --lift-levels 0 --tail-divisors 2 --stale 1,0 --stale-reuse 1,4 \
+        --carry 0,1 --overlap 0,1 \
         >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
       rc=$?
       echo "tune rc=$rc" | tee -a "$out/watch.log"

@@ -64,6 +64,10 @@ def main():
                     help="comma list of 0/1: per-segment stale lifting "
                          "tables on full exact-descent segments "
                          "(BASELINE.md 'stale lifting tables' A/B)")
+    ap.add_argument("--stale-reuse", default="1",
+                    help="comma list of K >= 1: full segments per "
+                         "lifting-stack rebuild (elim.py "
+                         "fold_segment_pos_stale; only with --stale 1)")
     ap.add_argument("--carry", default="0",
                     help="comma list of 0/1: carry-over tails between "
                          "chunks instead of per-chunk host tails "
@@ -108,7 +112,7 @@ def main():
     pos_host = np.asarray(pos[:n])
 
     def run(chunk_log, warm_name, seg_rounds, lift, tail_div, stale, carry,
-            overlap):
+            overlap, reuse=1):
         cs = 1 << chunk_log
         # pre-pad + pre-upload all chunks so only fold time is measured
         dev_chunks = [jnp.asarray(pad_chunk(edges[i:i + cs], cs, n))
@@ -134,7 +138,7 @@ def main():
                     segment_rounds=seg_rounds,
                     warm_schedule=WARM_SCHEDULES[warm_name], stats=stats,
                     host_tail_threshold=(cs // tail_div if tail_div else 0),
-                    stale_tables=bool(stale),
+                    stale_tables=bool(stale), stale_reuse=reuse,
                     carry=carried, carry_out=bool(carry) or bool(overlap))
                 if carry:
                     P, rounds, carried = step
@@ -154,7 +158,8 @@ def main():
                 P, carried[0], carried[1], n, lift_levels=lift,
                 segment_rounds=seg_rounds,
                 host_tail_threshold=(cs // tail_div if tail_div else 0),
-                pos_host=pos_host, stats=stats, stale_tables=bool(stale))
+                pos_host=pos_host, stats=stats, stale_tables=bool(stale),
+                stale_reuse=reuse)
             total += int(rounds)
         np.asarray(P[:8])  # force completion (block_until_ready lies
         # through the tunnel; see tools/microbench_fixpoint.py)
@@ -168,19 +173,23 @@ def main():
     lifts = [int(x) for x in args.lift_levels.split(",")]
     tail_divs = [int(x) for x in args.tail_divisors.split(",")]
     stales = [int(x) for x in args.stale.split(",")]
+    reuses = [int(x) for x in args.stale_reuse.split(",")]
     carries = [int(x) for x in args.carry.split(",")]
     overlaps = [int(x) for x in args.overlap.split(",")]
 
     reference = None
     best = None
-    for cl, wn, sr, lv, td, st, ca, ov in itertools.product(
+    for cl, wn, sr, lv, td, st, ru, ca, ov in itertools.product(
             chunk_logs, warm_names, seg_rounds_list, lifts, tail_divs,
-            stales, carries, overlaps):
+            stales, reuses, carries, overlaps):
         if ca and ov:
             continue  # mutually exclusive tail strategies
+        if not st and ru > 1:
+            continue  # reuse cadence only exists on the stale path
         dts = []
         for rep in range(args.reps):
-            P, dt, total, stats = run(cl, wn, sr, lv, td, st, ca, ov)
+            P, dt, total, stats = run(cl, wn, sr, lv, td, st, ca, ov,
+                                      reuse=ru)
             dts.append(dt)
         dt = min(dts)
         P_np = np.asarray(P)
@@ -189,15 +198,15 @@ def main():
         else:
             assert np.array_equal(reference, P_np), \
                 (f"config warm={wn} seg={sr} L={lv} td={td} stale={st} "
-                 f"carry={ca} overlap={ov} changed the forest!")
+                 f"reuse={ru} carry={ca} overlap={ov} changed the forest!")
         line = {"chunk_log": cl, "warm": wn, "segment_rounds": sr,
                 "lift_levels": lv, "tail_div": td, "stale": st,
-                "carry": ca, "overlap": ov, "build_s": round(dt, 2),
-                "rounds": total,
+                "stale_reuse": ru, "carry": ca, "overlap": ov,
+                "build_s": round(dt, 2), "rounds": total,
                 "platform": plat, **{k: int(v) for k, v in stats.items()}}
         print(json.dumps(line), flush=True)
         log(f"chunk=2^{cl} warm={wn:5s} seg={sr} L={lv} td={td} st={st} "
-            f"ca={ca} ov={ov}: {dt:7.2f}s rounds={total} {stats}")
+            f"ru={ru} ca={ca} ov={ov}: {dt:7.2f}s rounds={total} {stats}")
         if best is None or dt < best[0]:
             best = (dt, line)
     if best is None:
