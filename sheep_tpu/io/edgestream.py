@@ -112,6 +112,11 @@ class EdgeStream:
         cheap = self.num_edges_cheap
         if cheap is not None:
             return cheap
+        if self.fmt == "text-gz":
+            # the >=4-bytes-per-line floor holds for the DECOMPRESSED
+            # text; on the compressed size it would not be an upper
+            # bound at all
+            return None
         if self.path is not None:
             # +1: the last line may lack its trailing newline
             return (os.path.getsize(self.path) + 1) // 4
@@ -178,6 +183,12 @@ class EdgeStream:
             yield from self._chunks_binary(chunk_edges, shard, num_shards, start_chunk)
         elif self.fmt == "csr":
             yield from self._chunks_csr(chunk_edges, shard, num_shards, start_chunk)
+        elif self.fmt == "text-gz":
+            # a gzip member is one sequential stream: no byte-range
+            # sharding, no seeks — every worker decompresses and keeps
+            # its round-robin chunks (fine for ingest-once workflows;
+            # recompress to .bin32/.csr for the multi-pass pipeline)
+            yield from self._chunks_text_gz(chunk_edges, shard, num_shards, start_chunk)
         elif byte_range:
             yield from self._chunks_text_span(chunk_edges, shard, num_shards, start_chunk)
         else:
@@ -261,6 +272,37 @@ class EdgeStream:
                 yield g.edge_slice(off, min(off + chunk_edges, total))
         finally:
             g.close()
+
+    def _chunks_text_gz(self, chunk_edges, shard, num_shards, start_chunk):
+        """Streamed gzip text: decompress 16 MB blocks, parse with the
+        shared block parser (native when built), regroup with the common
+        ownership semantics."""
+        import gzip
+
+        parse = self._block_parser()
+
+        def blocks():
+            tail = b""
+            with gzip.open(self.path, "rb") as f:
+                while True:
+                    block = f.read(1 << 24)
+                    data = tail + block
+                    if not data:
+                        return
+                    if block:
+                        edges, consumed = parse(data)
+                        tail = data[consumed:]
+                    else:  # final partial line (no trailing newline)
+                        edges, _ = parse(data + b"\n")
+                        tail = b""
+                    if len(edges):
+                        yield edges
+                    if not block:
+                        return
+
+        yield from self._regroup(
+            blocks(), chunk_edges,
+            lambda idx: self._owns(idx, shard, num_shards, start_chunk))
 
     def _chunks_text(self, chunk_edges, shard, num_shards, start_chunk):
         try:

@@ -28,7 +28,15 @@ CSR_EXTS = (".csr",)
 
 
 def detect_format(path: str) -> str:
-    ext = os.path.splitext(path)[1].lower()
+    base, ext = os.path.splitext(path)
+    ext = ext.lower()
+    if ext == ".gz":
+        inner = os.path.splitext(base)[1].lower()
+        if inner in TEXT_EXTS:
+            return "text-gz"  # how SNAP distributes graphs
+        raise ValueError(
+            f"gzip is supported for text edge lists only, not {inner!r} "
+            f"({path!r}); decompress binary formats first")
     if ext in TEXT_EXTS:
         return "text"
     if ext in BIN32_EXTS:
@@ -58,10 +66,19 @@ def parse_text_line(line: str):
         return None
 
 
+def _open_text(path: str, mode: str):
+    if path.lower().endswith(".gz"):
+        import gzip
+
+        return gzip.open(path, mode + "t")
+    return open(path, mode)
+
+
 def read_text_edges(path: str) -> np.ndarray:
-    """Read a SNAP-style text edge list into an (E, 2) int64 array."""
+    """Read a SNAP-style text edge list (plain or gzip) into an (E, 2)
+    int64 array."""
     rows = []
-    with open(path, "r") as f:
+    with _open_text(path, "r") as f:
         for line in f:
             pair = parse_text_line(line)
             if pair is not None:
@@ -72,7 +89,7 @@ def read_text_edges(path: str) -> np.ndarray:
 
 
 def write_text_edges(path: str, edges: np.ndarray) -> None:
-    with open(path, "w") as f:
+    with _open_text(path, "w") as f:
         for u, v in np.asarray(edges, dtype=np.int64):
             f.write(f"{u} {v}\n")
 
@@ -99,7 +116,7 @@ def read_edges(path: str, fmt: str | None = None) -> np.ndarray:
     """Materialize the full edge list (small graphs / tests only — the
     streaming path is :class:`sheep_tpu.io.edgestream.EdgeStream`)."""
     fmt = fmt or detect_format(path)
-    if fmt == "text":
+    if fmt in ("text", "text-gz"):
         return read_text_edges(path)
     if fmt == "bin32":
         return read_binary_edges(path, np.dtype("<u4"))
@@ -110,7 +127,7 @@ def read_edges(path: str, fmt: str | None = None) -> np.ndarray:
 
 def write_edges(path: str, edges: np.ndarray, fmt: str | None = None) -> None:
     fmt = fmt or detect_format(path)
-    if fmt == "text":
+    if fmt in ("text", "text-gz"):
         write_text_edges(path, edges)
     elif fmt == "bin32":
         write_binary_edges(path, edges, np.dtype("<u4"))
