@@ -279,29 +279,10 @@ class EdgeStream:
         ownership semantics."""
         import gzip
 
-        parse = self._block_parser()
-
-        def blocks():
-            tail = b""
-            with gzip.open(self.path, "rb") as f:
-                while True:
-                    block = f.read(1 << 24)
-                    data = tail + block
-                    if not data:
-                        return
-                    if block:
-                        edges, consumed = parse(data)
-                        tail = data[consumed:]
-                    else:  # final partial line (no trailing newline)
-                        edges, _ = parse(data + b"\n")
-                        tail = b""
-                    if len(edges):
-                        yield edges
-                    if not block:
-                        return
-
         yield from self._regroup(
-            blocks(), chunk_edges,
+            self._text_blocks(lambda: gzip.open(self.path, "rb"),
+                              self._block_parser()),
+            chunk_edges,
             lambda idx: self._owns(idx, shard, num_shards, start_chunk))
 
     def _chunks_text(self, chunk_edges, shard, num_shards, start_chunk):
@@ -316,29 +297,37 @@ class EdgeStream:
             pass
         yield from self._chunks_text_python(chunk_edges, shard, num_shards, start_chunk)
 
+    @staticmethod
+    def _text_blocks(open_fn, parse):
+        """Block-wise text parse shared by the plain and gzip paths: one
+        copy of the subtle partial-line boundary handling (tail carry,
+        consumed offset, EOF-without-trailing-newline). ``open_fn()``
+        must return a binary file-like; ``parse(bytes)`` -> (edges,
+        consumed) is the shared block-parser contract."""
+        tail = b""
+        with open_fn() as f:
+            while True:
+                block = f.read(1 << 24)
+                data = tail + block
+                if not data:
+                    return
+                if block:
+                    edges, consumed = parse(data)
+                    tail = data[consumed:]
+                else:  # final partial line (no trailing newline)
+                    edges, _ = parse(data + b"\n")
+                    tail = b""
+                yield edges
+                if not block:
+                    return
+
     def _chunks_text_native(self, native, chunk_edges, shard, num_shards, start_chunk):
         """Block-wise parse via the C parser (~10x the Python loop). Malformed
         lines are skipped — the same policy as the Python path."""
-        def blocks():
-            tail = b""
-            with open(self.path, "rb") as f:
-                while True:
-                    block = f.read(1 << 24)
-                    data = tail + block
-                    if not data:
-                        return
-                    if block:
-                        edges, consumed = native.parse_text(data)
-                        tail = data[consumed:]
-                    else:  # final partial line (no trailing newline)
-                        edges, _ = native.parse_text(data + b"\n")
-                        tail = b""
-                    yield edges
-                    if not block:
-                        return
-
         yield from self._regroup(
-            blocks(), chunk_edges,
+            self._text_blocks(lambda: open(self.path, "rb"),
+                              native.parse_text),
+            chunk_edges,
             lambda idx: self._owns(idx, shard, num_shards, start_chunk))
 
     def _chunks_text_span(self, chunk_edges, shard, num_shards, start_chunk):

@@ -66,19 +66,39 @@ def parse_text_line(line: str):
         return None
 
 
-def _open_text(path: str, mode: str):
-    if path.lower().endswith(".gz"):
+from contextlib import contextmanager
+
+
+@contextmanager
+def _open_text(path: str, mode: str, gz: bool | None = None):
+    """``gz`` None = sniff the extension; an explicit caller ``fmt``
+    must override sniffing (as it does for the binary formats).
+
+    Writes go through GzipFile(fileobj=..., mtime=0): the module
+    contract is byte-identical round-trips, and gzip's header would
+    otherwise embed wall-clock time AND the basename (FNAME), making
+    identical content hash differently per path/moment."""
+    if gz is None:
+        gz = path.lower().endswith(".gz")
+    if gz:
         import gzip
+        import io
 
-        return gzip.open(path, mode + "t")
-    return open(path, mode)
+        with open(path, mode + "b") as raw, \
+                gzip.GzipFile(filename="", fileobj=raw, mode=mode + "b",
+                              mtime=0) as gzf, \
+                io.TextIOWrapper(gzf) as f:
+            yield f
+    else:
+        with open(path, mode) as f:
+            yield f
 
 
-def read_text_edges(path: str) -> np.ndarray:
+def read_text_edges(path: str, gz: bool | None = None) -> np.ndarray:
     """Read a SNAP-style text edge list (plain or gzip) into an (E, 2)
     int64 array."""
     rows = []
-    with _open_text(path, "r") as f:
+    with _open_text(path, "r", gz) as f:
         for line in f:
             pair = parse_text_line(line)
             if pair is not None:
@@ -88,8 +108,9 @@ def read_text_edges(path: str) -> np.ndarray:
     return np.asarray(rows, dtype=np.int64)
 
 
-def write_text_edges(path: str, edges: np.ndarray) -> None:
-    with _open_text(path, "w") as f:
+def write_text_edges(path: str, edges: np.ndarray,
+                     gz: bool | None = None) -> None:
+    with _open_text(path, "w", gz) as f:
         for u, v in np.asarray(edges, dtype=np.int64):
             f.write(f"{u} {v}\n")
 
@@ -117,7 +138,7 @@ def read_edges(path: str, fmt: str | None = None) -> np.ndarray:
     streaming path is :class:`sheep_tpu.io.edgestream.EdgeStream`)."""
     fmt = fmt or detect_format(path)
     if fmt in ("text", "text-gz"):
-        return read_text_edges(path)
+        return read_text_edges(path, gz=(fmt == "text-gz"))
     if fmt == "bin32":
         return read_binary_edges(path, np.dtype("<u4"))
     if fmt == "bin64":
@@ -128,7 +149,7 @@ def read_edges(path: str, fmt: str | None = None) -> np.ndarray:
 def write_edges(path: str, edges: np.ndarray, fmt: str | None = None) -> None:
     fmt = fmt or detect_format(path)
     if fmt in ("text", "text-gz"):
-        write_text_edges(path, edges)
+        write_text_edges(path, edges, gz=(fmt == "text-gz"))
     elif fmt == "bin32":
         write_binary_edges(path, edges, np.dtype("<u4"))
     elif fmt == "bin64":
