@@ -24,6 +24,97 @@ class Partitioner(abc.ABC):
     def partition(self, stream, k: int, **opts) -> PartitionResult:
         """Partition the graph in *stream* into *k* parts."""
 
+    def partition_multi(self, stream, ks, weights: str = "unit",
+                        comm_volume: bool = True, **opts):
+        """One PartitionResult per k in ``ks`` — SHEEP's headline reuse
+        property: the elimination tree is k-INDEPENDENT, so one
+        degrees+build pays for every part count [PAPER]. Backends that
+        honor ``keep_tree`` (pure/cpu/tpu) get extra k values for an
+        O(V) re-split plus one scoring stream pass each; backends that
+        don't fall back to independent full runs. Checkpoint/resume
+        stays a single-k feature (pass checkpointer to partition())."""
+        import sys
+        import time
+
+        import numpy as np
+
+        ks = [int(k) for k in ks]
+        if not ks:
+            raise ValueError("ks must be non-empty")
+        if opts.get("checkpointer") is not None:
+            raise ValueError("partition_multi does not checkpoint; "
+                             "run single-k partitions to checkpoint")
+        opts.pop("keep_tree", None)  # we set it; a caller copy would
+        # collide with the explicit kwarg below
+        first = self.partition(stream, ks[0], weights=weights,
+                               comm_volume=comm_volume, keep_tree=True,
+                               **opts)
+        out = [first]
+        if len(ks) == 1:
+            return out
+        tree = first.tree
+        if tree is None:  # backend doesn't expose its tree
+            print(f"note: backend {self.name!r} does not expose its "
+                  f"elimination tree; --k list runs {len(ks)} independent "
+                  f"full partitions instead of one shared build",
+                  file=sys.stderr)
+            out += [self.partition(stream, k, weights=weights,
+                                   comm_volume=comm_volume, **opts)
+                    for k in ks[1:]]
+            return out
+        from sheep_tpu.core import native, pure
+        from sheep_tpu.ops.split import tree_split_host
+
+        n = len(tree["parent"])
+        use_native = native.available()
+        w = tree["deg"].astype(np.float64) if weights == "degree" else None
+        cs = stream.clamp_chunk_edges(getattr(self, "chunk_edges", 1 << 22))
+        split_s = {}
+        assigns = {}
+        for k in ks[1:]:
+            t0 = time.perf_counter()
+            assigns[k] = tree_split_host(tree["parent"], tree["pos"], k,
+                                         weights=w,
+                                         alpha=getattr(self, "alpha", 1.0))
+            split_s[k] = time.perf_counter() - t0
+        # ONE stream pass scores every extra assignment (the pass, not
+        # the O(E) arithmetic, dominates on file/gz streams)
+        t0 = time.perf_counter()
+        cut = {k: 0 for k in ks[1:]}
+        total = 0
+        cv_parts = {k: [] for k in ks[1:]}
+        for chunk in stream.chunks(cs):
+            e = np.asarray(chunk, dtype=np.int64).reshape(-1, 2)
+            first_k = True
+            for k in ks[1:]:
+                a = assigns[k]
+                if use_native:
+                    c, tt = native.score_chunk(e, a, n)
+                else:
+                    c, tt, _, _ = pure.edge_cut_score(e, a, k,
+                                                      comm_volume=False)
+                cut[k] += int(c)
+                if first_k:
+                    total += int(tt)
+                    first_k = False
+                if comm_volume:
+                    cv_parts[k].append(
+                        native.cut_pairs(e, a, n, k) if use_native
+                        else pure.cut_pairs(e, a, k))
+        score_s = time.perf_counter() - t0
+        for k in ks[1:]:
+            cv = (int(len(np.unique(np.concatenate(cv_parts[k]))))
+                  if cv_parts[k] else 0) if comm_volume else None
+            out.append(PartitionResult(
+                assignment=assigns[k], k=k, edge_cut=cut[k],
+                total_edges=total, cut_ratio=cut[k] / max(total, 1),
+                balance=pure.part_balance(assigns[k], k, w),
+                comm_volume=cv,
+                phase_times={"split": split_s[k],
+                             "score": score_s / len(ks[1:])},
+                backend=self.name, tree=tree))
+        return out
+
     # backends advertise capabilities the CLI/driver can query
     supports_streaming: bool = True
     supports_multidevice: bool = False

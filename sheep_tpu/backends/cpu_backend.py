@@ -121,4 +121,6 @@ class CpuBackend(Partitioner):
             cut_ratio=cut / max(total, 1), balance=balance,
             comm_volume=cv if comm_volume else None,
             phase_times=t, backend=self.name,
+            tree={"parent": parent, "pos": pos, "deg": deg}
+            if opts.get("keep_tree") else None,
         )

@@ -80,4 +80,6 @@ class PureBackend(Partitioner):
             comm_volume=cv,
             phase_times=t,
             backend=self.name,
+            tree={"parent": parent, "pos": pos, "deg": deg}
+            if opts.get("keep_tree") else None,
         )

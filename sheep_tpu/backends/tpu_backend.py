@@ -453,4 +453,6 @@ class TpuBackend(Partitioner):
             phase_times=t, backend=self.name,
             diagnostics={"fixpoint_rounds": float(total_rounds),
                          **{k: float(v) for k, v in build_stats.items()}},
+            tree={"parent": np.asarray(parent), "pos": pos_host,
+                  "deg": deg_host} if opts.get("keep_tree") else None,
         )

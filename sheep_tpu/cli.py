@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -30,7 +31,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "binary), or a synthetic stream spec: "
                         "rmat-hash:SCALE[:EF[:SEED]] (device-generated "
                         "chunks on TPU backends) or rmat:SCALE[:EF[:SEED]]")
-    p.add_argument("--k", type=int, help="number of parts")
+    p.add_argument("--k", help="number of parts; a comma list (e.g. "
+                               "--k 8,64,256) splits ONE elimination-tree "
+                               "build for every k (the tree is "
+                               "k-independent), one result line each")
     p.add_argument("--backend", default=None,
                    help="execution backend (default: best available; see --list-backends)")
     p.add_argument("--output", default=None,
@@ -164,6 +168,18 @@ def main(argv=None) -> int:
         return 0
     if args.input is None or args.k is None:
         build_parser().error("--input and --k are required")
+    try:
+        ks = [int(x) for x in str(args.k).split(",") if x != ""]
+    except ValueError:
+        ks = []
+    if not ks or any(k < 1 for k in ks):
+        build_parser().error(f"--k must be a positive int or comma list "
+                             f"of them (got {args.k!r})")
+    if len(ks) > 1 and (args.checkpoint_dir or args.refine):
+        build_parser().error("--k lists do not combine with "
+                             "--checkpoint-dir or --refine; run those "
+                             "single-k")
+    args.k = ks[0]
     if args.resume and not args.checkpoint_dir:
         build_parser().error("--resume requires --checkpoint-dir")
     if args.carry_tail and args.tail_overlap:
@@ -285,9 +301,15 @@ def main(argv=None) -> int:
             profile.__enter__()
         try:
             try:
-                res = be.partition(es, args.k, weights=args.weights,
-                                   comm_volume=not args.no_comm_volume,
-                                   **ckpt_kw)
+                if len(ks) > 1:
+                    multi = be.partition_multi(
+                        es, ks, weights=args.weights,
+                        comm_volume=not args.no_comm_volume)
+                    res = multi[0]
+                else:
+                    res = be.partition(es, args.k, weights=args.weights,
+                                       comm_volume=not args.no_comm_volume,
+                                       **ckpt_kw)
             except UnsupportedGraphError as exc:
                 # documented envelope violations (e.g. >= 2^31 vertices on
                 # an int32-table TPU backend) reject cleanly, not as a
@@ -307,34 +329,54 @@ def main(argv=None) -> int:
         n = es.num_vertices
         m = res.total_edges
 
+    results = multi if len(ks) > 1 else [res]
+
+    def _out_path(k: int) -> str:
+        if len(ks) == 1:
+            return args.output
+        root, ext = os.path.splitext(args.output)
+        return f"{root}.k{k}{ext}"
+
     if args.output and is_main:
-        write_partition(args.output, res.assignment)
+        for r in results:
+            write_partition(_out_path(r.k), r.assignment)
 
     if args.metrics_out and is_main:
         from sheep_tpu.utils.metrics import MetricsWriter, emit_run_metrics
 
         with MetricsWriter(args.metrics_out) as mw:
-            emit_run_metrics(mw, res, n, wall, graph=args.input)
+            for r in results:
+                emit_run_metrics(mw, r, n, wall, graph=args.input)
 
-    summary = res.summary()
-    summary["wall_seconds"] = round(wall, 4)
-    summary["edges_per_sec"] = round(m / wall, 1) if wall > 0 else None
-    summary["n_vertices"] = n
     if not is_main:
         return 0
     if not args.json:
         print(f"graph: {args.input}  V={n:,}  E={m:,}")
-        print(f"backend: {res.backend}  k={res.k}")
+        print(f"backend: {res.backend}  k={','.join(str(k) for k in ks)}")
         for phase, secs in res.phase_times.items():
             print(f"  {phase:>16}: {secs:.3f}s")
-        print(f"edge cut:    {res.edge_cut:,}  ({100 * res.cut_ratio:.2f}%)")
-        print(f"balance:     {res.balance:.4f}")
-        if res.comm_volume is not None:
-            print(f"comm volume: {res.comm_volume:,}")
-        print(f"wall: {wall:.2f}s  ({summary['edges_per_sec']:,.0f} edges/s)")
-        if args.output:
-            print(f"partition map written to {args.output}")
-    print(json.dumps(summary))
+        for r in results:
+            print(f"k={r.k}: edge cut {r.edge_cut:,} "
+                  f"({100 * r.cut_ratio:.2f}%)  balance {r.balance:.4f}"
+                  + (f"  comm volume {r.comm_volume:,}"
+                     if r.comm_volume is not None else ""))
+            if args.output:
+                print(f"partition map written to {_out_path(r.k)}")
+        print(f"wall: {wall:.2f}s  "
+              f"({m / wall if wall > 0 else 0:,.0f} edges/s)")
+    # JSON result lines LAST, one per k — consumers parse the tail.
+    # Multi-k wall accounting: extra ks carry their MARGINAL cost (their
+    # split + scoring share), the first k the remainder — rows sum to
+    # the run wall instead of over-counting it len(ks) times.
+    marginal = {r.k: sum(r.phase_times.values()) for r in results[1:]}
+    for r in results:
+        summary = r.summary()
+        r_wall = marginal.get(r.k, wall - sum(marginal.values()))
+        summary["wall_seconds"] = round(r_wall, 4)
+        summary["edges_per_sec"] = round(m / r_wall, 1) if r_wall > 0 \
+            else None
+        summary["n_vertices"] = n
+        print(json.dumps(summary))
     return 0
 
 

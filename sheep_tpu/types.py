@@ -80,6 +80,11 @@ class PartitionResult:
     # non-time diagnostics (e.g. fixpoint round counts) — kept out of
     # phase_times so per-phase throughput math stays meaningful
     diagnostics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # the k-INDEPENDENT build state {parent, pos, deg}, attached when the
+    # caller passed keep_tree=True — what partition_multi re-splits for
+    # further k values without re-streaming degrees/build [PAPER: the
+    # elimination tree is reusable across part counts]
+    tree: Optional[Dict[str, np.ndarray]] = None
 
     def validate(self, n: int) -> None:
         a = self.assignment

@@ -1,0 +1,90 @@
+"""partition_multi: one elimination-tree build split for many k — the
+tree is k-independent [PAPER], so every result must equal the
+corresponding independent single-k run exactly."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.base import get_backend, list_backends
+from sheep_tpu.io import formats, generators
+from sheep_tpu.io.edgestream import EdgeStream
+
+KS = [2, 8, 5]
+
+
+def _stream():
+    return EdgeStream.from_array(generators.rmat(10, 8, seed=6),
+                                 n_vertices=1 << 10)
+
+
+@pytest.mark.parametrize("backend", ["pure", "cpu", "tpu"])
+def test_multi_equals_independent(backend):
+    if backend not in list_backends():
+        pytest.skip(f"{backend} unavailable")
+    be = get_backend(backend, chunk_edges=1024)
+    multi = be.partition_multi(_stream(), KS)
+    assert [r.k for r in multi] == KS
+    for r in multi:
+        single = get_backend(backend, chunk_edges=1024).partition(
+            _stream(), r.k)
+        np.testing.assert_array_equal(r.assignment, single.assignment)
+        assert r.edge_cut == single.edge_cut
+        assert r.comm_volume == single.comm_volume
+        assert r.balance == pytest.approx(single.balance)
+
+
+def test_fallback_without_tree():
+    """A backend that ignores keep_tree still yields correct results via
+    independent runs (tpu-sharded doesn't expose its tree)."""
+    be = get_backend("tpu-sharded", chunk_edges=1024)
+    multi = be.partition_multi(_stream(), [2, 4])
+    for r, k in zip(multi, [2, 4]):
+        assert r.k == k
+        r.validate(1 << 10)
+
+
+def test_multi_rejects_checkpointer(tmp_path):
+    from sheep_tpu.utils.checkpoint import Checkpointer
+
+    be = get_backend("pure")
+    with pytest.raises(ValueError, match="checkpoint"):
+        be.partition_multi(_stream(), [2, 4],
+                           checkpointer=Checkpointer(str(tmp_path)))
+
+
+def test_cli_multi_k(tmp_path):
+    e = generators.karate_club()
+    src = str(tmp_path / "g.edges")
+    formats.write_edges(src, e)
+    out = str(tmp_path / "g.parts")
+    r = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", src,
+         "--k", "2,4", "--backend", "pure", "--output", out, "--json"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lines = [json.loads(x) for x in r.stdout.strip().splitlines()]
+    assert [d["k"] for d in lines] == [2, 4]
+    for k in (2, 4):
+        a = formats.read_partition(str(tmp_path / f"g.k{k}.parts"))
+        single = subprocess.run(
+            [sys.executable, "-m", "sheep_tpu.cli", "--input", src,
+             "--k", str(k), "--backend", "pure", "--json"],
+            capture_output=True, text=True)
+        d = json.loads(single.stdout.strip().splitlines()[-1])
+        got = next(x for x in lines if x["k"] == k)
+        assert got["edge_cut"] == d["edge_cut"]
+        assert len(a) == 34 and a.max() < k
+
+
+def test_cli_k_validation():
+    for bad in ("0", "2,,x", "-3", "2,0"):
+        r = subprocess.run(
+            [sys.executable, "-m", "sheep_tpu.cli", "--input", "x.edges",
+             "--k", bad, "--backend", "pure"],
+            capture_output=True, text=True)
+        assert r.returncode == 2, bad
+        assert "--k" in r.stderr
