@@ -76,6 +76,32 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
         return res
 
 
+def partition_multi(path, ks, backend=None, **opts):
+    """Like :func:`partition`, but one result per part count in ``ks``
+    from ONE elimination-tree build where the backend supports it (the
+    tree is k-independent — SHEEP's reuse property): extra k values cost
+    an O(V) re-split plus one shared scoring pass. Returns a list of
+    PartitionResult in ``ks`` order."""
+    import inspect
+
+    from sheep_tpu.backends.base import _REGISTRY
+    from sheep_tpu.io.edgestream import open_input
+
+    if backend is None:
+        avail = list_backends()
+        backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(f"unknown backend {backend!r}; available: "
+                         f"{', '.join(list_backends())}")
+    sig = inspect.signature(cls.__init__)
+    ctor_opts = {o: v for o, v in opts.items() if o in sig.parameters}
+    rest = {o: v for o, v in opts.items() if o not in ctor_opts}
+    be = cls(**ctor_opts)
+    with open_input(path) as es:
+        return be.partition_multi(es, ks, **rest)
+
+
 def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
     """Apply the post-pass refinement to a PartitionResult (shared by the
     library API and the CLI's --refine flag); rescores cut/balance (and

@@ -88,3 +88,15 @@ def test_cli_k_validation():
             capture_output=True, text=True)
         assert r.returncode == 2, bad
         assert "--k" in r.stderr
+
+
+def test_library_partition_multi(tmp_path):
+    import sheep_tpu
+
+    e = generators.karate_club()
+    src = str(tmp_path / "g.edges")
+    formats.write_edges(src, e)
+    res = sheep_tpu.partition_multi(src, [2, 4], backend="pure")
+    assert [r.k for r in res] == [2, 4]
+    single = sheep_tpu.partition(src, 4, backend="pure")
+    np.testing.assert_array_equal(res[1].assignment, single.assignment)
