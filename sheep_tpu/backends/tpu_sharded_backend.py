@@ -10,6 +10,8 @@ the ``tpu`` backend.
 
 from __future__ import annotations
 
+import numpy as np
+
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.parallel.mesh import shards_mesh
 from sheep_tpu.parallel.pipeline import ShardedPipeline
@@ -61,4 +63,6 @@ class TpuShardedBackend(Partitioner):
             phase_times=timings, backend=self.name,
             diagnostics={k_: (v if isinstance(v, (int, float)) else str(v))
                          for k_, v in out.get("merge_stats", {}).items()},
+            tree={"parent": np.asarray(out["parent"]), "pos": out["pos"],
+                  "deg": out["degrees"]} if opts.get("keep_tree") else None,
         )

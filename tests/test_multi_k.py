@@ -37,10 +37,22 @@ def test_multi_equals_independent(backend):
         assert r.balance == pytest.approx(single.balance)
 
 
+def test_sharded_multi_equals_independent():
+    """tpu-sharded exposes its merged tree too: multi-k must equal
+    independent sharded runs exactly."""
+    be = get_backend("tpu-sharded", chunk_edges=1024)
+    multi = be.partition_multi(_stream(), [2, 4])
+    for r in multi:
+        single = get_backend("tpu-sharded", chunk_edges=1024).partition(
+            _stream(), r.k)
+        np.testing.assert_array_equal(r.assignment, single.assignment)
+        assert r.edge_cut == single.edge_cut
+
+
 def test_fallback_without_tree():
     """A backend that ignores keep_tree still yields correct results via
-    independent runs (tpu-sharded doesn't expose its tree)."""
-    be = get_backend("tpu-sharded", chunk_edges=1024)
+    independent runs (tpu-bigv doesn't expose its tree)."""
+    be = get_backend("tpu-bigv", chunk_edges=1024)
     multi = be.partition_multi(_stream(), [2, 4])
     for r, k in zip(multi, [2, 4]):
         assert r.k == k
