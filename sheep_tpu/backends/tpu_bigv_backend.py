@@ -73,4 +73,6 @@ class TpuBigVBackend(Partitioner):
             diagnostics={"fixpoint_rounds": float(out["fixpoint_rounds"]),
                          **{k_: float(v) for k_, v in
                             out.get("build_stats", {}).items()}},
+            tree={"parent": out["parent"], "pos": out["pos"],
+                  "deg": out["degrees"]} if opts.get("keep_tree") else None,
         )

@@ -49,11 +49,34 @@ def test_sharded_multi_equals_independent():
         assert r.edge_cut == single.edge_cut
 
 
-def test_fallback_without_tree():
-    """A backend that ignores keep_tree still yields correct results via
-    independent runs (tpu-bigv doesn't expose its tree)."""
+def test_bigv_multi_equals_independent():
+    """tpu-bigv exposes its (host-materialized) tree too."""
     be = get_backend("tpu-bigv", chunk_edges=1024)
     multi = be.partition_multi(_stream(), [2, 4])
+    for r in multi:
+        single = get_backend("tpu-bigv", chunk_edges=1024).partition(
+            _stream(), r.k)
+        np.testing.assert_array_equal(r.assignment, single.assignment)
+        assert r.edge_cut == single.edge_cut
+
+
+def test_fallback_without_tree(capsys):
+    """A backend that ignores keep_tree still yields correct results via
+    independent runs, with a stderr note about the downgrade."""
+    from sheep_tpu.backends.base import Partitioner
+
+    class NoTree(Partitioner):
+        name = "no-tree-test"
+        chunk_edges = 1024
+
+        def partition(self, stream, k, **opts):
+            from sheep_tpu.core import pure
+
+            return pure.partition_arrays(stream.read_all(), k,
+                                         n=stream.num_vertices)
+
+    multi = NoTree().partition_multi(_stream(), [2, 4])
+    assert "independent full partitions" in capsys.readouterr().err
     for r, k in zip(multi, [2, 4]):
         assert r.k == k
         r.validate(1 << 10)
