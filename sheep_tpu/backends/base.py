@@ -62,13 +62,9 @@ class Partitioner(abc.ABC):
                                    comm_volume=comm_volume, **opts)
                     for k in ks[1:]]
             return out
-        from sheep_tpu.core import native, pure
         from sheep_tpu.ops.split import tree_split_host
 
-        n = len(tree["parent"])
-        use_native = native.available()
         w = tree["deg"].astype(np.float64) if weights == "degree" else None
-        cs = stream.clamp_chunk_edges(getattr(self, "chunk_edges", 1 << 22))
         split_s = {}
         assigns = {}
         for k in ks[1:]:
@@ -80,36 +76,17 @@ class Partitioner(abc.ABC):
         # ONE stream pass scores every extra assignment (the pass, not
         # the O(E) arithmetic, dominates on file/gz streams)
         t0 = time.perf_counter()
-        cut = {k: 0 for k in ks[1:]}
-        total = 0
-        cv_parts = {k: [] for k in ks[1:]}
-        for chunk in stream.chunks(cs):
-            e = np.asarray(chunk, dtype=np.int64).reshape(-1, 2)
-            first_k = True
-            for k in ks[1:]:
-                a = assigns[k]
-                if use_native:
-                    c, tt = native.score_chunk(e, a, n)
-                else:
-                    c, tt, _, _ = pure.edge_cut_score(e, a, k,
-                                                      comm_volume=False)
-                cut[k] += int(c)
-                if first_k:
-                    total += int(tt)
-                    first_k = False
-                if comm_volume:
-                    cv_parts[k].append(
-                        native.cut_pairs(e, a, n, k) if use_native
-                        else pure.cut_pairs(e, a, k))
+        scored = score_stream(
+            stream, assigns,
+            chunk_edges=getattr(self, "chunk_edges", 1 << 22),
+            comm_volume=comm_volume, weights=w)
         score_s = time.perf_counter() - t0
         for k in ks[1:]:
-            cv = (int(len(np.unique(np.concatenate(cv_parts[k]))))
-                  if cv_parts[k] else 0) if comm_volume else None
+            cut, total, balance, cv = scored[k]
             out.append(PartitionResult(
-                assignment=assigns[k], k=k, edge_cut=cut[k],
-                total_edges=total, cut_ratio=cut[k] / max(total, 1),
-                balance=pure.part_balance(assigns[k], k, w),
-                comm_volume=cv,
+                assignment=assigns[k], k=k, edge_cut=cut,
+                total_edges=total, cut_ratio=cut / max(total, 1),
+                balance=balance, comm_volume=cv,
                 phase_times={"split": split_s[k],
                              "score": score_s / len(ks[1:])},
                 backend=self.name, tree=tree))
@@ -118,6 +95,49 @@ class Partitioner(abc.ABC):
     # backends advertise capabilities the CLI/driver can query
     supports_streaming: bool = True
     supports_multidevice: bool = False
+
+
+def score_stream(stream, assignments, chunk_edges: int = 1 << 22,
+                 comm_volume: bool = True, weights=None):
+    """Score one or more existing assignments against the stream in ONE
+    pass: {k: (cut, total, balance, cv)}. ``assignments`` is a dict
+    {k: int array[V]}. The native scorer is used when built; this is the
+    single host-side scoring implementation shared by partition_multi
+    and the CLI's --score-only mode (the reference's standalone
+    edge_cut_score() use case)."""
+    import numpy as np
+
+    from sheep_tpu.core import native, pure
+
+    use_native = native.available()
+    n = stream.num_vertices
+    cs = stream.clamp_chunk_edges(chunk_edges)
+    cut = {k: 0 for k in assignments}
+    total = 0
+    cv_parts = {k: [] for k in assignments}
+    for chunk in stream.chunks(cs):
+        e = np.asarray(chunk, dtype=np.int64).reshape(-1, 2)
+        first = True
+        for k, a in assignments.items():
+            if use_native:
+                c, tt = native.score_chunk(e, a, n)
+            else:
+                c, tt, _, _ = pure.edge_cut_score(e, a, k,
+                                                  comm_volume=False)
+            cut[k] += int(c)
+            if first:
+                total += int(tt)
+                first = False
+            if comm_volume:
+                cv_parts[k].append(
+                    native.cut_pairs(e, a, n, k) if use_native
+                    else pure.cut_pairs(e, a, k))
+    out = {}
+    for k, a in assignments.items():
+        cv = (int(len(np.unique(np.concatenate(cv_parts[k]))))
+              if cv_parts[k] else 0) if comm_volume else None
+        out[k] = (cut[k], total, pure.part_balance(a, k, weights), cv)
+    return out
 
 
 def register(cls: Type[Partitioner]) -> Type[Partitioner]:

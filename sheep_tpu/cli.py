@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
                                "k-independent), one result line each")
     p.add_argument("--backend", default=None,
                    help="execution backend (default: best available; see --list-backends)")
+    p.add_argument("--score-only", default=None, metavar="PARTS",
+                   help="skip partitioning: score this existing partition "
+                        "map (.parts/.pbin) against --input — the "
+                        "standalone edge_cut_score() use case; --k is "
+                        "inferred from the map if omitted")
     p.add_argument("--output", default=None,
                    help="partition map output (.parts text or .pbin binary)")
     p.add_argument("--weights", choices=["unit", "degree"], default="unit",
@@ -147,6 +152,53 @@ def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
 
+    def _score_only(args):
+        """--score-only PARTS: evaluate an existing partition map against
+        the input — the reference's standalone edge_cut_score() path."""
+        import numpy as np
+
+        from sheep_tpu.backends.base import score_stream
+        from sheep_tpu.io.edgestream import open_input
+        from sheep_tpu.io.formats import read_partition
+
+        assignment = read_partition(args.score_only)
+        with open_input(args.input, n_vertices=args.num_vertices) as es:
+            n = es.num_vertices
+            if len(assignment) != n:
+                print(f"error: partition map has {len(assignment)} "
+                      f"entries, graph has {n} vertices", file=sys.stderr)
+                return 2
+            k = int(args.k) if args.k is not None \
+                else int(assignment.max()) + 1
+            if assignment.min() < 0 or assignment.max() >= k:
+                print(f"error: partition map assigns parts outside "
+                      f"[0, {k})", file=sys.stderr)
+                return 2
+            t0 = time.perf_counter()
+            w = None
+            if args.weights == "degree":
+                w = np.zeros(n, dtype=np.int64)
+                for c in es.chunks(args.chunk_edges or (1 << 22)):
+                    w += np.bincount(np.asarray(c, np.int64).ravel(),
+                                     minlength=n)[:n]
+            cut, total, balance, cv = score_stream(
+                es, {k: assignment},
+                chunk_edges=args.chunk_edges or (1 << 22),
+                comm_volume=not args.no_comm_volume, weights=w)[k]
+            wall = time.perf_counter() - t0
+        line = {"k": k, "edge_cut": cut, "total_edges": total,
+                "cut_ratio": cut / max(total, 1), "balance": balance,
+                "comm_volume": cv, "backend": "score-only",
+                "wall_seconds": round(wall, 4), "n_vertices": n}
+        if not args.json:
+            print(f"score-only: {args.score_only} vs {args.input}")
+            print(f"k={k}: edge cut {cut:,} "
+                  f"({100 * cut / max(total, 1):.2f}%)  "
+                  f"balance {balance:.4f}"
+                  + (f"  comm volume {cv:,}" if cv is not None else ""))
+        print(json.dumps(line))
+        return 0
+
     # Honor JAX_PLATFORMS even though a TPU platform plugin may pre-import
     # jax at interpreter startup (which makes the env var a no-op on its
     # own). Without this, `JAX_PLATFORMS=cpu python -m sheep_tpu.cli ...`
@@ -166,8 +218,10 @@ def main(argv=None) -> int:
     if args.list_backends:
         print(" ".join(list_backends()))
         return 0
-    if args.input is None or args.k is None:
+    if args.input is None or (args.k is None and not args.score_only):
         build_parser().error("--input and --k are required")
+    if args.score_only:
+        return _score_only(args)
     try:
         ks = [int(x) for x in str(args.k).split(",") if x != ""]
     except ValueError:

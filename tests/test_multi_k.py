@@ -135,3 +135,40 @@ def test_library_partition_multi(tmp_path):
     assert [r.k for r in res] == [2, 4]
     single = sheep_tpu.partition(src, 4, backend="pure")
     np.testing.assert_array_equal(res[1].assignment, single.assignment)
+
+
+def test_cli_score_only(tmp_path):
+    """--score-only reproduces the partitioner's own scores for its own
+    output map, and infers k when omitted."""
+    e = generators.karate_club()
+    src = str(tmp_path / "g.edges")
+    formats.write_edges(src, e)
+    out = str(tmp_path / "g.parts")
+    run = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", src, "--k", "2",
+         "--backend", "pure", "--output", out, "--json"],
+        capture_output=True, text=True)
+    want = json.loads(run.stdout.strip().splitlines()[-1])
+    score = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", src,
+         "--score-only", out, "--json"],
+        capture_output=True, text=True)
+    assert score.returncode == 0, score.stderr
+    got = json.loads(score.stdout.strip().splitlines()[-1])
+    assert got["backend"] == "score-only"
+    for f in ("k", "edge_cut", "total_edges", "comm_volume"):
+        assert got[f] == want[f], f
+    assert got["balance"] == pytest.approx(want["balance"])
+
+
+def test_cli_score_only_rejects_bad_map(tmp_path):
+    e = generators.karate_club()
+    src = str(tmp_path / "g.edges")
+    formats.write_edges(src, e)
+    bad = str(tmp_path / "bad.parts")
+    formats.write_partition(bad, np.zeros(7, dtype=np.int32))  # wrong V
+    r = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input", src,
+         "--score-only", bad, "--json"],
+        capture_output=True, text=True)
+    assert r.returncode == 2 and "entries" in r.stderr
