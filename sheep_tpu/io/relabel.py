@@ -1,0 +1,137 @@
+"""Compact sparse vertex ids (SNAP graphs often have gaps).
+
+The pipeline sizes every vertex-indexed table by ``max_id + 1``
+(SURVEY.md §2 #1's streaming contract), so a graph whose ids are sparse
+— e.g. crawl datasets keyed by hash — pays memory for ids that never
+occur. This tool renumbers vertices densely in TWO streaming passes and
+writes the inverse map so partitions translate back:
+
+    python -m sheep_tpu.io.relabel sparse.edges dense.bin32
+    # -> dense.bin32 (edges, ids in [0, V_used))
+    # -> dense.bin32.map (raw little-endian int64: new id -> old id)
+
+Memory is O(max_id * 5/8 + chunk): a bitmap of used ids (max_id/8
+bytes) plus a byte-granular uint32 rank prefix (max_id/2 bytes) — the
+dense id of old id i is ``prefix[i >> 3] + popcount(bits below i&7)``,
+so no O(max_id)-sized int64 translation table is ever materialized
+(~1.3 GB at the int32 id ceiling, vs ~16 GB for the naive table).
+
+The mapping preserves id ORDER (old ids ascending -> new ids ascending),
+so degree ties break identically before/after when the tie-break is by
+id. Partition results on the dense graph map back with
+``old_part[map[new]] = part[new]``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+# bits_below[byte, bit] = popcount of byte's bits strictly below `bit`
+_BITS_BELOW = np.array(
+    [[bin(b & ((1 << bit) - 1)).count("1") for bit in range(8)]
+     for b in range(256)], dtype=np.uint8)
+_POPCNT = np.array([bin(b).count("1") for b in range(256)], dtype=np.uint8)
+
+
+def used_id_bitmap(stream, chunk_edges: int = 1 << 22) -> np.ndarray:
+    """Pass 1: bitmap of ids that occur as either endpoint
+    (uint8[ceil((max_id+1)/8)]). Rejects negative ids loudly — Python's
+    negative indexing would otherwise corrupt the bitmap silently."""
+    n = stream.num_vertices  # max id + 1 (streaming pass if unknown)
+    bitmap = np.zeros((n + 7) // 8, dtype=np.uint8)
+    for chunk in stream.chunks(chunk_edges):
+        ids = np.asarray(chunk, dtype=np.int64).ravel()
+        if ids.size and int(ids.min()) < 0:
+            raise ValueError("negative vertex id in stream")
+        np.bitwise_or.at(bitmap, ids >> 3,
+                         np.left_shift(np.uint8(1),
+                                       (ids & 7).astype(np.uint8)))
+    return bitmap
+
+
+def _rank_prefix(bitmap: np.ndarray) -> tuple[np.ndarray, int]:
+    """(uint32 exclusive prefix of per-byte popcounts, total used)."""
+    counts = _POPCNT[bitmap]
+    total = int(counts.sum(dtype=np.int64))
+    if total >= 1 << 32:
+        raise ValueError("more than 2^32 - 1 used ids")
+    prefix = np.zeros(len(bitmap), dtype=np.uint32)
+    np.cumsum(counts[:-1], out=prefix[1:], dtype=np.uint32)
+    return prefix, total
+
+
+def relabel_to(stream, out_path: str, map_path: str | None = None,
+               chunk_edges: int = 1 << 22):
+    """Rewrite ``stream`` with dense ids; returns (v_used, v_old, edges).
+
+    ``out_path`` format by extension (.bin32/.bin64); the new->old map
+    lands at ``map_path`` (default ``out_path + '.map'``) as a raw
+    little-endian int64 array — NOT .pbin, whose int32 cells could not
+    hold old ids >= 2^31, the very graphs relabeling exists for."""
+    from sheep_tpu.io import formats
+
+    # fail on a bad destination BEFORE the full pass-1 stream scan
+    fmt = formats.detect_format(out_path)
+    if fmt not in ("bin32", "bin64"):
+        raise ValueError("relabel writes binary edge lists "
+                         "(.bin32/.bin64); got " + fmt)
+    bitmap = used_id_bitmap(stream, chunk_edges)
+    prefix, v_used = _rank_prefix(bitmap)
+    n_old = stream.num_vertices
+    if v_used > (1 << 32) and fmt == "bin32":
+        raise ValueError("more than 2^32 used ids; write .bin64")
+    dtype = np.dtype("<u4") if fmt == "bin32" else np.dtype("<u8")
+
+    def rank(ids: np.ndarray) -> np.ndarray:
+        byte, bit = ids >> 3, (ids & 7).astype(np.uint8)
+        return (prefix[byte].astype(np.int64)
+                + _BITS_BELOW[bitmap[byte], bit])
+
+    edges = 0
+    out_tmp, map_tmp = out_path + ".tmp", (map_path or out_path + ".map") \
+        + ".tmp"
+    with open(out_tmp, "wb") as f:
+        for chunk in stream.chunks(chunk_edges):
+            e = rank(np.asarray(chunk, dtype=np.int64))
+            np.ascontiguousarray(e, dtype=dtype).tofile(f)
+            edges += len(e)
+    # new -> old map, streamed in bitmap blocks so no O(v_used) array
+    # beyond the block is held
+    with open(map_tmp, "wb") as f:
+        block = 1 << 20  # bitmap bytes per block = 2^23 ids
+        for off in range(0, len(bitmap), block):
+            bits = np.unpackbits(bitmap[off:off + block],
+                                 bitorder="little").astype(bool)
+            old = np.flatnonzero(bits) + (off << 3)
+            old[old < n_old].astype("<i8").tofile(f)
+    # install both files only after both are complete; the map goes
+    # first so a crash between the two replaces leaves old edges + new
+    # map (detectably mismatched sizes) rather than new edges silently
+    # paired with a stale map
+    os.replace(map_tmp, map_path or out_path + ".map")
+    os.replace(out_tmp, out_path)
+    return v_used, n_old, edges
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3):
+        print("usage: python -m sheep_tpu.io.relabel INPUT OUTPUT.bin32 "
+              "[MAP]", file=sys.stderr)
+        return 2
+    from sheep_tpu.io.edgestream import open_input
+
+    stream = open_input(argv[0])
+    v_used, n_old, edges = relabel_to(
+        stream, argv[1], argv[2] if len(argv) == 3 else None)
+    print(f"wrote {argv[1]}: {edges} edges, {v_used} used ids "
+          f"(of {n_old} in the old id space, "
+          f"{100 * (1 - v_used / max(n_old, 1)):.1f}% gap)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

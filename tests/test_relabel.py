@@ -1,0 +1,92 @@
+"""Sparse-id relabeling: dense rewrite + inverse map, partition
+translates back, id order (and so degree-tie ordering) preserved."""
+
+import subprocess
+import sys
+
+import numpy as np
+
+from sheep_tpu.backends.base import get_backend
+from sheep_tpu.io import formats, generators, relabel
+from sheep_tpu.io.edgestream import EdgeStream, open_input
+
+
+def _sparse_graph():
+    # karate club with ids spread out by a sparse, order-preserving map
+    e = np.asarray(generators.karate_club())
+    old_ids = np.sort(np.random.default_rng(3).choice(
+        10_000, size=34, replace=False))
+    return old_ids[e], old_ids
+
+
+def test_relabel_roundtrip_and_partition(tmp_path):
+    sparse_e, old_ids = _sparse_graph()
+    src = str(tmp_path / "sparse.bin32")
+    formats.write_edges(src, sparse_e)
+    dense = str(tmp_path / "dense.bin32")
+    v_used, n_old, m = relabel.relabel_to(EdgeStream.open(src), dense)
+    assert (v_used, m) == (34, len(sparse_e))
+    assert n_old == int(sparse_e.max()) + 1
+    # inverse map: new -> old, ascending (order preserved)
+    mapping = np.fromfile(dense + ".map", dtype="<i8")
+    np.testing.assert_array_equal(mapping, old_ids)
+    # the dense graph is exactly karate club again (order-preserving
+    # relabel of an order-preserved spread is the identity)
+    back = EdgeStream.open(dense).read_all()
+    karate = generators.karate_club()
+    np.testing.assert_array_equal(back, karate)
+    # partition of the dense graph equals the karate partition
+    res = get_backend("pure").partition(open_input(dense), 2)
+    want = get_backend("pure").partition(
+        EdgeStream.from_array(karate), 2)
+    np.testing.assert_array_equal(res.assignment, want.assignment)
+
+
+def test_relabel_cli(tmp_path):
+    sparse_e, _ = _sparse_graph()
+    src = str(tmp_path / "s.bin32")
+    formats.write_edges(src, sparse_e)
+    dst = str(tmp_path / "d.bin32")
+    r = subprocess.run([sys.executable, "-m", "sheep_tpu.io.relabel",
+                        src, dst], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "34 used ids" in r.stdout
+    assert EdgeStream.open(dst).num_vertices == 34
+
+
+def test_relabel_rejects_text_output(tmp_path):
+    sparse_e, _ = _sparse_graph()
+    src = str(tmp_path / "s.bin32")
+    formats.write_edges(src, sparse_e)
+    try:
+        relabel.relabel_to(EdgeStream.open(src), str(tmp_path / "d.edges"))
+    except ValueError as e:
+        assert "binary" in str(e)
+    else:
+        raise AssertionError("text output should be rejected")
+
+
+def test_relabel_rejects_negative_ids(tmp_path):
+    import pytest
+
+    s = EdgeStream.from_array(np.array([[0, 5]]), n_vertices=6)
+    s._edges = np.array([[0, -1]])  # bypass validation upstream
+    with pytest.raises(ValueError, match="negative"):
+        relabel.relabel_to(s, str(tmp_path / "d.bin32"))
+
+
+def test_relabel_large_block_boundary(tmp_path):
+    # ids straddling the map-writer's bitmap block boundary (2^23 ids)
+    ids = np.array([0, 7, (1 << 23) - 1, 1 << 23, (1 << 23) + 9])
+    e = np.stack([ids, np.roll(ids, 1)], axis=1)
+    src = str(tmp_path / "s.bin64")
+    formats.write_edges(src, e)
+    dense = str(tmp_path / "d.bin32")
+    v_used, n_old, m = relabel.relabel_to(EdgeStream.open(src), dense)
+    assert v_used == 5 and m == 5
+    mapping = np.fromfile(dense + ".map", dtype="<i8")
+    np.testing.assert_array_equal(mapping, np.sort(ids))
+    back = EdgeStream.open(dense).read_all()
+    lookup = {o: n for n, o in enumerate(np.sort(ids))}
+    np.testing.assert_array_equal(
+        back, np.vectorize(lookup.get)(e))
