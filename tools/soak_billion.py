@@ -144,8 +144,12 @@ def orchestrate(args) -> dict:
     cmd = cli_cmd(data, args.k, ckpt_dir, args.chunk_edges, n, resume=True)
     print("resume run:", " ".join(cmd), flush=True)
     t0 = time.perf_counter()
+    # the resume leg runs the REST of the pipeline (most of the build +
+    # split + the whole scoring pass) — sharing the first leg's
+    # to-the-kill-point timeout killed a 3.76B-edge soak at build chunk
+    # 440/448 (r3b); give it its own, much larger budget
     out = subprocess.run(cmd, capture_output=True, text=True,
-                         timeout=args.timeout, cwd=REPO)
+                         timeout=args.resume_timeout, cwd=REPO)
     if out.returncode != 0:
         raise RuntimeError(f"resume failed rc={out.returncode}:\n"
                            f"{out.stdout}\n{out.stderr}")
@@ -165,7 +169,10 @@ def main():
     ap.add_argument("--chunk-edges", type=int, default=1 << 23)
     ap.add_argument("--kill-at-chunk", type=int, default=64,
                     help="SIGKILL once a build checkpoint >= this chunk exists")
-    ap.add_argument("--timeout", type=float, default=7200)
+    ap.add_argument("--timeout", type=float, default=7200,
+                    help="first leg: generate + run to the kill point")
+    ap.add_argument("--resume-timeout", type=float, default=86400,
+                    help="resume leg: the rest of the whole pipeline")
     ap.add_argument("--gen", choices=["hash", "pcg"], default="hash",
                     help="edge generator: counter-hash (native C loop, "
                          "fast) or the PCG replay generator")
