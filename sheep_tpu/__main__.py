@@ -1,0 +1,6 @@
+"""``python -m sheep_tpu`` == ``python -m sheep_tpu.cli``."""
+
+from sheep_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

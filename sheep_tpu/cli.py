@@ -120,6 +120,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--json", action="store_true", help="print only the JSON result line")
     p.add_argument("--list-backends", action="store_true", help="list backends and exit")
+    from sheep_tpu import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"sheep_tpu {__version__}")
     mh = p.add_argument_group("multi-host (the reference's mpirun equivalent)")
     mh.add_argument("--coordinator", default=None,
                     help="coordinator address host:port; launch one process "
