@@ -284,6 +284,10 @@ def main(argv=None) -> int:
             cs = args.chunk_edges or (1 << 22)
             if es.num_vertices > max_vertices_for(int(0.9 * hbm), cs):
                 backend = "tpu-bigv"
+                print(f"note: V={es.num_vertices:,} exceeds the "
+                      f"replicated-table ceiling for this device's HBM; "
+                      f"auto-selected the vertex-sharded tpu-bigv backend",
+                      file=sys.stderr)
 
         ctor = {"alpha": args.alpha}
         if args.chunk_edges:
