@@ -40,33 +40,9 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     extension beyond the reference's surface; the refined cut is
     guaranteed <= the unrefined cut (non-improving rounds roll back).
     """
-    import inspect
-
     from sheep_tpu.io.edgestream import open_input
 
-    if backend is None:
-        avail = list_backends()
-        backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
-
-    from sheep_tpu.backends.base import _REGISTRY
-
-    cls = _REGISTRY.get(backend)
-    if cls is None:
-        raise ValueError(
-            f"unknown backend {backend!r}; available: {', '.join(list_backends())}"
-        )
-    def named_params(fn, skip):
-        sig = inspect.signature(fn)
-        return {name for name, p in sig.parameters.items()
-                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)} - skip
-
-    ctor_params = named_params(cls.__init__, {"self"})
-    part_params = named_params(cls.partition, {"self", "stream", "k"})
-    unknown = set(opts) - ctor_params - part_params
-    if unknown:
-        raise TypeError(f"unknown option(s) for backend {backend!r}: {sorted(unknown)}")
-    ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
-    part_opts = {o: v for o, v in opts.items() if o in part_params and o not in ctor_params}
+    cls, ctor_opts, part_opts = _resolve_backend(backend, opts)
     be = cls(**ctor_opts)
     with open_input(path) as es:
         res = be.partition(es, k, **part_opts)
@@ -76,30 +52,60 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
         return res
 
 
+def _resolve_backend(backend, opts):
+    """Shared backend resolution for :func:`partition` /
+    :func:`partition_multi`: auto-select (tpu > cpu > pure) with a clear
+    error when none is registered, reject unknown backend names, and
+    split ``opts`` into constructor vs partition kwargs — raising
+    TypeError on options neither accepts instead of silently dropping
+    them (ADVICE r3)."""
+    import inspect
+
+    from sheep_tpu.backends.base import _REGISTRY
+
+    avail = list_backends()
+    if backend is None:
+        backend = next((b for b in ("tpu", "cpu", "pure") if b in avail),
+                       None)
+        if backend is None:
+            raise RuntimeError(
+                "no default backend registered (need one of tpu/cpu/pure); "
+                f"registered: {', '.join(avail) or 'none'}")
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(f"unknown backend {backend!r}; available: "
+                         f"{', '.join(avail)}")
+
+    def named_params(fn, skip):
+        sig = inspect.signature(fn)
+        return {name for name, p in sig.parameters.items()
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)} - skip
+
+    ctor_params = named_params(cls.__init__, {"self"})
+    part_params = named_params(cls.partition, {"self", "stream", "k"})
+    unknown = set(opts) - ctor_params - part_params
+    if unknown:
+        raise TypeError(f"unknown option(s) for backend {backend!r}: "
+                        f"{sorted(unknown)}")
+    ctor_opts = {o: v for o, v in opts.items() if o in ctor_params}
+    part_opts = {o: v for o, v in opts.items()
+                 if o in part_params and o not in ctor_params}
+    return cls, ctor_opts, part_opts
+
+
 def partition_multi(path, ks, backend=None, **opts):
     """Like :func:`partition`, but one result per part count in ``ks``
     from ONE elimination-tree build where the backend supports it (the
     tree is k-independent — SHEEP's reuse property): extra k values cost
     an O(V) re-split plus one shared scoring pass. Returns a list of
-    PartitionResult in ``ks`` order."""
-    import inspect
-
-    from sheep_tpu.backends.base import _REGISTRY
+    PartitionResult in ``ks`` order. Unknown options raise TypeError,
+    matching :func:`partition`."""
     from sheep_tpu.io.edgestream import open_input
 
-    if backend is None:
-        avail = list_backends()
-        backend = next(b for b in ("tpu", "cpu", "pure") if b in avail)
-    cls = _REGISTRY.get(backend)
-    if cls is None:
-        raise ValueError(f"unknown backend {backend!r}; available: "
-                         f"{', '.join(list_backends())}")
-    sig = inspect.signature(cls.__init__)
-    ctor_opts = {o: v for o, v in opts.items() if o in sig.parameters}
-    rest = {o: v for o, v in opts.items() if o not in ctor_opts}
+    cls, ctor_opts, part_opts = _resolve_backend(backend, opts)
     be = cls(**ctor_opts)
     with open_input(path) as es:
-        return be.partition_multi(es, ks, **rest)
+        return be.partition_multi(es, ks, **part_opts)
 
 
 def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):

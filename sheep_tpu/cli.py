@@ -47,7 +47,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weights", choices=["unit", "degree"], default="unit",
                    help="vertex weights for balance (default unit)")
     p.add_argument("--alpha", type=float, default=1.0,
-                   help="bag capacity factor for the tree split (default 1.0)")
+                   help="bag capacity factor for the tree split (default "
+                        "1.0; delivered balance is bounded by 1 + alpha "
+                        "+ k*max_weight/total — see --balance for the "
+                        "contract form)")
+    p.add_argument("--balance", type=float, default=None, metavar="BETA",
+                   help="guaranteed balance bound: deliver max part load "
+                        "<= BETA * (total/k) + max vertex weight (+ one "
+                        "weight unit on tiny parts, total/k < "
+                        "1/(BETA-1), where the bag capacity floors at a "
+                        "single unit), by running the split at alpha = "
+                        "BETA - 1 (measured cut cost ~1-2.5%% at BETA "
+                        "1.1-1.3, BASELINE.md balance table); BETA > 1, "
+                        "mutually exclusive with --alpha")
     p.add_argument("--segment-rounds", type=int, default=None,
                    help="fixpoint rounds per device execution (tpu "
                         "backend; default 2 — tuned on the v5e)")
@@ -225,6 +237,19 @@ def main(argv=None) -> int:
     if args.input is None or (args.k is None and not args.score_only):
         build_parser().error("--input and --k are required")
     if args.score_only:
+        if args.balance is not None:
+            build_parser().error("--balance has no effect with "
+                                 "--score-only (the split already "
+                                 "happened)")
+        if args.k is not None:
+            raw_k = args.k
+            try:
+                args.k = int(raw_k)
+            except ValueError:
+                args.k = 0
+            if args.k < 1:
+                build_parser().error(f"--score-only takes a single "
+                                     f"positive --k (got {raw_k!r})")
         return _score_only(args)
     try:
         ks = [int(x) for x in str(args.k).split(",") if x != ""]
@@ -233,6 +258,9 @@ def main(argv=None) -> int:
     if not ks or any(k < 1 for k in ks):
         build_parser().error(f"--k must be a positive int or comma list "
                              f"of them (got {args.k!r})")
+    # duplicate ks would alias the per-k output paths and the marginal
+    # wall accounting (both are keyed by k): dedupe preserving order
+    ks = list(dict.fromkeys(ks))
     if len(ks) > 1 and (args.checkpoint_dir or args.refine):
         build_parser().error("--k lists do not combine with "
                              "--checkpoint-dir or --refine; run those "
@@ -289,6 +317,18 @@ def main(argv=None) -> int:
                       f"auto-selected the vertex-sharded tpu-bigv backend",
                       file=sys.stderr)
 
+        if args.balance is not None:
+            if args.balance <= 1.0:
+                parser.error("--balance must be > 1 (it bounds max part "
+                             "load at BETA * total/k)")
+            if args.alpha != 1.0:
+                parser.error("--balance sets alpha = BETA - 1; do not "
+                             "also pass --alpha")
+            # LPT placement puts each flushed bag (<= alpha*total/k +
+            # max_w) on a part whose load is <= total/k, so alpha =
+            # BETA - 1 delivers max load <= BETA*total/k + max_w
+            # (tests/test_balance.py pins this bound)
+            args.alpha = min(args.balance - 1.0, 1.0)
         ctor = {"alpha": args.alpha}
         if args.chunk_edges:
             ctor["chunk_edges"] = args.chunk_edges

@@ -57,7 +57,12 @@ def _rank_prefix(bitmap: np.ndarray) -> tuple[np.ndarray, int]:
     counts = _POPCNT[bitmap]
     total = int(counts.sum(dtype=np.int64))
     if total >= 1 << 32:
-        raise ValueError("more than 2^32 - 1 used ids")
+        # the uint32 rank prefix caps relabeling at 2^32 - 1 USED ids
+        # regardless of output format (old ids may still exceed 2^32 —
+        # that is the sparse-id case relabeling exists for)
+        raise ValueError("more than 2^32 - 1 used ids; relabeling's rank "
+                         "prefix is uint32 (dense output ids would not "
+                         "fit .bin32 either)")
     prefix = np.zeros(len(bitmap), dtype=np.uint32)
     np.cumsum(counts[:-1], out=prefix[1:], dtype=np.uint32)
     return prefix, total
@@ -70,7 +75,12 @@ def relabel_to(stream, out_path: str, map_path: str | None = None,
     ``out_path`` format by extension (.bin32/.bin64); the new->old map
     lands at ``map_path`` (default ``out_path + '.map'``) as a raw
     little-endian int64 array — NOT .pbin, whose int32 cells could not
-    hold old ids >= 2^31, the very graphs relabeling exists for."""
+    hold old ids >= 2^31, the very graphs relabeling exists for.
+
+    Ceiling: the number of USED ids must stay below 2^32 (the rank
+    prefix is uint32) — for either output format; old ids themselves may
+    go up to 2^63 - 1. A graph with >= 2^32 distinct vertices is already
+    dense territory where relabeling buys nothing."""
     from sheep_tpu.io import formats
 
     # fail on a bad destination BEFORE the full pass-1 stream scan
@@ -79,10 +89,10 @@ def relabel_to(stream, out_path: str, map_path: str | None = None,
         raise ValueError("relabel writes binary edge lists "
                          "(.bin32/.bin64); got " + fmt)
     bitmap = used_id_bitmap(stream, chunk_edges)
+    # _rank_prefix enforces the v_used < 2^32 ceiling (uint32 prefix);
+    # dense output ids therefore always fit .bin32's u4 cells
     prefix, v_used = _rank_prefix(bitmap)
     n_old = stream.num_vertices
-    if v_used > (1 << 32) and fmt == "bin32":
-        raise ValueError("more than 2^32 used ids; write .bin64")
     dtype = np.dtype("<u4") if fmt == "bin32" else np.dtype("<u8")
 
     def rank(ids: np.ndarray) -> np.ndarray:

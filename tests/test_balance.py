@@ -1,0 +1,118 @@
+"""Balance-bound contract tests (SURVEY.md §4.3, VERDICT r3 item 4).
+
+The greedy split's proven envelope (see ``core/pure.py tree_split``):
+every flushed bag weighs at most ``cap + max_w`` (cap = max(alpha *
+total/k, 1); the mid-pack flush fires BEFORE an overflowing child is
+added, and the final flush adds only v itself on top of a bag < cap),
+and LPT placement puts it on a part whose load is <= total/k at that
+moment (the min is <= the mean). Hence
+
+    max part load <= total/k + cap + max_w
+    balance       <= 1 + max(alpha, k/total) + max_w * k / total
+
+This file pins that bound across the eval shapes for both split
+implementations, and pins the ``--balance BETA`` contract flag
+(alpha = BETA - 1) actually delivering <= BETA + max_w*k/total.
+"""
+
+import numpy as np
+import pytest
+
+import sheep_tpu
+from sheep_tpu.core import pure
+from sheep_tpu.io import formats, generators
+from sheep_tpu.types import ElimTree
+
+
+def build_tree(edges, n):
+    pos = pure.elimination_order(pure.degrees(edges, n))
+    return pure.build_elim_tree(edges, pos)
+
+
+def split_balance(edges, n, k, alpha, weights=None):
+    """Build tree + split via the pure spec; return (balance, bound)."""
+    tree = build_tree(edges, n)
+    w = weights if weights is not None else np.ones(n, dtype=np.int64)
+    a = pure.tree_split(tree, k, weights=weights, alpha=alpha)
+    assert a.min() >= 0 and a.max() < k          # every vertex assigned
+    total = float(w.sum())
+    loads = np.bincount(a, weights=w.astype(np.float64), minlength=k)
+    balance = loads.max() / (total / k)
+    bound = 1.0 + max(alpha, k / total) + float(w.max()) * k / total
+    return balance, bound
+
+
+GRAPHS = [
+    ("karate", lambda: (generators.karate_club(), 34)),
+    ("grid32", lambda: (generators.grid_graph(32, 32), 1024)),
+    ("star", lambda: (generators.star_graph(1000), 1000)),
+    ("rmat12", lambda: (generators.rmat(12, 8, seed=3), 1 << 12)),
+    ("sbm10", lambda: (generators.sbm_hash_range(10, 0, 8 << 10, 8, 0.05,
+                                                 seed=1), 1 << 10)),
+]
+
+
+@pytest.mark.parametrize("name,mk", GRAPHS)
+@pytest.mark.parametrize("k", [2, 8, 64])
+@pytest.mark.parametrize("alpha", [1.0, 0.5, 0.1])
+def test_unit_weight_balance_bound(name, mk, k, alpha):
+    edges, n = mk()
+    balance, bound = split_balance(edges, n, k, alpha)
+    assert balance <= bound + 1e-9, (name, k, alpha, balance, bound)
+
+
+@pytest.mark.parametrize("name,mk", GRAPHS)
+def test_degree_weight_balance_bound(name, mk):
+    edges, n = mk()
+    w = np.bincount(np.asarray(edges, np.int64).ravel(), minlength=n)[:n]
+    w = np.maximum(w, 1).astype(np.int64)
+    balance, bound = split_balance(edges, n, 8, 1.0, weights=w)
+    # the star's hub carries ~half the degree weight: the bound's max_w
+    # term is what keeps the contract honest there
+    assert balance <= bound + 1e-9, (name, balance, bound)
+
+
+def test_native_split_same_bound():
+    from sheep_tpu.core import native
+
+    if not native.available():
+        pytest.skip("native core not built")
+    edges, n = generators.rmat(12, 8, seed=7), 1 << 12
+    tree = build_tree(edges, n)
+    for alpha in (1.0, 0.25):
+        a = native.tree_split(tree.parent.astype(np.int64),
+                              tree.pos.astype(np.int64), 64, alpha=alpha)
+        loads = np.bincount(a, minlength=64)
+        balance = loads.max() / (n / 64)
+        assert balance <= 1.0 + max(alpha, 64 / n) + 64 / n + 1e-9
+
+
+def test_balance_flag_contract(tmp_path, capsys):
+    """--balance BETA delivers balance <= BETA (+ unit max_w slack)."""
+    import json
+
+    from sheep_tpu import cli
+
+    p = str(tmp_path / "r.edges")
+    formats.write_edges(p, generators.rmat(12, 8, seed=3))
+    for beta in (1.3, 1.1):
+        rc = cli.main(["--input", p, "--k", "64", "--backend",
+                       "cpu" if "cpu" in sheep_tpu.list_backends()
+                       else "pure", "--balance", str(beta), "--json",
+                       "--no-comm-volume"])
+        assert rc == 0
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["balance"] <= beta + 64 / (1 << 12) + 1e-9, \
+            (beta, line["balance"])
+
+
+def test_balance_flag_validation(tmp_path):
+    from sheep_tpu import cli
+
+    p = str(tmp_path / "k.edges")
+    formats.write_edges(p, generators.karate_club())
+    with pytest.raises(SystemExit):
+        cli.main(["--input", p, "--k", "2", "--balance", "0.9"])
+    with pytest.raises(SystemExit):
+        cli.main(["--input", p, "--k", "2", "--balance", "1.3",
+                  "--alpha", "0.5"])

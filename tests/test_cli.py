@@ -102,3 +102,34 @@ def test_partition_api_rejects_unknown_opts(karate_file):
     res = sheep_tpu.partition(karate_file, 2, backend="pure", chunk_edges=10,
                               comm_volume=False)
     assert res.comm_volume is None
+
+
+def test_partition_multi_rejects_unknown_opts(karate_file):
+    # ADVICE r3: partition_multi must validate options like partition()
+    import sheep_tpu
+
+    with pytest.raises(TypeError, match="unknown option"):
+        sheep_tpu.partition_multi(karate_file, [2, 4], backend="pure",
+                                  bogus=1)
+
+
+def test_duplicate_ks_deduped(karate_file, capsys):
+    # ADVICE r3: --k 2,2 must not alias output paths / wall accounting
+    rc = run_cli("--input", karate_file, "--k", "2,2", "--backend", "pure",
+                 "--json")
+    assert rc == 0
+    lines = [json.loads(x) for x in capsys.readouterr().out.strip()
+             .splitlines()]
+    assert [r["k"] for r in lines] == [2]
+
+
+def test_score_only_rejects_k_list(karate_file, tmp_path, capsys):
+    # ADVICE r3: a comma list with --score-only is a clean usage error,
+    # not a ValueError traceback
+    out = str(tmp_path / "karate.parts")
+    assert run_cli("--input", karate_file, "--k", "2", "--backend", "pure",
+                   "--output", out) == 0
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as e:
+        run_cli("--input", karate_file, "--k", "2,4", "--score-only", out)
+    assert e.value.code == 2
