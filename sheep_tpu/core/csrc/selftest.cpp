@@ -24,6 +24,10 @@ void sheep_tree_split(const i64*, const i64*, const double*, i64, i64, double,
 void sheep_score_chunk(const i64*, i64, const i32*, i64, i64*, i64*);
 i64 sheep_cut_pairs(const i64*, i64, const i32*, i64, i64, i64*);
 i64 sheep_parse_text(const char*, i64, i64*, i64, i64*);
+void sheep_rmat_hash_range(i64, i64, i64, const uint32_t*, const uint32_t*,
+                           uint32_t, uint32_t, uint32_t, i64*);
+void sheep_sbm_hash_range(i64, i64, const uint32_t*, const uint32_t*,
+                          uint32_t, i64, i64, i64*);
 i64 sheep_core_abi_version();
 }
 
@@ -114,6 +118,23 @@ int main() {
                             &consumed);
   CHECK(ne == 3, "parsed complete lines only");
   CHECK(out[0] == 1 && out[1] == 2 && out[4] == 9, "parsed values");
+
+  // counter-hash generators: sanitized pass over a 64-bit-boundary range
+  // (start chosen so elo wraps mid-range), ids must stay in range
+  {
+    std::vector<uint32_t> hk = {1u, 2u, 3u, 4u, 5u};
+    std::vector<uint32_t> hk2 = {9u, 8u, 7u, 6u, 5u};
+    i64 cnt = 256;
+    std::vector<i64> he(2 * cnt);
+    sheep_rmat_hash_range(5, (i64)0xFFFFFF80LL, cnt, hk.data(), hk2.data(),
+                          32768u, 32768u, 32768u, he.data());
+    for (i64 i = 0; i < 2 * cnt; ++i)
+      CHECK(he[i] >= 0 && he[i] < 32, "rmat hash ids in range");
+    sheep_sbm_hash_range((i64)0xFFFFFF80LL, cnt, hk.data(), hk2.data(),
+                         214748365u /* p_out=0.05 */, 8, 7, he.data());
+    for (i64 i = 0; i < 2 * cnt; ++i)
+      CHECK(he[i] >= 0 && he[i] < 1024, "sbm hash ids in range");
+  }
 
   std::puts("selftest OK");
   return 0;

@@ -384,6 +384,40 @@ void sheep_rmat_hash_range(i64 scale, i64 start, i64 count,
   }
 }
 
+// Counter-based planted partition (SBM), host twin of
+// io/generators.py _sbm_hash_uv — same fmix32-with-fold per field, five
+// per-field keys (decide, bu, bv, uoff, voff). Bit-identical to the
+// numpy/jnp bodies; the native loop exists because at-scale SBM quality
+// runs re-stream the graph once per refine round (tools/sbm_quality.py)
+// and host numpy hashing would dominate the measurement.
+void sheep_sbm_hash_range(i64 start, i64 count, const uint32_t* keys,
+                          const uint32_t* keys2, uint32_t t_out,
+                          i64 n_blocks, i64 block_bits, i64* out) {
+  uint32_t nb1 = (uint32_t)(n_blocks - 1);
+  uint32_t off_mask = (uint32_t)((1u << block_bits) - 1u);
+  for (i64 i = 0; i < count; ++i) {
+    uint64_t e = (uint64_t)(start + i);
+    uint32_t elo = (uint32_t)e, ehi = (uint32_t)(e >> 32);
+    uint32_t f[5];
+    for (int j = 0; j < 5; ++j) {
+      uint32_t h = elo ^ keys[j];
+      h ^= h >> 16;
+      h *= 0x85EBCA6Bu;
+      h ^= ehi ^ keys2[j];
+      h ^= h >> 13;
+      h *= 0xC2B2AE35u;
+      h ^= h >> 16;
+      f[j] = h;
+    }
+    uint32_t bu = f[1] & nb1;
+    uint32_t bvr = f[2] % nb1;  // [0, n_blocks-1)
+    uint32_t bv = bvr + (bvr >= bu ? 1u : 0u);
+    uint32_t b2 = (f[0] < t_out) ? bv : bu;
+    out[2 * i] = (i64)(((uint64_t)bu << block_bits) | (f[3] & off_mask));
+    out[2 * i + 1] = (i64)(((uint64_t)b2 << block_bits) | (f[4] & off_mask));
+  }
+}
+
 // ------------------------------------------------------------- utilities
 
 i64 sheep_core_abi_version() { return 1; }

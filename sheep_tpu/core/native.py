@@ -77,6 +77,12 @@ def load() -> ctypes.CDLL:
     lib.sheep_rmat_hash_range.argtypes = [c_i64, c_i64, c_i64, _u32p, _u32p,
                                           ctypes.c_uint32, ctypes.c_uint32,
                                           ctypes.c_uint32, _i64p]
+    if hasattr(lib, "sheep_sbm_hash_range"):
+        # round-4 symbol; a pre-round-4 .so (stale build) simply keeps
+        # the numpy path (generators.sbm_hash_range checks this hasattr)
+        lib.sheep_sbm_hash_range.argtypes = [c_i64, c_i64, _u32p, _u32p,
+                                             ctypes.c_uint32, c_i64, c_i64,
+                                             _i64p]
     _lib = lib
     return lib
 
@@ -193,3 +199,24 @@ def rmat_hash_range(scale: int, start: int, count: int,
         np.ascontiguousarray(keys2, dtype=np.uint32),
         int(thresholds[0]), int(thresholds[1]), int(thresholds[2]), out)
     return out
+
+
+def sbm_hash_range(start: int, count: int, keys, keys2, t_out: int,
+                   n_blocks: int, block_bits: int) -> np.ndarray:
+    """Native twin of generators._sbm_hash_uv over an edge-index range
+    (bit-identical; asserted by tests/test_sbm.py)."""
+    lib = load()
+    out = np.empty((count, 2), dtype=np.int64)
+    lib.sheep_sbm_hash_range(
+        start, count,
+        np.ascontiguousarray(keys, dtype=np.uint32),
+        np.ascontiguousarray(keys2, dtype=np.uint32),
+        int(t_out), int(n_blocks), int(block_bits), out)
+    return out
+
+
+def has_sbm_hash() -> bool:
+    try:
+        return hasattr(load(), "sheep_sbm_hash_range")
+    except Exception:
+        return False

@@ -445,6 +445,11 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
     - ``rmat:SCALE[:EF[:SEED]]`` — the PCG replay generator
       (:func:`~sheep_tpu.io.generators.rmat_stream`) behind a generator
       EdgeStream (matches the soak artifacts generated with it).
+    - ``sbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]]`` — counter-based
+      planted partition (:class:`~sheep_tpu.io.generators.SbmHashStream`):
+      BLOCKS power-of-two ground-truth communities, inter-block edge
+      fraction POUT (a float) — known-optimal-cut quality evaluation at
+      arbitrary scale.
 
     Anything else is treated as a path (format by extension). A
     user-supplied ``n_vertices`` must not contradict a synthetic spec's
@@ -452,6 +457,34 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
     """
     spec = os.fspath(spec)  # pathlib.Path inputs flow through unchanged
     kind, _, rest = spec.partition(":")
+    if kind == "sbm-hash" and rest:
+        from sheep_tpu.io import generators
+
+        parts = rest.split(":")
+        if not 3 <= len(parts) <= 5:
+            raise ValueError(
+                f"bad synthetic input spec {spec!r}; want "
+                f"sbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]]")
+        try:
+            scale, blocks = int(parts[0]), int(parts[1])
+            p_out = float(parts[2])
+            ef = int(parts[3]) if len(parts) > 3 else 16
+            seed = int(parts[4]) if len(parts) > 4 else 0
+        except ValueError:
+            raise ValueError(
+                f"bad synthetic input spec {spec!r}; want "
+                f"sbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]] (POUT a float, "
+                f"the rest integers)")
+        if not (1 <= scale <= 31) or ef < 1:
+            raise ValueError(f"bad synthetic input spec {spec!r}: "
+                             f"need 1 <= SCALE <= 31 and EF >= 1")
+        if n_vertices is not None and n_vertices != 1 << scale:
+            raise ValueError(
+                f"--num-vertices {n_vertices} contradicts {spec!r} "
+                f"(2**{scale} = {1 << scale} vertices)")
+        # blocks/p_out range checks live in SbmHashStream
+        return generators.SbmHashStream(scale, blocks, p_out,
+                                        edge_factor=ef, seed=seed)
     if kind in ("rmat-hash", "rmat") and rest:
         from sheep_tpu.io import generators
 

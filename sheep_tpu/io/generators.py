@@ -287,38 +287,23 @@ def rmat_hash_chunk_device(
     return _device_chunk_fn()(start_words, count, pad_to, keys, th, n)
 
 
-class RmatHashStream:
-    """An :class:`~sheep_tpu.io.edgestream.EdgeStream`-compatible synthetic
-    stream over the counter-based R-MAT (:func:`rmat_hash_range`), with a
-    DEVICE fast path: ``device_chunk(idx, cs, n)`` materializes the padded
-    chunk directly in accelerator memory (:func:`rmat_hash_chunk_device`),
-    bit-identical to the host chunks every other backend reads — so
-    cross-backend equality holds while the TPU path skips the
-    host->device upload entirely.
+class _CounterHashStream:
+    """Shared :class:`~sheep_tpu.io.edgestream.EdgeStream` surface for
+    replay-free counter-hash synthetic streams (R-MAT, SBM). Subclasses
+    set ``_n``/``_m`` and implement ``_range(start, count)`` (host chunk
+    as an int64 (count, 2) array); they may also provide the
+    ``device_chunk`` fast path the TPU backend probes for.
 
     Chunk access is random (any [start, start+count) range hashes
     independently), which also makes checkpoint resume and round-robin
     sharding exact rather than replay-based.
     """
 
-    def __init__(self, scale: int, edge_factor: int = 16, a: float = 0.57,
-                 b: float = 0.19, c: float = 0.19, seed: int = 0):
-        if not (1 <= scale <= 32):
-            # vertex bits accumulate in uint32 (shifts past bit 31 would
-            # silently drop); the device path is further gated to < 2^31
-            # ids by check_tpu_vertex_range at backend entry
-            raise ValueError(f"rmat-hash scale must be 1..32, got {scale}")
-        self.scale = int(scale)
-        self.edge_factor = int(edge_factor)
-        self.abc = (float(a), float(b), float(c))
-        self.seed = int(seed)
-        self._m = self.edge_factor << self.scale
-        self._n = 1 << self.scale
-        # EdgeStream API surface (checkpoint fingerprinting uses
-        # content_fingerprint below; there is no replay factory)
-        self._edges = None
-        self.path = None
-        self.fmt = "generator"
+    path = None
+    fmt = "generator"
+
+    def _range(self, start: int, count: int) -> np.ndarray:
+        raise NotImplementedError
 
     # -- EdgeStream surface -------------------------------------------------
     def __enter__(self):
@@ -351,16 +336,20 @@ class RmatHashStream:
                num_shards: int = 1, start_chunk: int = 0,
                byte_range: bool = False):
         """Host chunks by direct range hashing (no generator replay: chunk
-        i is rmat_hash_range(i*cs, cs), so skipping ahead is O(1))."""
+        i is _range(i*cs, cs), so skipping ahead is O(1))."""
         if not (0 <= shard < num_shards):
             raise ValueError(f"bad shard {shard}/{num_shards}")
         cs = int(chunk_edges)
         n_chunks = -(-self._m // cs) if self._m else 0
         for i in range(start_chunk, n_chunks):
             if (i % num_shards) == shard:
-                yield rmat_hash_range(self.scale, i * cs,
-                                      min(cs, self._m - i * cs),
-                                      *self.abc, seed=self.seed)
+                yield self._range(i * cs, min(cs, self._m - i * cs))
+
+    def read_all(self) -> np.ndarray:
+        return self._range(0, self._m)
+
+    def num_device_chunks(self, chunk_edges: int) -> int:
+        return -(-self._m // int(chunk_edges))
 
     def count_edges_in_span(self, shard: int, num_shards: int) -> int:
         """O(1) arithmetic (EdgeStream replays the generator to count;
@@ -383,25 +372,51 @@ class RmatHashStream:
             total -= n_chunks * cs - self._m  # short final chunk
         return total
 
-    def read_all(self) -> np.ndarray:
-        return rmat_hash_range(self.scale, 0, self._m, *self.abc,
-                               seed=self.seed)
-
-    # -- device fast path ---------------------------------------------------
-    def content_fingerprint(self) -> str:
+    def _fingerprint(self, tag: str) -> str:
         """Cheap stable identity for checkpoint fingerprints: the
         generator parameters plus a hashed 4096-edge prefix (the full
         first-chunk hash the generic generator fallback would pay costs
         a scale-deep pass over a default-size chunk per partition())."""
         import hashlib
 
-        sample = rmat_hash_range(self.scale, 0, min(4096, self._m),
-                                 *self.abc, seed=self.seed)
-        tag = (f"rmat_hash/s{self.scale}/ef{self.edge_factor}/"
-               f"{self.abc}/{self.seed}/")
+        sample = self._range(0, min(4096, self._m))
         return tag + hashlib.sha1(
             np.ascontiguousarray(sample).tobytes()).hexdigest()
 
+
+class RmatHashStream(_CounterHashStream):
+    """Counter-based R-MAT stream (:func:`rmat_hash_range`), with a
+    DEVICE fast path: ``device_chunk(idx, cs, n)`` materializes the padded
+    chunk directly in accelerator memory (:func:`rmat_hash_chunk_device`),
+    bit-identical to the host chunks every other backend reads — so
+    cross-backend equality holds while the TPU path skips the
+    host->device upload entirely.
+    """
+
+    def __init__(self, scale: int, edge_factor: int = 16, a: float = 0.57,
+                 b: float = 0.19, c: float = 0.19, seed: int = 0):
+        if not (1 <= scale <= 32):
+            # vertex bits accumulate in uint32 (shifts past bit 31 would
+            # silently drop); the device path is further gated to < 2^31
+            # ids by check_tpu_vertex_range at backend entry
+            raise ValueError(f"rmat-hash scale must be 1..32, got {scale}")
+        self.scale = int(scale)
+        self.edge_factor = int(edge_factor)
+        self.abc = (float(a), float(b), float(c))
+        self.seed = int(seed)
+        self._m = self.edge_factor << self.scale
+        self._n = 1 << self.scale
+
+    def _range(self, start: int, count: int) -> np.ndarray:
+        return rmat_hash_range(self.scale, start, count, *self.abc,
+                               seed=self.seed)
+
+    def content_fingerprint(self) -> str:
+        return self._fingerprint(f"rmat_hash/s{self.scale}/"
+                                 f"ef{self.edge_factor}/{self.abc}/"
+                                 f"{self.seed}/")
+
+    # -- device fast path ---------------------------------------------------
     def device_chunk(self, idx: int, chunk_edges: int, n: int):
         """Padded (chunk_edges, 2) int32 device chunk for global chunk
         ``idx`` — the TPU backend substitutes this for host pad+upload."""
@@ -411,5 +426,192 @@ class RmatHashStream:
         return rmat_hash_chunk_device(self.scale, start, count, cs, n,
                                       *self.abc, seed=self.seed)
 
-    def num_device_chunks(self, chunk_edges: int) -> int:
-        return -(-self._m // int(chunk_edges))
+
+# ---------------------------------------------------------------------------
+# Counter-based planted partition (SBM): ground-truth community structure
+# at arbitrary scale, replay-free like the R-MAT above. The real eval
+# graphs with community structure (LiveJournal/twitter/uk) are
+# unreachable in this environment, and R-MAT is an expander (cut ratios
+# 93-97% are a property of the GRAPH, not the partitioner) — this stream
+# is how "low communication volume" (SURVEY.md §1's defining output
+# property) gets at-scale evidence: k planted blocks, an exact
+# inter-block edge fraction p_out, and a known optimal cut to compare
+# the recovered cut against (VERDICT r3 item 5).
+#
+# Model (per edge counter i, five independent 32-bit uniforms):
+#   cross  = h0 < round(p_out * 2^32)
+#   bu     = h1 & (n_blocks - 1)              # blocks are power-of-two
+#   bv     = distinct-from-bu pick from h2    # only used when cross
+#   u      = bu * block_size + (h3 & (block_size - 1))
+#   v      = (cross ? bv : bu) * block_size + (h4 & (block_size - 1))
+# so a cross edge NEVER lands inside a block: the planted cut fraction
+# is exactly the Bernoulli(p_out) rate, and vertex ids are contiguous
+# within blocks (ground truth = v >> block_bits). Intra edges may be
+# self-loops with probability 2^-block_bits (harmless: never cut).
+# ---------------------------------------------------------------------------
+
+
+def _sbm_hash_keys(seed: int):
+    """Five per-field uint32 keys (decide, bu, bv, uoff, voff)."""
+    s = _mix32_int((seed & _M32) ^ 0x2545F491)
+    return [_mix32_int(s + 0x9E3779B9 * (f + 1)) for f in range(5)]
+
+
+def _sbm_hash_uv(xp, elo, ehi, keys, t_out, n_blocks, block_bits, dtype):
+    """Shared numpy/jnp body: edge-counter words -> (u, v). All uint32
+    wraparound arithmetic, so host and device bits agree exactly."""
+    fields = []
+    for key, key2 in zip(keys, _rmat_hash_keys2(keys)):
+        h = elo ^ xp.uint32(key)
+        h = h ^ (h >> xp.uint32(16))
+        h = h * xp.uint32(0x85EBCA6B)
+        h = h ^ (ehi ^ xp.uint32(key2))
+        h = h ^ (h >> xp.uint32(13))
+        h = h * xp.uint32(0xC2B2AE35)
+        h = h ^ (h >> xp.uint32(16))
+        fields.append(h)
+    h_cross, h_bu, h_bv, h_uo, h_vo = fields
+    cross = h_cross < xp.uint32(t_out)
+    bu = h_bu & xp.uint32(n_blocks - 1)
+    # distinct second block: draw from [0, n_blocks-1) and skip past bu
+    # (modulo bias <= (n_blocks-1)/2^32 — immaterial for any usable
+    # block count)
+    bvr = h_bv % xp.uint32(n_blocks - 1)
+    bv = bvr + (bvr >= bu).astype(xp.uint32)
+    b2 = xp.where(cross, bv, bu)
+    off_mask = xp.uint32((1 << block_bits) - 1)
+    u = (bu << xp.uint32(block_bits)) | (h_uo & off_mask)
+    v = (b2 << xp.uint32(block_bits)) | (h_vo & off_mask)
+    return u.astype(dtype), v.astype(dtype)
+
+
+def _sbm_t_out(p_out: float) -> int:
+    """p_out as a uint32 threshold (clamped; p_out=1.0 maps to 2^32-1,
+    i.e. 'all cross' short of one edge in 4 billion)."""
+    return min(_M32, max(0, round(float(p_out) * 4294967296.0)))
+
+
+def sbm_hash_range(scale: int, start: int, count: int, n_blocks: int,
+                   p_out: float, seed: int = 0) -> np.ndarray:
+    """Edges [start, start+count) of the counter-based planted-partition
+    stream, as a (count, 2) int64 array (host twin of the device path).
+
+    Large ranges take the native C loop when the core is built
+    (bit-identical, ~100x numpy — at-scale quality runs re-stream the
+    graph once per refine round); small ranges and toolchain-less hosts
+    use numpy."""
+    keys = _sbm_hash_keys(seed)
+    block_bits = scale - (n_blocks.bit_length() - 1)
+    if count >= 4096:
+        from sheep_tpu.core import native
+
+        if native.available() and native.has_sbm_hash():
+            return native.sbm_hash_range(
+                start, count, keys, _rmat_hash_keys2(keys),
+                _sbm_t_out(p_out), n_blocks, block_bits)
+    idx = start + np.arange(count, dtype=np.int64)
+    elo = (idx & _M32).astype(np.uint32)
+    ehi = (idx >> 32).astype(np.uint32)
+    u, v = _sbm_hash_uv(np, elo, ehi, keys, _sbm_t_out(p_out), n_blocks,
+                        block_bits, np.int64)
+    return np.stack([u, v], axis=1)
+
+
+_SBM_DEVICE_CHUNK_FN = None
+
+
+def _sbm_device_chunk_fn():
+    """Jitted device-chunk kernel singleton (same rationale as
+    :func:`_device_chunk_fn`: jit caches on the wrapper object)."""
+    global _SBM_DEVICE_CHUNK_FN
+    if _SBM_DEVICE_CHUNK_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+        def _chunk(start_words, count, pad_to, keys, t_out, n_blocks,
+                   block_bits, n):
+            lo0, hi0 = start_words
+            i = jnp.arange(pad_to, dtype=jnp.uint32)
+            elo = lo0 + i
+            ehi = hi0 + (elo < lo0).astype(jnp.uint32)  # 64-bit carry
+            u, v = _sbm_hash_uv(jnp, elo, ehi, list(keys), t_out,
+                                n_blocks, block_bits, jnp.int32)
+            e = jnp.stack([u, v], axis=1)
+            return jnp.where((i < jnp.uint32(count))[:, None], e,
+                             jnp.int32(n))
+
+        _SBM_DEVICE_CHUNK_FN = _chunk
+    return _SBM_DEVICE_CHUNK_FN
+
+
+class SbmHashStream(_CounterHashStream):
+    """Planted-partition (stochastic block model) counter-hash stream:
+    2**scale vertices in ``n_blocks`` equal contiguous blocks, each edge
+    inter-block with probability ``p_out``. Ground truth is
+    :meth:`ground_truth`; the planted cut ratio is exactly the Bernoulli
+    cross rate, so a partitioner that recovers the blocks at
+    k = n_blocks scores cut_ratio ~= p_out.
+
+    Device fast path like :class:`RmatHashStream` (bit-identical host
+    and device chunks).
+    """
+
+    def __init__(self, scale: int, n_blocks: int = 64,
+                 p_out: float = 0.05, edge_factor: int = 16,
+                 seed: int = 0):
+        if not (1 <= scale <= 31):
+            # ids must fit int32 on-device (no < 2^31 backend gate can
+            # widen a generator that emits 2^31 ids)
+            raise ValueError(f"sbm-hash scale must be 1..31, got {scale}")
+        nb = int(n_blocks)
+        if nb < 2 or nb & (nb - 1) or nb > (1 << scale):
+            raise ValueError(f"n_blocks must be a power of two in "
+                             f"[2, 2**scale], got {n_blocks}")
+        if not (0.0 <= p_out <= 1.0):
+            raise ValueError(f"p_out must be in [0, 1], got {p_out}")
+        self.scale = int(scale)
+        self.n_blocks = nb
+        self.block_bits = self.scale - (nb.bit_length() - 1)
+        self.p_out = float(p_out)
+        self.edge_factor = int(edge_factor)
+        self.seed = int(seed)
+        self._m = self.edge_factor << self.scale
+        self._n = 1 << self.scale
+
+    def _range(self, start: int, count: int) -> np.ndarray:
+        return sbm_hash_range(self.scale, start, count, self.n_blocks,
+                              self.p_out, seed=self.seed)
+
+    def content_fingerprint(self) -> str:
+        return self._fingerprint(
+            f"sbm_hash/s{self.scale}/b{self.n_blocks}/p{self.p_out}/"
+            f"ef{self.edge_factor}/{self.seed}/")
+
+    def ground_truth(self, k: int | None = None) -> np.ndarray:
+        """The planted assignment at ``k`` parts (default: one part per
+        block). ``n_blocks`` must be divisible by ``k``: consecutive
+        blocks group into a part, preserving the planted cut. O(V)
+        memory — ground truth is for scoring, not for streaming."""
+        k = self.n_blocks if k is None else int(k)
+        if k < 1 or self.n_blocks % k:
+            raise ValueError(f"k must divide n_blocks={self.n_blocks}, "
+                             f"got {k}")
+        per = self.n_blocks // k
+        blocks = np.arange(self._n, dtype=np.int64) >> self.block_bits
+        return (blocks // per).astype(np.int32)
+
+    def planted_cut_ratio(self) -> float:
+        """The exact expected cut ratio of the planted partition at
+        k = n_blocks (cross edges are inter-block by construction)."""
+        return _sbm_t_out(self.p_out) / 4294967296.0
+
+    # -- device fast path ---------------------------------------------------
+    def device_chunk(self, idx: int, chunk_edges: int, n: int):
+        cs = int(chunk_edges)
+        start = idx * cs
+        count = max(0, min(cs, self._m - start))
+        return _sbm_device_chunk_fn()(
+            (np.uint32(start & _M32), np.uint32(start >> 32)), count, cs,
+            tuple(_sbm_hash_keys(self.seed)), _sbm_t_out(self.p_out),
+            self.n_blocks, self.block_bits, n)

@@ -1,0 +1,143 @@
+"""Planted-partition (SBM) stream tests (VERDICT r3 item 5; SURVEY.md §1
+"low communication volume" / §4.5 quality evidence).
+
+The counter-hash SBM gives a KNOWN optimal cut at any scale: cross
+edges are inter-block by construction, so the planted assignment scores
+cut_ratio == (observed Bernoulli(p_out) rate) exactly. Quality evidence:
+the streaming pass alone does not recover blocks on a degree-flat SBM
+(it optimizes communication volume via degree/elimination structure —
+measured ~0.87 cut at k=8 where random is 0.875), and the refine
+post-pass (capacity-constrained label propagation) recovers the planted
+structure to near-optimal where block density supports it
+(BASELINE.md "SBM quality" table).
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import open_input
+
+
+def test_range_determinism_and_chunk_consistency():
+    s = generators.SbmHashStream(10, 8, 0.07, edge_factor=4, seed=9)
+    full = s.read_all()
+    assert full.shape == (4 << 10, 2)
+    again = np.concatenate(list(s.chunks(chunk_edges=1000)))
+    assert np.array_equal(full, again)
+    # random access: any range equals the slice of the full stream
+    assert np.array_equal(
+        generators.sbm_hash_range(10, 777, 500, 8, 0.07, seed=9),
+        full[777:1277])
+
+
+def test_ids_in_range_and_cross_rate():
+    s = generators.SbmHashStream(12, 16, 0.10, edge_factor=8, seed=3)
+    e = s.read_all()
+    assert e.min() >= 0 and e.max() < (1 << 12)
+    gt = s.ground_truth()
+    assert gt.shape == (1 << 12,) and gt.max() == 15
+    cross = (gt[e[:, 0]] != gt[e[:, 1]]).mean()
+    # 32768 edges: 5 sigma ~ 0.0083
+    assert abs(cross - 0.10) < 0.01, cross
+    # blocks are contiguous id ranges
+    assert np.array_equal(gt, np.arange(1 << 12) >> 8)
+
+
+def test_ground_truth_grouping_and_validation():
+    s = generators.SbmHashStream(8, 8, 0.05)
+    gt8 = s.ground_truth()
+    gt2 = s.ground_truth(2)
+    assert np.array_equal(gt2, gt8 // 4)
+    with pytest.raises(ValueError, match="divide"):
+        s.ground_truth(3)
+    with pytest.raises(ValueError, match="power of two"):
+        generators.SbmHashStream(8, 6, 0.05)
+    with pytest.raises(ValueError, match="p_out"):
+        generators.SbmHashStream(8, 4, 1.5)
+    with pytest.raises(ValueError, match="scale"):
+        generators.SbmHashStream(32, 4, 0.1)
+
+
+def test_native_range_matches_numpy():
+    from sheep_tpu.core import native
+
+    if not (native.available() and native.has_sbm_hash()):
+        pytest.skip("native core without sbm hash")
+    # count >= 4096 dispatches native; force the numpy body for the twin
+    keys = generators._sbm_hash_keys(7)
+    start, count = (1 << 32) - 2048, 8192  # crosses the 32-bit counter
+    idx = start + np.arange(count, dtype=np.int64)
+    u, v = generators._sbm_hash_uv(
+        np, (idx & 0xFFFFFFFF).astype(np.uint32),
+        (idx >> 32).astype(np.uint32), keys,
+        generators._sbm_t_out(0.07), 16, 8, np.int64)
+    nat = native.sbm_hash_range(start, count, keys,
+                                generators._rmat_hash_keys2(keys),
+                                generators._sbm_t_out(0.07), 16, 8)
+    assert np.array_equal(nat, np.stack([u, v], axis=1))
+
+
+def test_device_chunk_matches_host():
+    s = generators.SbmHashStream(9, 4, 0.2, edge_factor=4, seed=5)
+    n = 1 << 9
+    cs = 600
+    host = s.read_all()
+    for idx in range(s.num_device_chunks(cs)):
+        dev = np.asarray(s.device_chunk(idx, cs, n))
+        count = min(cs, s.num_edges - idx * cs)
+        assert np.array_equal(dev[:count].astype(np.int64),
+                              host[idx * cs: idx * cs + count])
+        assert (dev[count:] == n).all()  # sentinel padding
+
+
+def test_open_input_spec():
+    with open_input("sbm-hash:10:8:0.05") as s:
+        assert isinstance(s, generators.SbmHashStream)
+        assert s.num_vertices == 1 << 10 and s.p_out == 0.05
+    with open_input("sbm-hash:10:8:0.05:4:7") as s:
+        assert s.edge_factor == 4 and s.seed == 7
+    for bad in ("sbm-hash:10", "sbm-hash:10:8", "sbm-hash:10:8:x",
+                "sbm-hash:10:8:0.05:0", "sbm-hash:10:6:0.05"):
+        with pytest.raises(ValueError):
+            open_input(bad)
+    with pytest.raises(ValueError, match="contradicts"):
+        open_input("sbm-hash:10:8:0.05", n_vertices=55)
+
+
+def test_planted_assignment_scores_planted_ratio():
+    """Scoring the ground truth against the stream recovers the observed
+    cross rate exactly — the known-optimal-cut yardstick."""
+    from sheep_tpu.backends.base import score_stream
+
+    s = generators.SbmHashStream(11, 8, 0.05, edge_factor=16, seed=1)
+    gt = s.ground_truth()
+    cut, total, balance, _ = score_stream(s, {8: gt.astype(np.int32)},
+                                          chunk_edges=1 << 14,
+                                          comm_volume=False)[8]
+    e = s.read_all()
+    expect = int((gt[e[:, 0]] != gt[e[:, 1]]).sum())
+    assert cut == expect
+    # the scorer's total excludes self-loops (never cuttable; the SBM
+    # produces ~2^-block_bits of them among intra edges)
+    assert total == int((e[:, 0] != e[:, 1]).sum())
+    assert abs(cut / total - 0.05) < 0.01
+    assert abs(balance - 1.0) < 1e-6  # equal blocks => perfect balance
+
+
+def test_refine_recovers_planted_structure():
+    """The headline quality property: base streaming pass ~random on a
+    degree-flat SBM, refine recovers near-planted cut (measured 0.13 at
+    scale 11 / k=8 / p_out=0.05 / 8 rounds; planted 0.05, random 0.875,
+    base 0.87)."""
+    import sheep_tpu
+
+    be = "cpu" if "cpu" in sheep_tpu.list_backends() else "pure"
+    base = sheep_tpu.partition("sbm-hash:11:8:0.05:16:1", 8, backend=be,
+                               comm_volume=False)
+    refined = sheep_tpu.partition("sbm-hash:11:8:0.05:16:1", 8, backend=be,
+                                  comm_volume=False, refine=8)
+    assert base.cut_ratio < 0.93            # sane, if not structured
+    assert refined.cut_ratio <= 0.30, refined.cut_ratio
+    assert refined.cut_ratio <= base.cut_ratio / 2
+    assert refined.balance <= 1.11          # refine alpha default 1.10

@@ -1,0 +1,109 @@
+"""LiveJournal-class SBM quality run (VERDICT r3 items 5+8).
+
+Partitions a scale-22 planted-partition stream (4.2M vertices, 67M
+edges, 64 blocks, p_out inter-block rate) with the cpu-native backend
+and the tpu-sharded 8-device virtual mesh, scores the planted ground
+truth as the known optimum, and measures the refine post-pass delta
+where cut structure actually exists (the round-3 refine measurement was
+on an expander).
+
+Results -> tools/out/soak/sbm_s22.json. Wall-bounded: the refine rounds
+dominate (one host stream pass each); --refine 6 keeps the run in
+CI-hours on one core.
+
+Usage:
+    python tools/sbm_quality.py [--scale 22] [--blocks 64] [--p-out 0.05]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=22)
+    ap.add_argument("--blocks", type=int, default=64)
+    ap.add_argument("--p-out", type=float, default=0.05)
+    ap.add_argument("--edge-factor", type=int, default=16)
+    ap.add_argument("--k", type=int, default=64)
+    ap.add_argument("--refine", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--skip-sharded", action="store_true")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    from sheep_tpu.utils.platform import pin_platform
+
+    pin_platform("cpu")
+    import sheep_tpu
+    from sheep_tpu.backends.base import score_stream
+    from sheep_tpu.io import generators
+
+    spec = (f"sbm-hash:{args.scale}:{args.blocks}:{args.p_out}:"
+            f"{args.edge_factor}:{args.seed}")
+    s = generators.SbmHashStream(args.scale, args.blocks, args.p_out,
+                                 args.edge_factor, seed=args.seed)
+    result = {"spec": spec, "n_vertices": s.num_vertices,
+              "n_edges": s.num_edges, "k": args.k,
+              "refine_rounds": args.refine}
+    print(f"{spec}: V={s.num_vertices:,} E={s.num_edges:,} k={args.k}",
+          flush=True)
+
+    # known optimum: the planted assignment scored against the stream
+    t0 = time.perf_counter()
+    gt = s.ground_truth(args.k)
+    cut, total, balance, _ = score_stream(
+        s, {args.k: gt.astype(np.int32)}, chunk_edges=1 << 22,
+        comm_volume=False)[args.k]
+    result["planted"] = {"cut_ratio": round(cut / total, 6),
+                        "balance": round(float(balance), 4),
+                        "score_s": round(time.perf_counter() - t0, 1)}
+    print("planted:", json.dumps(result["planted"]), flush=True)
+
+    be = "cpu" if "cpu" in sheep_tpu.list_backends() else "pure"
+    for label, refine in (("base", 0), ("refined", args.refine)):
+        t0 = time.perf_counter()
+        r = sheep_tpu.partition(spec, args.k, backend=be,
+                                comm_volume=False, refine=refine)
+        result[f"{be}_{label}"] = {
+            "cut_ratio": round(float(r.cut_ratio), 6),
+            "balance": round(float(r.balance), 4),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        print(f"{be} {label}:", json.dumps(result[f"{be}_{label}"]),
+              flush=True)
+
+    if not args.skip_sharded:
+        t0 = time.perf_counter()
+        r = sheep_tpu.partition(spec, args.k, backend="tpu-sharded",
+                                comm_volume=False)
+        result["tpu_sharded_base"] = {
+            "cut_ratio": round(float(r.cut_ratio), 6),
+            "balance": round(float(r.balance), 4),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        print("tpu-sharded base:",
+              json.dumps(result["tpu_sharded_base"]), flush=True)
+
+    out = os.path.join(REPO, "tools", "out", "soak",
+                       f"sbm_s{args.scale}.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print("wrote", out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
