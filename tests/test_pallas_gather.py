@@ -1,0 +1,26 @@
+"""Semantics of the Pallas VMEM-gather probe (interpreter mode — the
+on-chip lowering/perf question is the microbench's to answer)."""
+
+import numpy as np
+
+from sheep_tpu.ops.pallas_gather import vmem_gather
+
+
+def test_interpret_matches_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    table = rng.integers(0, 1 << 20, size=1 << 12, dtype=np.int32)
+    idx = rng.integers(0, 1 << 12, size=1 << 14, dtype=np.int32)
+    out = np.asarray(vmem_gather(jnp.asarray(table), jnp.asarray(idx),
+                                 block=4096, interpret=True))
+    assert np.array_equal(out, table[idx])
+
+
+def test_block_validation():
+    import jax.numpy as jnp
+    import pytest
+
+    t = jnp.zeros(16, jnp.int32)
+    with pytest.raises(ValueError, match="multiple"):
+        vmem_gather(t, jnp.zeros(100, jnp.int32), block=64)

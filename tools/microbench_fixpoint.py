@@ -160,6 +160,31 @@ def main():
     # bytes: C index reads + C random table reads + C writes
     report("gather_C_from_V", s, 4 * (3 * c))
 
+    # 1b. Pallas VMEM-staged gather (SURVEY.md §7 step 7, VERDICT r3
+    # weak #3): the XLA gather above runs ~50x under roofline; if
+    # staging the table in VMEM wins >= 2x, a Pallas round body is the
+    # first credible path to single-chip R >= 1. Table capped at 2^21
+    # entries (8 MB; VMEM ~16 MB/core). A Mosaic lowering rejection is
+    # ALSO a result — it closes the escape hatch with an artifact.
+    try:
+        from sheep_tpu.ops.pallas_gather import vmem_gather
+
+        tscale = min(args.scale, 21)
+        tn = 1 << tscale
+        table_s = jax.lax.slice(table, (0,), (tn,))
+        idx_s = jnp.bitwise_and(idx_c, jnp.int32(tn - 1))
+        s = timeit(jax.jit(lambda t, i: vmem_gather(t, i)), table_s, idx_s)
+        report("pallas_vmem_gather_C", s, 4 * (3 * c),
+               {"table_scale": tscale})
+        g_ref = jax.jit(lambda t, i: t[i])
+        s = timeit(g_ref, table_s, idx_s)
+        report("xla_gather_C_matched", s, 4 * (3 * c),
+               {"table_scale": tscale})
+    except Exception as e:  # lowering rejection or OOM: record, move on
+        emit(bench="pallas_vmem_gather_C", error=str(e)[:400],
+             platform=plat)
+        log(f"pallas_vmem_gather_C FAILED: {str(e)[:200]}")
+
     # 2. table self-gather t[t] (lifting-table squaring, V-sized)
     g2 = jax.jit(lambda t: t[t])
     s = timeit(g2, table)
