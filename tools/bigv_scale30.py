@@ -7,7 +7,8 @@ hold the RMAT-30 class (BASELINE.md HBM table). tpu-bigv exists to
 remove that ceiling: pos/P/deg block-sharded across the mesh (B =
 (V+1)/D rows per device), ONE distributed forest via routed
 collectives. This driver proves it at the real vertex scale on the
-8-device virtual CPU mesh:
+virtual CPU mesh (--devices sizes the mesh; see that flag's help for
+why the virtual-mesh default is 2):
 
 - graph: a PREFIX of the rmat_stream(30, ef=1) edge stream (Graph500
   R-MAT parameters, so the hub skew of the scale-30 class is real),
@@ -57,6 +58,18 @@ def main():
                     help="fixpoint rounds per device execution (same "
                          "memory trade as --lift-levels)")
     ap.add_argument("--jumps", type=int, default=16)
+    ap.add_argument("--devices", type=int, default=2,
+                    help="mesh size for the run. On the VIRTUAL mesh "
+                         "every all_gather of a B-width buffer "
+                         "replicates all D shards into ONE host RAM "
+                         "(D * V words per live gathered buffer — 34 GB "
+                         "at D=8/V=2^30, several live at once: the "
+                         "observed 130 GB OOMs), where real chips hold "
+                         "their own copy in their own HBM. D=2 proves "
+                         "the identical block-sharded/routed design at "
+                         "full vertex scale within 125 GB; per-device "
+                         "collective counts for D=8 come from "
+                         "build_stats at smaller V (BASELINE.md)")
     ap.add_argument("--skip-oracle", action="store_true")
     args = ap.parse_args()
 
@@ -89,10 +102,10 @@ def main():
         return EdgeStream.from_generator(prefix, n_vertices=n, num_edges=m)
 
     result = {"scale": args.scale, "n_vertices": n, "n_edges": m,
-              "k": args.k, "devices": jax.device_count(),
+              "k": args.k, "devices": args.devices,
               "chunk_edges": args.chunk_edges}
     print(f"V=2^{args.scale} = {n:,}  E={m:,}  k={args.k}  "
-          f"devices={jax.device_count()}", flush=True)
+          f"devices={args.devices} (virtual mesh of {jax.device_count()})", flush=True)
 
     result["lift_levels"] = args.lift_levels
     result["segment_rounds"] = args.segment_rounds
@@ -101,13 +114,13 @@ def main():
     # record what actually runs so cross-round artifact comparisons
     # don't attribute a hidden chunk-size change to code changes
     result["chunk_edges_effective"] = min(
-        args.chunk_edges, max(1024, -(-m // jax.device_count())))
+        args.chunk_edges, max(1024, -(-m // args.devices)))
     t0 = time.perf_counter()
     # through the REGISTERED backend (vertex-range check, chunk clamping,
     # PartitionResult packaging), not a hand-wired pipeline
     big = get_backend(
         "tpu-bigv", chunk_edges=args.chunk_edges, jumps=args.jumps,
-        segment_rounds=args.segment_rounds,
+        segment_rounds=args.segment_rounds, n_devices=args.devices,
         lift_levels=args.lift_levels).partition(
             stream(), args.k, comm_volume=False)
     result["bigv"] = {
