@@ -71,6 +71,10 @@ class TpuBigVBackend(Partitioner):
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
             diagnostics={"fixpoint_rounds": float(out["fixpoint_rounds"]),
+                         # the clamped value actually run, so artifact
+                         # tooling records it instead of re-deriving the
+                         # clamp formula (which could silently drift)
+                         "chunk_edges_effective": float(cs),
                          **{k_: float(v) for k_, v in
                             out.get("build_stats", {}).items()}},
             tree={"parent": out["parent"], "pos": out["pos"],

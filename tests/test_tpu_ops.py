@@ -489,3 +489,28 @@ def test_stale_reuse_rebuild_cadence():
     full = stats.get("full_segments", 0)
     assert full > 0, "config must exercise the full-segment stale path"
     assert stats.get("stack_rebuilds", 0) == -(-full // 3)
+
+
+def test_pipeline_runs_under_debug_nans():
+    """SURVEY.md §5 race-detection line: the JAX path is functional/pure,
+    so the structural check is that a full partition runs clean under
+    jax_debug_nans (plus the cross-backend equivalence suite). The
+    pipeline is integer-only; this pins that no float NaN can sneak in
+    via scoring/balance math."""
+    import jax
+
+    import sheep_tpu
+    from sheep_tpu.io import formats, generators
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            p = f"{d}/k.edges"
+            formats.write_edges(p, generators.karate_club())
+            res = sheep_tpu.partition(p, 2, backend="tpu")
+            assert res.edge_cut > 0
+    finally:
+        jax.config.update("jax_debug_nans", prev)
