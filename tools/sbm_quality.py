@@ -97,8 +97,11 @@ def main():
         print("tpu-sharded base:",
               json.dumps(result["tpu_sharded_base"]), flush=True)
 
-    out = os.path.join(REPO, "tools", "out", "soak",
-                       f"sbm_s{args.scale}.json")
+    # key the artifact by every quality-relevant knob so reruns at a
+    # different k/refine depth do not clobber committed evidence
+    tag = f"sbm_s{args.scale}" + (f"_k{args.k}" if args.k != 64 else "") \
+        + (f"_r{args.refine}" if args.refine != 6 else "")
+    out = os.path.join(REPO, "tools", "out", "soak", f"{tag}.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
