@@ -22,6 +22,15 @@ from sheep_tpu.types import ElimTree, PartitionResult  # noqa: F401
 from sheep_tpu.backends.base import get_backend, list_backends  # noqa: F401
 
 
+def partition_hierarchical(path, k_levels, **kw):
+    """Lazy re-export of :func:`sheep_tpu.hierarchy.partition_hierarchical`
+    (k = prod(k_levels) via per-level partition + refine — keeps every
+    level above the LP signal threshold; see that module)."""
+    from sheep_tpu.hierarchy import partition_hierarchical as ph
+
+    return ph(path, k_levels, **kw)
+
+
 def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     """One-call API: partition the graph stored at *path* into *k* parts.
 
@@ -42,14 +51,24 @@ def partition(path, k, backend=None, refine=0, refine_alpha=1.10, **opts):
     """
     from sheep_tpu.io.edgestream import open_input
 
+    with open_input(path) as es:
+        return _partition_stream(es, k, backend=backend, refine=refine,
+                                 refine_alpha=refine_alpha, **opts)
+
+
+def _partition_stream(stream, k, backend=None, refine=0,
+                      refine_alpha=1.10, **opts):
+    """:func:`partition` over an already-open stream (shared by the
+    path API and :func:`sheep_tpu.hierarchy.partition_hierarchical`,
+    whose induced subgraphs exist only in memory)."""
     cls, ctor_opts, part_opts = _resolve_backend(backend, opts)
     be = cls(**ctor_opts)
-    with open_input(path) as es:
-        res = be.partition(es, k, **part_opts)
-        if refine:
-            res = refine_result(res, es, rounds=refine, alpha=refine_alpha,
-                                weights=opts.get("weights", "unit"))
-        return res
+    res = be.partition(stream, k, **part_opts)
+    if refine:
+        res = refine_result(res, stream, rounds=refine,
+                            alpha=refine_alpha,
+                            weights=opts.get("weights", "unit"))
+    return res
 
 
 def _resolve_backend(backend, opts):

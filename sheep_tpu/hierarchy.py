@@ -1,0 +1,129 @@
+"""Hierarchical partitioning: k = k1 * k2 * ... via recursive refinement.
+
+Motivated by a measured law (BASELINE.md "SBM quality"): label-
+propagation refinement recovers community structure only while average
+intra-community degree / k >= ~1 — at the LiveJournal shape it recovers
+k=8 to near-optimal but stalls at k=64 (majority signal below noise).
+Splitting k into levels keeps EVERY level above the signal threshold:
+partition + refine at k1, then partition each part's induced subgraph
+at the remaining levels (recursively), labeling vertex v as
+part(v) * prod(k_rest) + subpart(v). Measured effect at the stalled
+config (s22, 64 planted blocks, k=64): flat refine stalls at 0.847;
+hierarchical [8, 8] — see BASELINE.md "SBM quality".
+
+An EXTENSION beyond the reference's surface, like ops/refine.py; the
+flat pipeline and every parity artifact are untouched.
+
+Memory envelope: each level materializes each part's INTRA-part edges
+(cross edges are already cut and never revisited), so host memory is
+O(E_intra) = (1 - cut_so_far) * E for the bucketing pass plus one
+subgraph at a time. Streams too big for that should partition flat
+(the flat split has no such limit; this utility exists for cut QUALITY
+on community-structured graphs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _hier_assign(stream, k_levels, backend, refine, chunk_edges,
+                 opts):
+    """Assignment over ``stream`` at k = prod(k_levels), recursing."""
+    from sheep_tpu import _partition_stream
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    n = stream.num_vertices
+    # comm volume of inner levels is discarded (the final full-stream
+    # score recomputes it once); chunk_edges forwards as the backends'
+    # ctor option so the user's memory ceiling applies at every level
+    res = _partition_stream(stream, k_levels[0], backend=backend,
+                            refine=refine, chunk_edges=chunk_edges,
+                            **{**opts, "comm_volume": False})
+    assign = np.asarray(res.assignment, np.int64)
+    if len(k_levels) == 1:
+        return assign.astype(np.int32)
+
+    k1 = k_levels[0]
+    k_sub = int(np.prod(k_levels[1:]))
+    # one bucketing pass: intra-part edges per part (cross edges are
+    # final cut at this level and never revisited)
+    buckets: list[list[np.ndarray]] = [[] for _ in range(k1)]
+    for c in stream.chunks(chunk_edges):
+        e = np.asarray(c, np.int64).reshape(-1, 2)
+        pu = assign[e[:, 0]]
+        same = pu == assign[e[:, 1]]
+        for p in range(k1):
+            m = same & (pu == p)
+            if m.any():
+                buckets[p].append(e[m])
+
+    final = np.empty(n, np.int32)
+    for p in range(k1):
+        members = np.flatnonzero(assign == p)
+        if len(members) == 0:
+            continue
+        if len(members) <= k_sub:
+            # degenerate tiny part: round-robin so every vertex keeps a
+            # valid label in [0, k_sub)
+            final[members] = p * k_sub + np.arange(len(members)) % k_sub
+            continue
+        inv = np.full(n, -1, np.int64)       # dense relabel of the part
+        inv[members] = np.arange(len(members))
+        eb = (np.concatenate(buckets[p])
+              if buckets[p] else np.empty((0, 2), np.int64))
+        buckets[p] = []  # release the fragments as the loop advances
+        sub_edges = inv[eb] if len(eb) else eb
+        sub = EdgeStream.from_array(sub_edges, n_vertices=len(members))
+        sub_assign = _hier_assign(sub, k_levels[1:], backend, refine,
+                                  chunk_edges, opts)
+        final[members] = p * k_sub + sub_assign
+    return final
+
+
+def partition_hierarchical(path, k_levels, backend=None, refine=8,
+                           chunk_edges: int = 1 << 22, **opts):
+    """Partition into prod(k_levels) parts, one level at a time.
+
+    ``k_levels`` — e.g. ``[8, 8]`` for k=64. ``refine`` rounds apply at
+    EVERY level (that is the point: each level stays above the LP
+    signal threshold). Extra ``opts`` are the usual backend/partition
+    options of :func:`sheep_tpu.partition`. Returns a PartitionResult
+    scored over the full stream at k = prod(k_levels); ``backend``
+    in the result is tagged ``+hier``.
+    """
+    from sheep_tpu.backends.base import score_stream
+    from sheep_tpu.io.edgestream import open_input
+
+    from sheep_tpu import _resolve_backend
+
+    k_levels = [int(k) for k in k_levels]
+    if len(k_levels) < 1 or any(k < 1 for k in k_levels):
+        raise ValueError(f"k_levels must be positive ints, got {k_levels}")
+    k_total = int(np.prod(k_levels))
+    comm_volume = opts.get("comm_volume", True)
+    inner_backend = _resolve_backend(backend, {})[0].name
+
+    with open_input(path) as es:
+        final = _hier_assign(es, k_levels, backend, refine, chunk_edges,
+                             dict(opts))
+        w = None
+        if opts.get("weights") == "degree":
+            # score with the same weights the levels balanced against,
+            # like partition()/partition_multi
+            n = es.num_vertices
+            w = np.zeros(n, dtype=np.int64)
+            for c in es.chunks(chunk_edges):
+                w += np.bincount(np.asarray(c, np.int64).ravel(),
+                                 minlength=n)[:n]
+        scored = score_stream(es, {k_total: final},
+                              chunk_edges=chunk_edges,
+                              comm_volume=comm_volume, weights=w)
+    cut, total, balance, cv = scored[k_total]
+    from sheep_tpu.types import PartitionResult
+
+    return PartitionResult(
+        assignment=final, k=k_total, edge_cut=cut, total_edges=total,
+        cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
+        phase_times={}, backend=f"{inner_backend}+hier{k_levels}",
+        diagnostics={})
