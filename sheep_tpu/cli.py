@@ -37,6 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
                                "k-independent), one result line each")
     p.add_argument("--backend", default=None,
                    help="execution backend (default: best available; see --list-backends)")
+    p.add_argument("--k-levels", default=None, metavar="K1,K2",
+                   help="hierarchical partitioning into K1*K2*... parts: "
+                        "partition + refine at K1, recurse into each "
+                        "part's induced subgraph for the remaining "
+                        "levels. --refine rounds apply at EVERY level "
+                        "(default 8 when --refine is 0). Recovers "
+                        "community structure where flat k stalls below "
+                        "the LP signal threshold (BASELINE.md 'SBM "
+                        "quality'); replaces --k, excludes "
+                        "--checkpoint-dir/--resume")
     p.add_argument("--score-only", default=None, metavar="PARTS",
                    help="skip partitioning: score this existing partition "
                         "map (.parts/.pbin) against --input — the "
@@ -109,10 +119,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "squaring, the measured default; see BASELINE.md)")
     p.add_argument("--chunk-edges", type=int, default=None,
                    help="edges per streamed chunk (default backend-specific)")
-    p.add_argument("--refine", type=int, default=0, metavar="N",
+    p.add_argument("--refine", type=int, default=None, metavar="N",
                    help="post-pass: up to N rounds of capacity-constrained "
                         "label propagation (cut never regresses; extension "
-                        "beyond the reference)")
+                        "beyond the reference). Default 0 for flat runs; "
+                        "--k-levels defaults to 8 per level (pass an "
+                        "explicit 0 for unrefined levels)")
     p.add_argument("--refine-alpha", type=float, default=1.10,
                    help="refinement balance cap (x ceil(V/k) per part)")
     p.add_argument("--no-comm-volume", action="store_true",
@@ -234,8 +246,89 @@ def main(argv=None) -> int:
     if args.list_backends:
         print(" ".join(list_backends()))
         return 0
-    if args.input is None or (args.k is None and not args.score_only):
+    if args.input is None or (args.k is None and not args.score_only
+                              and not args.k_levels):
         build_parser().error("--input and --k are required")
+
+    def _k_levels(args):
+        """--k-levels K1,K2: hierarchical partitioning via the library's
+        partition_hierarchical (see sheep_tpu/hierarchy.py)."""
+        import sheep_tpu
+
+        if args.k is not None:
+            parser.error("--k-levels replaces --k")
+        if args.checkpoint_dir or args.resume:
+            parser.error("--k-levels does not combine with "
+                         "--checkpoint-dir/--resume (hierarchy levels "
+                         "are not checkpointable units)")
+        if args.coordinator or args.num_processes:
+            parser.error("--k-levels is single-process (levels recurse "
+                         "into host-memory subgraphs); run multi-host "
+                         "partitions flat")
+        if args.balance is not None:
+            parser.error("--balance does not compose across hierarchy "
+                         "levels (per-level BETA compounds to "
+                         "~BETA^levels); pass an explicit --alpha "
+                         "instead")
+        # every other flag either forwards below or must not silently
+        # diverge from what was requested
+        ignored = [f for f, v in (
+            ("--metrics-out", args.metrics_out),
+            ("--profile-dir", args.profile_dir),
+            ("--num-vertices", args.num_vertices),
+            ("--segment-rounds", args.segment_rounds),
+            ("--warm-schedule", args.warm_schedule),
+            ("--host-tail-threshold", args.host_tail_threshold),
+            ("--no-cache-chunks", args.no_cache_chunks or None),
+            ("--carry-tail", args.carry_tail),
+            ("--tail-overlap", args.tail_overlap),
+            ("--stale-reuse", args.stale_reuse),
+            ("--lift-levels", args.lift_levels),
+            ("--jumps", args.jumps),
+            ("--hoist-bytes", args.hoist_bytes),
+        ) if v is not None]
+        if ignored:
+            parser.error(f"{', '.join(ignored)} not supported with "
+                         f"--k-levels (would be silently ignored)")
+        try:
+            levels = [int(x) for x in args.k_levels.split(",") if x != ""]
+        except ValueError:
+            levels = []
+        if not levels or any(k < 1 for k in levels):
+            parser.error(f"--k-levels must be a comma list of "
+                         f"positive ints (got {args.k_levels!r})")
+        t0 = time.perf_counter()
+        res = sheep_tpu.partition_hierarchical(
+            args.input, levels, backend=args.backend,
+            refine=8 if args.refine is None else args.refine,
+            refine_alpha=args.refine_alpha,
+            chunk_edges=args.chunk_edges or (1 << 22),
+            comm_volume=not args.no_comm_volume, weights=args.weights,
+            alpha=args.alpha)
+        wall = time.perf_counter() - t0
+        if args.output:
+            write_partition(args.output, res.assignment)
+        summary = res.summary()
+        summary["wall_seconds"] = round(wall, 4)
+        summary["n_vertices"] = int(len(res.assignment))
+        if not args.json:
+            print(f"graph: {args.input}  k-levels: {levels}")
+            print(f"k={res.k}: edge cut {res.edge_cut:,} "
+                  f"({100 * res.cut_ratio:.2f}%)  balance "
+                  f"{res.balance:.4f}"
+                  + (f"  comm volume {res.comm_volume:,}"
+                     if res.comm_volume is not None else ""))
+            if args.output:
+                print(f"partition map written to {args.output}")
+            print(f"wall: {wall:.2f}s")
+        print(json.dumps(summary))
+        return 0
+
+    if args.k_levels:
+        if args.score_only:
+            build_parser().error("--k-levels does not combine with "
+                                 "--score-only")
+        return _k_levels(args)
     if args.score_only:
         if args.balance is not None:
             build_parser().error("--balance has no effect with "

@@ -63,3 +63,30 @@ def test_validation():
         sheep_tpu.partition_hierarchical(SPEC, [4, 0])
     with pytest.raises(ValueError, match="positive"):
         sheep_tpu.partition_hierarchical(SPEC, [])
+
+
+def test_cli_k_levels(tmp_path, capsys):
+    import json
+
+    from sheep_tpu import cli
+    from sheep_tpu.io import formats, generators
+
+    p = str(tmp_path / "g.edges")
+    formats.write_edges(p, generators.sbm_hash_range(10, 0, 4 << 10, 4,
+                                                     0.05, seed=1))
+    out = str(tmp_path / "g.parts")
+    rc = cli.main(["--input", p, "--k-levels", "2,2", "--backend", "pure",
+                   "--refine", "2", "--no-comm-volume", "--json",
+                   "--output", out])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["k"] == 4 and line["backend"].endswith("+hier[2, 2]")
+    parts = formats.read_partition(out)
+    assert parts.shape == (1 << 10,) and parts.max() < 4
+    # exclusions are clean usage errors
+    for argv in (["--input", p, "--k-levels", "2,2", "--k", "4"],
+                 ["--input", p, "--k-levels", "2,x"],
+                 ["--input", p, "--k-levels", "2,2",
+                  "--checkpoint-dir", str(tmp_path)]):
+        with pytest.raises(SystemExit):
+            cli.main(argv)
