@@ -1,0 +1,116 @@
+"""Bank partial evidence in ANY healthy window (VERDICT r4 item 8).
+
+Round 4 had zero healthy tunnel windows; rounds 3/3b each saw windows
+too short for a full capture. This probe is the "one warm phase" that
+banks a number in under ~90 s: per-window LINK STATE (h2d rate, d2h
+rate, per-call RTT) plus the gather roofline datum — the quantities
+that explained the 0.215 -> 0.064 headline swing (BASELINE.md round-3b:
+same code, link state differed ~8x). With a per-window link-state line
+on file, any e2e capture from the same window can be normalized to the
+co-located-host bound even if nothing else lands.
+
+Appends ONE JSON line to tools/out/linkstate.jsonl (and stdout). Cheap
+by construction: largest transfer is 64 MB, gather probe is 16M
+indices, everything warm-measured once. Timing forces a tiny host pull
+(np.asarray(x[:1])) because block_until_ready() does not block through
+the tunnel (BASELINE.md round-2 fact 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def pull(x):
+    import numpy as np
+
+    return np.asarray(x[:1])
+
+
+PATH = os.path.join(REPO, "tools", "out", "linkstate.jsonl")
+
+
+def bank(out):
+    """Rewrite this probe's line after every leg: a mid-probe wedge (or
+    the watcher's timeout kill) must not lose the numbers already
+    measured — partial link state is exactly the evidence this tool
+    exists to bank."""
+    line = json.dumps(out)
+    print(line, flush=True)
+    os.makedirs(os.path.dirname(PATH), exist_ok=True)
+    lines = []
+    if os.path.exists(PATH):
+        with open(PATH) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+    if lines and json.loads(lines[-1]).get("utc") == out["utc"]:
+        lines[-1] = line
+    else:
+        lines.append(line)
+    tmp = PATH + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, PATH)
+
+
+def main():
+    import numpy as np
+
+    out = {"probe": "linkstate", "utc": time.strftime("%Y%m%dT%H%M%S",
+                                                      time.gmtime())}
+    import jax
+    import jax.numpy as jnp
+
+    out["platform"] = jax.default_backend()
+    bank(out)
+
+    # per-call RTT: median of 9 tiny put+pull round trips
+    rtts = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        pull(jax.device_put(np.zeros(1, np.int32)))
+        rtts.append(time.perf_counter() - t0)
+    out["rtt_ms"] = round(1e3 * sorted(rtts)[len(rtts) // 2], 1)
+    bank(out)
+
+    # h2d: one 64 MB upload (forced by a dependent 4-byte pull)
+    host = np.arange(1 << 24, dtype=np.int32)  # 64 MB
+    t0 = time.perf_counter()
+    dev = jax.device_put(host)
+    pull(dev)
+    h2d_s = time.perf_counter() - t0
+    out["h2d_mbs"] = round(64 / h2d_s, 1)
+    bank(out)
+
+    # d2h: pull the same 64 MB back
+    t0 = time.perf_counter()
+    back = np.asarray(dev)
+    d2h_s = time.perf_counter() - t0
+    assert back[-1] == host[-1]
+    out["d2h_mbs"] = round(64 / d2h_s, 1)
+    bank(out)
+
+    # gather roofline: 16M random indices from a 4M-entry table (the
+    # round-2 probe shape: measured 121 ms = ~135 M elem/s on v5e)
+    table = jnp.arange(1 << 22, dtype=jnp.int32)
+    idx = jax.device_put(
+        np.random.default_rng(0).integers(0, 1 << 22, 1 << 24,
+                                          dtype=np.int32))
+    f = jax.jit(lambda t, i: jnp.take(t, i, mode="clip"))
+    pull(f(table, idx))  # compile warm-up
+    t0 = time.perf_counter()
+    pull(f(table, idx))
+    g_s = time.perf_counter() - t0
+    out["gather_melems"] = round((1 << 24) / g_s / 1e6, 1)
+    out["complete"] = True
+    bank(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,92 +1,10 @@
 #!/usr/bin/env bash
-# Bench-FIRST tunnel watcher (round 3b). Differences from tpu_watch.sh,
-# learned the hard way:
-#   - the headline bench.py runs FIRST in the healthy window (the sweep
-#     twice outlived the window and cost the round its headline);
-#   - cheap 60s probes between attempts instead of letting bench.py's
-#     30-min attempt timeout block blind (a wedged tunnel hangs clients
-#     at jax init, burning the ladder with zero signal);
-#   - tools/out/CAPTURING flag while working so concurrent dev work can
-#     yield the (single) host core — the CPU baseline leg is
-#     contention-sensitive (r2's numbers were polluted that way);
-#   - JAX_COMPILATION_CACHE_DIR defaults into the repo (.jax_cache) so
-#     machine resets don't re-pay the ~7 min cold warm-up.
-set -u
-cd "$(dirname "$0")/.."
-export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
-interval=${SHEEP_WATCH_INTERVAL:-180}
-deadline=$(( $(date +%s) + ${SHEEP_WATCH_HOURS:-10} * 3600 ))
-flag=tools/out/CAPTURING
-
-probe() {
-  timeout 75 python -c "
-import jax, jax.numpy as jnp, numpy as np
-assert int(np.asarray(jnp.sum(jnp.arange(8)))) == 28
-print('ok')" 2>/dev/null | grep -q ok
-}
-
-cleanup() { rm -f "$flag"; }
-trap cleanup EXIT
-
-have_bench=""
-have_micro=""
-have_tune=""
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  if probe; then
-    ts=$(date -u +%Y%m%dT%H%M%S)
-    out="tools/out/$ts"
-    mkdir -p "$out"
-    touch "$flag"
-    echo "tunnel healthy at $ts; capturing (bench first)" | tee "$out/watch.log"
-    if [ -z "$have_bench" ]; then
-      timeout 2400 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
-      cat "$out/bench.json" | tee -a "$out/watch.log"
-      if grep -q '"vs_baseline"' "$out/bench.json" && \
-         ! grep -q '"value": 0.0' "$out/bench.json" && \
-         ! grep -q '"platform": "cpu"' "$out/bench.json"; then
-        have_bench=yes
-        echo "HEADLINE LANDED in $out" | tee -a "$out/watch.log"
-      else
-        echo "bench incomplete; resuming poll" | tee -a "$out/watch.log"
-        rm -f "$flag"
-        sleep "$interval"
-        continue
-      fi
-    fi
-    # headline on file: extras in priority order. Each leg counts as
-    # done only on rc=0 (a timeout-killed sweep is a PARTIAL artifact:
-    # keep the jsonl as data but retry the leg next healthy window);
-    # completed legs never re-run.
-    if [ -z "$have_micro" ]; then
-      timeout 1500 python tools/microbench_fixpoint.py --scale 22 \
-        --chunk-log 23 --profile-dir "$out/xprof" \
-        >"$out/microbench.jsonl" 2>>"$out/watch.log"
-      rc=$?
-      echo "microbench rc=$rc" | tee -a "$out/watch.log"
-      [ "$rc" = 0 ] && [ -s "$out/microbench.jsonl" ] && have_micro=yes
-    fi
-    if [ -z "$have_tune" ]; then
-      timeout 3600 python tools/tune_fixpoint.py --scale 22 --ef 16 \
-        --chunk-logs 23 --warm w1,w8 --segment-rounds 2 \
-        --lift-levels 0 --tail-divisors 2 --stale 1,0 --stale-reuse 1,4 \
-        --carry 0,1 --overlap 0,1 \
-        >"$out/tune22_post.jsonl" 2>>"$out/watch.log"
-      rc=$?
-      echo "tune rc=$rc" | tee -a "$out/watch.log"
-      [ "$rc" = 0 ] && [ -s "$out/tune22_post.jsonl" ] && have_tune=yes
-    fi
-    if [ -n "$have_micro" ] && [ -n "$have_tune" ]; then
-      echo "full capture complete (bench+microbench+tune)" \
-        | tee -a "$out/watch.log"
-      rm -f "$flag"
-      exit 0
-    fi
-    rm -f "$flag"
-  fi
-  sleep "$interval"
-done
-echo "deadline reached: bench=${have_bench:-no} micro=${have_micro:-no}" \
-     "tune=${have_tune:-no}"
-# exit 0 if the one critical artifact (the headline bench) landed
-[ -n "$have_bench" ] && exit 0
-exit 1
+# RETIRED (round 5): superseded by tools/tpu_watch3.sh, which adds the
+# per-window linkstate leg (tools/tpu_probe_quick.py), the Mosaic
+# lowering smoke (tools/pallas_smoke.py), and a single-watcher pidfile
+# guard. Two watchers fighting over tools/out/CAPTURING and the single
+# host core would contaminate the CPU-baseline denominator — so this
+# script now refuses to run. Its round-3b leg history is preserved in
+# git (and inherited verbatim by watch3's bench/microbench/tune legs).
+echo "tpu_watch2.sh is retired; use tools/tpu_watch3.sh" >&2
+exit 2
