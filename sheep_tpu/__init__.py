@@ -127,12 +127,35 @@ def partition_multi(path, ks, backend=None, **opts):
         return be.partition_multi(es, ks, **part_opts)
 
 
-def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
+def comm_volume_of(assignment, stream, n, k, chunk_edges=1 << 22):
+    """Deduped (vertex, foreign-part) comm volume of an assignment over
+    one stream pass — the counter every backend reports, exposed for
+    post-passes (refine/hierarchy) that change the assignment after the
+    scored pass already happened."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from sheep_tpu.ops import score as score_ops
+    from sheep_tpu.utils.checkpoint import compact_cv_keys
+
+    a_dev = jnp.asarray(np.concatenate(
+        [np.asarray(assignment, np.int32), np.zeros(1, np.int32)]))
+    acc: list = []
+    for c in stream.chunks(chunk_edges):
+        score_ops.accumulate_cv_keys(
+            acc, score_ops.cut_pair_keys_host(c, a_dev, n, k))
+    return int(len(compact_cv_keys(acc)))
+
+
+def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit",
+                  degrees=None):
     """Apply the post-pass refinement to a PartitionResult (shared by the
     library API and the CLI's --refine flag); rescores cut/balance (and
     comm volume when the input carried one). ``weights="degree"`` caps
     parts by degree weight, matching the backend's balance semantics
-    (one extra stream pass recomputes the degrees)."""
+    (one extra stream pass recomputes the degrees — pass ``degrees`` to
+    reuse an already-computed table instead)."""
     import dataclasses
 
     import numpy as np
@@ -141,8 +164,8 @@ def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
     from sheep_tpu.ops.refine import refine_assignment
 
     n = stream.num_vertices
-    w = None
-    if weights == "degree":
+    w = degrees
+    if weights == "degree" and w is None:
         w = np.zeros(n, dtype=np.int64)
         for c in stream.chunks(1 << 22):
             w += np.bincount(np.asarray(c, np.int64).ravel(),
@@ -162,18 +185,7 @@ def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit"):
                               "refine_skipped": str(e)})
     cv = res.comm_volume
     if cv is not None:
-        import jax.numpy as jnp
-
-        from sheep_tpu.ops import score as score_ops
-        from sheep_tpu.utils.checkpoint import compact_cv_keys
-
-        a_dev = jnp.asarray(np.concatenate(
-            [new_assign.astype(np.int32), np.zeros(1, np.int32)]))
-        acc: list = []
-        for c in stream.chunks(1 << 22):
-            score_ops.accumulate_cv_keys(
-                acc, score_ops.cut_pair_keys_host(c, a_dev, n, res.k))
-        cv = int(len(compact_cv_keys(acc)))
+        cv = comm_volume_of(new_assign, stream, n, res.k)
     return dataclasses.replace(
         res, assignment=new_assign,
         edge_cut=rstats["refine_cut_after"],

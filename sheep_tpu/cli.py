@@ -47,6 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the LP signal threshold (BASELINE.md 'SBM "
                         "quality'); replaces --k, excludes "
                         "--checkpoint-dir/--resume")
+    p.add_argument("--final-refine", type=int, default=0, metavar="N",
+                   help="with --k-levels: N warm-start LP rounds at the "
+                        "FULL k after hierarchical assembly (level-1 "
+                        "leakage repair; the LP signal objection applies "
+                        "to cold starts only)")
+    p.add_argument("--spill-dir", default=None, metavar="DIR",
+                   help="with --k-levels: where per-part intra-edge "
+                        "shards spill (default: system temp). Disk "
+                        "high-water mark is 8 bytes per intra edge of "
+                        "the current level")
     p.add_argument("--score-only", default=None, metavar="PARTS",
                    help="skip partitioning: score this existing partition "
                         "map (.parts/.pbin) against --input — the "
@@ -265,11 +275,10 @@ def main(argv=None) -> int:
             parser.error("--k-levels is single-process (levels recurse "
                          "into host-memory subgraphs); run multi-host "
                          "partitions flat")
-        if args.balance is not None:
-            parser.error("--balance does not compose across hierarchy "
-                         "levels (per-level BETA compounds to "
-                         "~BETA^levels); pass an explicit --alpha "
-                         "instead")
+        if args.balance is not None and args.alpha != 1.0:
+            parser.error("--balance sets the per-level alpha "
+                         "(BETA**(1/levels) per level); do not also "
+                         "pass --alpha")
         # every other flag either forwards below or must not silently
         # diverge from what was requested
         ignored = [f for f, v in (
@@ -304,7 +313,10 @@ def main(argv=None) -> int:
             refine_alpha=args.refine_alpha,
             chunk_edges=args.chunk_edges or (1 << 22),
             comm_volume=not args.no_comm_volume, weights=args.weights,
-            alpha=args.alpha)
+            balance=args.balance, final_refine=args.final_refine,
+            spill_dir=args.spill_dir,
+            **({} if args.balance is not None else
+               {"alpha": args.alpha}))
         wall = time.perf_counter() - t0
         if args.output:
             write_partition(args.output, res.assignment)
@@ -329,6 +341,10 @@ def main(argv=None) -> int:
             build_parser().error("--k-levels does not combine with "
                                  "--score-only")
         return _k_levels(args)
+    if args.final_refine or args.spill_dir:
+        build_parser().error("--final-refine/--spill-dir require "
+                             "--k-levels (the flat pipeline has no "
+                             "hierarchy to repair or spill)")
     if args.score_only:
         if args.balance is not None:
             build_parser().error("--balance has no effect with "
@@ -422,6 +438,14 @@ def main(argv=None) -> int:
             # BETA - 1 delivers max load <= BETA*total/k + max_w
             # (tests/test_balance.py pins this bound)
             args.alpha = min(args.balance - 1.0, 1.0)
+            if args.refine and args.refine_alpha > args.balance:
+                # refinement caps parts at refine_alpha*ceil(V/k): a
+                # looser refine cap would silently void the --balance
+                # contract end-to-end (ADVICE r4), so clamp it to BETA
+                print(f"note: --balance {args.balance} clamps "
+                      f"--refine-alpha {args.refine_alpha} to the "
+                      f"contract bound", file=sys.stderr)
+                args.refine_alpha = args.balance
         ctor = {"alpha": args.alpha}
         if args.chunk_edges:
             ctor["chunk_edges"] = args.chunk_edges

@@ -14,21 +14,93 @@ hierarchical [8, 8] — see BASELINE.md "SBM quality".
 An EXTENSION beyond the reference's surface, like ops/refine.py; the
 flat pipeline and every parity artifact are untouched.
 
-Memory envelope: each level materializes each part's INTRA-part edges
-(cross edges are already cut and never revisited), so host memory is
-O(E_intra) = (1 - cut_so_far) * E for the bucketing pass plus one
-subgraph at a time. Streams too big for that should partition flat
-(the flat split has no such limit; this utility exists for cut QUALITY
-on community-structured graphs).
+Memory envelope (round 5, VERDICT r4 item 4): the level-1 bucketing
+SPILLS each part's intra edges to a per-part ``.bin32`` temp shard in
+one streaming pass — each chunk is grouped by part once (stable argsort
++ one boundary scan, not the old O(k1 * E) per-part mask pass — ADVICE
+r4) and written relabeled, so host memory is O(V + chunk) regardless of
+stream size and each induced subgraph is itself a file-backed stream.
+Disk high-water mark is 8 bytes per intra edge of the current level.
+
+Balance budgeting (VERDICT r4 item 4): pass ``balance=BETA`` to budget
+the end-to-end bound as beta_level = BETA**(1/levels) per level (each
+level's max-load factor multiplies, so per-level bounds compound to
+~BETA); per-level refine caps are clamped to the same budget.
+
+Level-1 leakage repair (VERDICT r4 item 3): ``final_refine=N`` runs N
+rounds of capacity-constrained LP at the FULL k with the hierarchical
+labels as warm start. The LP signal law objects to COLD starts at
+k >= 64 (per-part majority ~ intra_degree/k is tie-noise); a warm start
+only needs boundary repair, where the majority signal is local and
+strong.
 """
 
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
+
 import numpy as np
 
 
-def _hier_assign(stream, k_levels, backend, refine, chunk_edges,
-                 opts):
+_SPILL_MAX_FDS = 64
+
+
+def _spill_intra(stream, assign, k1, chunk_edges, tmpdir, local_id):
+    """One streaming pass: write each part's intra edges — relabeled to
+    the part's dense local ids — to ``tmpdir/p{p}.bin32``. Returns the
+    per-part file paths. O(chunk) transient memory; each chunk is
+    grouped by owning part once (stable argsort + boundary scan).
+
+    File handles are bounded by an LRU of ``_SPILL_MAX_FDS`` append-mode
+    handles (review finding: k1 simultaneous 1 MB-buffered handles at
+    --k-levels 1024,2 would blow both ulimit -n and the documented
+    O(V + chunk) envelope); each chunk writes one contiguous slice per
+    part, so reopen churn is at most one open per touched part per
+    chunk."""
+    from collections import OrderedDict
+
+    paths = [os.path.join(tmpdir, f"p{p}.bin32") for p in range(k1)]
+    for p in paths:  # every part gets a (possibly empty) shard file
+        open(p, "wb").close()
+    lru: OrderedDict[int, object] = OrderedDict()
+
+    def handle(p):
+        f = lru.get(p)
+        if f is not None:
+            lru.move_to_end(p)
+            return f
+        if len(lru) >= _SPILL_MAX_FDS:
+            _, old = lru.popitem(last=False)
+            old.close()
+        f = lru[p] = open(paths[p], "ab", buffering=1 << 16)
+        return f
+
+    try:
+        for c in stream.chunks(chunk_edges):
+            e = np.asarray(c, np.int64).reshape(-1, 2)
+            pu = assign[e[:, 0]]
+            keep = pu == assign[e[:, 1]]
+            e = e[keep]
+            pu = pu[keep]
+            if not len(e):
+                continue
+            grp = np.argsort(pu, kind="stable")
+            lo = local_id[e[grp]].astype(np.uint32)
+            bounds = np.searchsorted(pu[grp], np.arange(k1 + 1))
+            for p in range(k1):
+                a, b = bounds[p], bounds[p + 1]
+                if b > a:
+                    handle(p).write(lo[a:b].tobytes())
+    finally:
+        for f in lru.values():
+            f.close()
+    return paths
+
+
+def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
+                 chunk_edges, tmpdir, opts):
     """Assignment over ``stream`` at k = prod(k_levels), recursing."""
     from sheep_tpu import _partition_stream
     from sheep_tpu.io.edgestream import EdgeStream
@@ -38,92 +110,142 @@ def _hier_assign(stream, k_levels, backend, refine, chunk_edges,
     # score recomputes it once); chunk_edges forwards as the backends'
     # ctor option so the user's memory ceiling applies at every level
     res = _partition_stream(stream, k_levels[0], backend=backend,
-                            refine=refine, chunk_edges=chunk_edges,
+                            refine=refine, refine_alpha=refine_alpha,
+                            chunk_edges=chunk_edges,
                             **{**opts, "comm_volume": False})
-    assign = np.asarray(res.assignment, np.int64)
+    assign = np.asarray(res.assignment, np.int32)
     if len(k_levels) == 1:
-        return assign.astype(np.int32)
+        return assign
 
     k1 = k_levels[0]
     k_sub = int(np.prod(k_levels[1:]))
-    # one bucketing pass: intra-part edges per part (cross edges are
-    # final cut at this level and never revisited)
-    buckets: list[list[np.ndarray]] = [[] for _ in range(k1)]
-    for c in stream.chunks(chunk_edges):
-        e = np.asarray(c, np.int64).reshape(-1, 2)
-        pu = assign[e[:, 0]]
-        same = pu == assign[e[:, 1]]
-        for p in range(k1):
-            m = same & (pu == p)
-            if m.any():
-                buckets[p].append(e[m])
+    # dense local ids for every part in one O(V) pass: vertex v is the
+    # local_id[v]-th member of part assign[v]
+    order = np.argsort(assign, kind="stable")
+    counts = np.bincount(assign, minlength=k1).astype(np.int64)
+    offsets = np.zeros(k1 + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    local_id = np.empty(n, np.int32)
+    local_id[order] = (np.arange(n, dtype=np.int64)
+                       - np.repeat(offsets[:-1], counts)).astype(np.int32)
+
+    level_dir = tempfile.mkdtemp(prefix="lvl_", dir=tmpdir)
+    paths = _spill_intra(stream, assign, k1, chunk_edges, level_dir,
+                         local_id)
+    del local_id
 
     final = np.empty(n, np.int32)
-    for p in range(k1):
-        members = np.flatnonzero(assign == p)
-        if len(members) == 0:
-            continue
-        if len(members) <= k_sub:
-            # degenerate tiny part: round-robin so every vertex keeps a
-            # valid label in [0, k_sub)
-            final[members] = p * k_sub + np.arange(len(members)) % k_sub
-            continue
-        inv = np.full(n, -1, np.int64)       # dense relabel of the part
-        inv[members] = np.arange(len(members))
-        eb = (np.concatenate(buckets[p])
-              if buckets[p] else np.empty((0, 2), np.int64))
-        buckets[p] = []  # release the fragments as the loop advances
-        sub_edges = inv[eb] if len(eb) else eb
-        sub = EdgeStream.from_array(sub_edges, n_vertices=len(members))
-        sub_assign = _hier_assign(sub, k_levels[1:], backend, refine,
-                                  chunk_edges, opts)
-        final[members] = p * k_sub + sub_assign
+    try:
+        for p in range(k1):
+            members = order[offsets[p]:offsets[p + 1]]
+            if len(members) == 0:
+                continue
+            if len(members) <= k_sub:
+                # degenerate tiny part: round-robin keeps every label in
+                # [0, k_sub); final_refine repairs these choices where a
+                # better neighborhood exists
+                final[members] = p * k_sub + np.arange(len(members),
+                                                       dtype=np.int32) % k_sub
+                continue
+            sub = EdgeStream.open(paths[p], n_vertices=len(members))
+            sub_assign = _hier_assign(sub, k_levels[1:], backend, refine,
+                                      refine_alpha, chunk_edges, tmpdir,
+                                      opts)
+            final[members] = p * k_sub + sub_assign
+            os.remove(paths[p])  # subtree done: reclaim the shard early
+    finally:
+        shutil.rmtree(level_dir, ignore_errors=True)
     return final
 
 
 def partition_hierarchical(path, k_levels, backend=None, refine=8,
-                           chunk_edges: int = 1 << 22, **opts):
+                           refine_alpha: float = 1.10,
+                           chunk_edges: int = 1 << 22,
+                           balance: float | None = None,
+                           final_refine: int = 0,
+                           spill_dir: str | None = None, **opts):
     """Partition into prod(k_levels) parts, one level at a time.
 
     ``k_levels`` — e.g. ``[8, 8]`` for k=64. ``refine`` rounds apply at
     EVERY level (that is the point: each level stays above the LP
-    signal threshold). Extra ``opts`` are the usual backend/partition
-    options of :func:`sheep_tpu.partition`. Returns a PartitionResult
-    scored over the full stream at k = prod(k_levels); ``backend``
-    in the result is tagged ``+hier``.
+    signal threshold). ``balance=BETA`` budgets the end-to-end balance
+    bound as BETA**(1/levels) per level (mutually exclusive with an
+    explicit ``alpha``). ``final_refine=N`` adds N warm-start LP rounds
+    at the FULL k after assembly — the level-1 leakage repair. Extra
+    ``opts`` are the usual backend/partition options of
+    :func:`sheep_tpu.partition`. Returns a PartitionResult scored over
+    the full stream at k = prod(k_levels); ``backend`` in the result is
+    tagged ``+hier``.
     """
     from sheep_tpu.backends.base import score_stream
     from sheep_tpu.io.edgestream import open_input
 
-    from sheep_tpu import _resolve_backend
+    from sheep_tpu import _resolve_backend, comm_volume_of, refine_result
 
     k_levels = [int(k) for k in k_levels]
     if len(k_levels) < 1 or any(k < 1 for k in k_levels):
         raise ValueError(f"k_levels must be positive ints, got {k_levels}")
     k_total = int(np.prod(k_levels))
+    if balance is not None:
+        if balance <= 1.0:
+            raise ValueError(f"balance must be > 1, got {balance}")
+        if "alpha" in opts and opts["alpha"] != 1.0:
+            raise ValueError("balance sets the per-level alpha; do not "
+                             "also pass alpha")
+        beta_level = balance ** (1.0 / len(k_levels))
+        opts = {**opts, "alpha": min(beta_level - 1.0, 1.0)}
+        # per-level refine must not void the budget it refines under
+        refine_alpha = min(refine_alpha, beta_level)
     comm_volume = opts.get("comm_volume", True)
     inner_backend = _resolve_backend(backend, {})[0].name
 
-    with open_input(path) as es:
-        final = _hier_assign(es, k_levels, backend, refine, chunk_edges,
-                             dict(opts))
-        w = None
-        if opts.get("weights") == "degree":
-            # score with the same weights the levels balanced against,
-            # like partition()/partition_multi
-            n = es.num_vertices
-            w = np.zeros(n, dtype=np.int64)
-            for c in es.chunks(chunk_edges):
-                w += np.bincount(np.asarray(c, np.int64).ravel(),
-                                 minlength=n)[:n]
-        scored = score_stream(es, {k_total: final},
-                              chunk_edges=chunk_edges,
-                              comm_volume=comm_volume, weights=w)
-    cut, total, balance, cv = scored[k_total]
-    from sheep_tpu.types import PartitionResult
+    tmp_root = tempfile.mkdtemp(prefix="sheep_hier_", dir=spill_dir)
+    try:
+        with open_input(path) as es:
+            final = _hier_assign(es, k_levels, backend, refine,
+                                 refine_alpha, chunk_edges, tmp_root,
+                                 dict(opts))
+            w = None
+            if opts.get("weights") == "degree":
+                # score with the same weights the levels balanced
+                # against, like partition()/partition_multi
+                n = es.num_vertices
+                w = np.zeros(n, dtype=np.int64)
+                for c in es.chunks(chunk_edges):
+                    w += np.bincount(np.asarray(c, np.int64).ravel(),
+                                     minlength=n)[:n]
+            # with a final refine coming, the pre-refine comm volume
+            # would be recomputed and discarded — defer it to one pass
+            # over the FINAL assignment (review finding)
+            scored = score_stream(es, {k_total: final},
+                                  chunk_edges=chunk_edges,
+                                  comm_volume=comm_volume
+                                  and not final_refine, weights=w)
+            cut, total, balance_got, cv = scored[k_total]
+            from sheep_tpu.types import PartitionResult
 
-    return PartitionResult(
-        assignment=final, k=k_total, edge_cut=cut, total_edges=total,
-        cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
-        phase_times={}, backend=f"{inner_backend}+hier{k_levels}",
-        diagnostics={})
+            res = PartitionResult(
+                assignment=final, k=k_total, edge_cut=cut,
+                total_edges=total, cut_ratio=cut / max(total, 1),
+                balance=balance_got, comm_volume=cv,
+                phase_times={},
+                backend=f"{inner_backend}+hier{k_levels}",
+                diagnostics={})
+            if final_refine:
+                # warm-start boundary repair at the full k; the cap is
+                # the end-to-end budget when one was given. The degree
+                # table computed for scoring is reused, not re-streamed.
+                res = refine_result(
+                    res, es, rounds=final_refine,
+                    alpha=balance if balance is not None else refine_alpha,
+                    weights=opts.get("weights", "unit"), degrees=w)
+                if comm_volume:
+                    import dataclasses
+
+                    res = dataclasses.replace(
+                        res, comm_volume=comm_volume_of(
+                            res.assignment, es, es.num_vertices, k_total,
+                            chunk_edges))
+            return res
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)

@@ -500,19 +500,27 @@ def sbm_hash_range(scale: int, start: int, count: int, n_blocks: int,
     (bit-identical, ~100x numpy — at-scale quality runs re-stream the
     graph once per refine round); small ranges and toolchain-less hosts
     use numpy."""
+    nb = int(n_blocks)
+    # mirror SbmHashStream's check: this is a public entry point too
+    # (tests/tools call it directly), and nb=1 is a modulo-by-zero in
+    # _sbm_hash_uv (SIGFPE in the native path) while a non-power-of-two
+    # silently corrupts the block structure via the (nb-1) mask
+    if nb < 2 or nb & (nb - 1) or nb > (1 << scale):
+        raise ValueError(f"n_blocks must be a power of two in "
+                         f"[2, 2**scale], got {n_blocks}")
     keys = _sbm_hash_keys(seed)
-    block_bits = scale - (n_blocks.bit_length() - 1)
+    block_bits = scale - (nb.bit_length() - 1)
     if count >= 4096:
         from sheep_tpu.core import native
 
         if native.available() and native.has_sbm_hash():
             return native.sbm_hash_range(
                 start, count, keys, _rmat_hash_keys2(keys),
-                _sbm_t_out(p_out), n_blocks, block_bits)
+                _sbm_t_out(p_out), nb, block_bits)
     idx = start + np.arange(count, dtype=np.int64)
     elo = (idx & _M32).astype(np.uint32)
     ehi = (idx >> 32).astype(np.uint32)
-    u, v = _sbm_hash_uv(np, elo, ehi, keys, _sbm_t_out(p_out), n_blocks,
+    u, v = _sbm_hash_uv(np, elo, ehi, keys, _sbm_t_out(p_out), nb,
                         block_bits, np.int64)
     return np.stack([u, v], axis=1)
 

@@ -65,6 +65,52 @@ def test_validation():
         sheep_tpu.partition_hierarchical(SPEC, [])
 
 
+def test_balance_budget_compounds_to_beta():
+    # balance=BETA budgets BETA**(1/L) per level; delivered end-to-end
+    # balance must respect the product bound (plus the +max_w slack of
+    # each level's envelope — generous margin here)
+    res = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=2, balance=1.2,
+        comm_volume=False)
+    assert res.balance <= 1.2 + 0.05, res.balance
+    with pytest.raises(ValueError, match="balance"):
+        sheep_tpu.partition_hierarchical(SPEC, [4, 4], balance=0.9)
+    with pytest.raises(ValueError, match="alpha"):
+        sheep_tpu.partition_hierarchical(SPEC, [4, 4], balance=1.2,
+                                         alpha=0.5)
+
+
+def test_final_refine_never_worse():
+    base = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=2, comm_volume=False)
+    rep = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=2, final_refine=4,
+        comm_volume=False)
+    # warm-start LP at full k keeps the non-regression rollback
+    assert rep.edge_cut <= base.edge_cut, (rep.edge_cut, base.edge_cut)
+    a = rep.assignment
+    assert a.min() >= 0 and a.max() < 16
+
+
+def test_spill_matches_scoring_and_bounds_disk(tmp_path):
+    # the spilled file-backed recursion must produce a valid, internally
+    # consistent result (scored cut == recount over the raw stream), and
+    # the spill dir must be cleaned up afterwards
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    res = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=0, comm_volume=False,
+        spill_dir=str(spill))
+    from sheep_tpu.io.edgestream import open_input
+
+    a = res.assignment
+    with open_input(SPEC) as es:
+        cut = sum(int((a[np.asarray(c)[:, 0]] != a[np.asarray(c)[:, 1]])
+                      .sum()) for c in es.chunks(1 << 20))
+    assert cut == res.edge_cut
+    assert list(spill.iterdir()) == []  # temp tree removed
+
+
 def test_cli_k_levels(tmp_path, capsys):
     import json
 
@@ -87,6 +133,19 @@ def test_cli_k_levels(tmp_path, capsys):
     for argv in (["--input", p, "--k-levels", "2,2", "--k", "4"],
                  ["--input", p, "--k-levels", "2,x"],
                  ["--input", p, "--k-levels", "2,2",
-                  "--checkpoint-dir", str(tmp_path)]):
+                  "--checkpoint-dir", str(tmp_path)],
+                 # hierarchy-only flags are errors on the flat path
+                 ["--input", p, "--k", "4", "--final-refine", "2"],
+                 ["--input", p, "--k", "4", "--spill-dir", str(tmp_path)],
+                 # --balance with an explicit --alpha stays an error
+                 ["--input", p, "--k-levels", "2,2", "--balance", "1.2",
+                  "--alpha", "0.5"]):
         with pytest.raises(SystemExit):
             cli.main(argv)
+    # --balance and --final-refine now COMPOSE with --k-levels
+    rc = cli.main(["--input", p, "--k-levels", "2,2", "--backend", "pure",
+                   "--refine", "2", "--balance", "1.2",
+                   "--final-refine", "2", "--no-comm-volume", "--json"])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["k"] == 4 and line["balance"] <= 1.25

@@ -70,8 +70,25 @@ def main():
                          "full vertex scale within 125 GB; per-device "
                          "collective counts for D=8 come from "
                          "build_stats at smaller V (BASELINE.md)")
+    ap.add_argument("--hoist-bytes", type=int, default=None,
+                    help="per-device budget for the per-segment stale "
+                         "lifting stack. The s28+ class is the "
+                         "V-dominant regime (B >> Q) BASELINE.md "
+                         "reserves hoisting for: squarings are paid "
+                         "once per segment instead of every round")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="per-batch checkpointing via utils/checkpoint "
+                         "(VERDICT r4 item 2: the s28 run needs to span "
+                         "sessions); pass with --resume to continue")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--ckpt-every", type=int, default=1,
+                    help="checkpoint cadence in CHUNKS (a D-device batch "
+                         "consumes D chunks; 1 = every batch)")
     ap.add_argument("--skip-oracle", action="store_true")
     args = ap.parse_args()
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir (without it the "
+                 "run would silently restart from scratch)")
 
     nd = max(8, args.devices)
     os.environ["XLA_FLAGS"] = (
@@ -111,14 +128,22 @@ def main():
     result["lift_levels"] = args.lift_levels
     result["segment_rounds"] = args.segment_rounds
     result["jumps"] = args.jumps
+    result["hoist_bytes"] = args.hoist_bytes
+    ckpt = None
+    if args.checkpoint_dir:
+        from sheep_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir, every=args.ckpt_every)
     t0 = time.perf_counter()
     # through the REGISTERED backend (vertex-range check, chunk clamping,
     # PartitionResult packaging), not a hand-wired pipeline
     big = get_backend(
         "tpu-bigv", chunk_edges=args.chunk_edges, jumps=args.jumps,
         segment_rounds=args.segment_rounds, n_devices=args.devices,
-        lift_levels=args.lift_levels).partition(
-            stream(), args.k, comm_volume=False)
+        lift_levels=args.lift_levels,
+        hoist_bytes=args.hoist_bytes).partition(
+            stream(), args.k, comm_volume=False,
+            checkpointer=ckpt, resume=args.resume)
     # the backend clamps chunk_edges for small streams; its diagnostics
     # carry the value actually run, so cross-round artifact comparisons
     # don't attribute a hidden chunk-size change to code changes
@@ -157,8 +182,12 @@ def main():
     # write the artifact BEFORE any equality verdicting exits: a
     # multi-hour disagreeing run must still leave its evidence on disk
     # (oracle_equal: false), not vanish into an AssertionError
+    # key the artifact by mesh size when it differs from the default
+    # (ADVICE r4: a rerun at another D is a semantically different run
+    # and must not clobber committed evidence)
+    tag = "" if args.devices == 2 else f"_d{args.devices}"
     out = os.path.join(REPO, "tools", "out", "soak",
-                       f"bigv_s{args.scale}.json")
+                       f"bigv_s{args.scale}{tag}.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
