@@ -40,9 +40,12 @@ import jax.numpy as jnp
 
 @partial(jax.jit, static_argnames=("n", "k"))
 def neighbor_hist_chunk(hist: jax.Array, chunk: jax.Array,
-                        assign: jax.Array, n: int, k: int) -> jax.Array:
+                        assign: jax.Array, n: int, k: int):
     """Accumulate one (C, 2) edge chunk into the (n+1, k) neighbor-part
-    histogram (row n absorbs padding/self-loops)."""
+    histogram (row n absorbs padding/self-loops). Also returns this
+    chunk's (cut, total) under the SAME validity mask — the score is a
+    free by-product of the lookups the histogram already does, which
+    lets the refine loop drop its separate per-round scoring pass."""
     e = chunk.astype(jnp.int32)
     u, v = e[:, 0], e[:, 1]
     valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
@@ -50,8 +53,10 @@ def neighbor_hist_chunk(hist: jax.Array, chunk: jax.Array,
     pv = assign[jnp.clip(v, 0, n)]
     iu = jnp.where(valid, u, n)
     iv = jnp.where(valid, v, n)
+    cut = jnp.sum(valid & (pu != pv), dtype=jnp.int32)
+    total = jnp.sum(valid, dtype=jnp.int32)
     hist = hist.at[iu, pv].add(1, mode="drop")
-    return hist.at[iv, pu].add(1, mode="drop")
+    return hist.at[iv, pu].add(1, mode="drop"), cut, total
 
 
 @partial(jax.jit, static_argnames=("n", "k", "vb"))
@@ -71,12 +76,21 @@ def neighbor_hist_block(hist: jax.Array, chunk: jax.Array,
         idx = jnp.where((local >= 0) & (local < vb), local, vb)
         return h.at[idx, p].add(1, mode="drop")
 
-    return upd(upd(hist, u, pv), v, pu)
+    cut = jnp.sum(valid & (pu != pv), dtype=jnp.int32)
+    total = jnp.sum(valid, dtype=jnp.int32)
+    return upd(upd(hist, u, pv), v, pu), cut, total
 
 
 @partial(jax.jit, static_argnames=())
 def hist_stats(hist: jax.Array, cur_part: jax.Array):
-    """(rows, k) histogram -> (best part, best count, current count)."""
+    """(rows, k) histogram -> (best part, best count, current count).
+
+    ``current count`` doubles as the free cut measurement: summed over
+    the real vertex rows it is 2 x intra edges (each intra edge (u, v)
+    lands once in hist[u, p] and once in hist[v, p] with p the shared
+    part), and the histogram's total over those rows is 2 x valid edges
+    — the hist pass and score_chunk share the exact same validity mask,
+    so ``cut = (hist_total - cur_total) // 2`` equals a scoring pass."""
     best = jnp.argmax(hist, axis=1).astype(jnp.int32)
     bestv = jnp.max(hist, axis=1)
     cur = jnp.take_along_axis(hist, cur_part[:, None].astype(jnp.int32),
@@ -180,13 +194,72 @@ def plan_moves_host(best: np.ndarray, gain: np.ndarray, assign: np.ndarray,
     return np.where(allowed, best, cur).astype(np.int32)
 
 
+def spool_stream(stream, n: int, chunk_edges: int = 1 << 22,
+                 spool_dir: str = None):
+    """Materialize a regeneration-expensive stream to a temp binary file
+    once, returning (file_backed_stream, temp_path). Generator/counter-
+    hash streams re-pay generation on EVERY pass (~all of refine's cost
+    at soak scale — BASELINE.md refine table); a multi-pass consumer
+    spools once and reads at disk/page-cache speed instead. Returns
+    (stream, None) unchanged on any spooling failure (e.g. ENOSPC) —
+    spooling is an optimization, never a requirement."""
+    import os
+    import tempfile
+
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    wide = n > 0xFFFFFFFF
+    dt = np.uint64 if wide else np.uint32
+    # never commit to a write the disk can't hold: a known edge bound
+    # must fit in (half of) the spool dir's free space; an unknown bound
+    # skips spooling (better to re-generate than to fill a tmpfs /tmp)
+    import shutil
+    import sys
+
+    ub = getattr(stream, "num_edges_upper_bound", None)
+    target = spool_dir or tempfile.gettempdir()
+    need = None if ub is None else 2 * dt().itemsize * ub
+    try:
+        free = shutil.disk_usage(target).free
+    except OSError:
+        free = 0
+    if need is None or need > free // 2:
+        print(f"refine: not spooling ({'unknown edge bound' if need is None else f'{need >> 20} MiB needed, {free >> 20} MiB free'})",
+              file=sys.stderr)
+        return stream, None
+    fd = None
+    path = None
+    try:
+        fd, path = tempfile.mkstemp(
+            suffix=".bin64" if wide else ".bin32",
+            prefix="sheep_spool_", dir=spool_dir)
+        with os.fdopen(fd, "wb", buffering=1 << 20) as f:
+            fd = None
+            for c in stream.chunks(chunk_edges):
+                f.write(np.ascontiguousarray(
+                    np.asarray(c, np.int64).astype(dt)).tobytes())
+        return EdgeStream.open(path, n_vertices=n), path
+    except OSError as e:
+        print(f"refine: stream spool failed ({e}); streaming direct",
+              file=sys.stderr)
+        if fd is not None:
+            os.close(fd)
+        if path is not None:
+            try:
+                os.unlink(path)  # never leak the partial write
+            except OSError:
+                pass
+        return stream, None
+
+
 def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
                       rounds: int = 3, alpha: float = 1.10,
                       chunk_edges: int = 1 << 22,
                       budget_bytes: int = 4 << 30,
                       plan_budget_bytes: int = 4 << 30,
                       min_block: int = 1 << 16,
-                      weights: np.ndarray = None):
+                      weights: np.ndarray = None,
+                      spool: bool = True, spool_dir: str = None):
     """Refine a host assignment in place-semantics; returns
     (new_assign, refine_stats).
 
@@ -195,7 +268,40 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
     stops. The balance cap is ``alpha * ceil(n / k)`` vertices per part
     (with ``weights``: ``alpha * total_weight / k`` per part) — parts
     already above it only shrink.
+
+    Refinement makes 2*rounds + 1 stream passes in full-histogram mode
+    (each round's first histogram pass doubles as the previous round's
+    scoring pass — the score reductions are fused into the histogram
+    kernel) and 1 + rounds*(2*blocks + 1) in vertex-blocked mode (a
+    dedicated 1-pass score stays cheaper there than a blocks-wide
+    histogram pass). When the input is a generator/counter-hash stream
+    (``fmt == "generator"``) it is spooled to a temp binary file first
+    (``spool=False`` opts out, and streams whose edge bound is unknown
+    or exceeds half the spool dir's free space stream direct) — one
+    generation pass instead of one per refine pass (VERDICT r4 item 6).
     """
+    import os
+
+    spool_path = None
+    if spool and getattr(stream, "fmt", None) == "generator":
+        stream, spool_path = spool_stream(stream, n, chunk_edges,
+                                          spool_dir)
+    try:
+        out, stats = _refine_impl(assign, stream, n, k, rounds, alpha,
+                                  chunk_edges, budget_bytes,
+                                  plan_budget_bytes, min_block, weights)
+        stats["refine_spooled"] = int(spool_path is not None)
+        return out, stats
+    finally:
+        if spool_path:
+            try:
+                os.unlink(spool_path)
+            except OSError:
+                pass
+
+
+def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
+                 budget_bytes, plan_budget_bytes, min_block, weights):
     from sheep_tpu.backends.tpu_backend import pad_chunk
     from sheep_tpu.ops import score as score_ops
 
@@ -213,32 +319,39 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
         if vb >= n + 1:
             vb = 0
 
-    def score(a_dev):
-        cut = total = 0
+    def score(a_try):
+        """Exact edge cut of ``a_try`` in ONE stream pass (blocked mode
+        scores with this instead of a blocks-wide histogram pass)."""
+        cuts = []
         for c in stream.chunks(chunk_edges):
-            cc, tt = score_ops.score_chunk(
-                jnp.asarray(pad_chunk(c, chunk_edges, n)), a_dev, n)
-            cut += int(cc)
-            total += int(tt)
-        return cut, total
+            cc, _ = score_ops.score_chunk(
+                jnp.asarray(pad_chunk(c, chunk_edges, n)), a_try, n)
+            cuts.append(cc)
+        return sum(int(c) for c in cuts)
 
     def gains(a_try):
-        """(best, gain) over all vertices — one histogram pass, or
-        ceil(V/vb) blocked passes when the full table exceeds budget."""
+        """(best, gain, cut) over all vertices — one histogram pass, or
+        ceil(V/vb) blocked passes when the full table exceeds budget.
+        In full mode ``cut`` is the exact edge cut of ``a_try``'s
+        labels, a free by-product of the pass (fused score reductions,
+        synced once after the loop so dispatch stays pipelined); blocked
+        mode returns cut=None — its score-only points use score()."""
         if not vb:
+            cuts = []
             hist = jnp.zeros((n + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
-                hist = neighbor_hist_chunk(
+                hist, cc, _ = neighbor_hist_chunk(
                     hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
                     a_try, n, k)
+                cuts.append(cc)
             b, bv, cur = hist_stats(hist, a_try)
-            return b, bv - cur
+            return b, bv - cur, sum(int(c) for c in cuts)
         best_h = np.zeros(n + 1, np.int32)
         gain_h = np.zeros(n + 1, np.int32)
         for base in range(0, n + 1, vb):
             hist = jnp.zeros((vb + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
-                hist = neighbor_hist_block(
+                hist, _, _ = neighbor_hist_block(
                     hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
                     a_try, jnp.int32(base), n, k, vb)
             rows = a_try[base:base + vb]
@@ -249,7 +362,21 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
             span = min(vb, n + 1 - base)
             best_h[base:base + span] = np.asarray(b)[:span]
             gain_h[base:base + span] = np.asarray(bv - cur)[:span]
-        return jnp.asarray(best_h), jnp.asarray(gain_h)
+        return jnp.asarray(best_h), jnp.asarray(gain_h), None
+
+    def plan(b, g, a_try, parity):
+        if host_plan:
+            w_host = None if weights is None \
+                else np.concatenate([np.asarray(weights, np.float32),
+                                     np.zeros(1, np.float32)])
+            return jnp.asarray(plan_moves_host(
+                np.asarray(b), np.asarray(g), np.asarray(a_try),
+                float(cap) if weights is not None else int(cap),
+                parity, n, k, w=w_host))
+        if weights is not None:
+            return plan_moves_weighted(b, g, a_try, w_dev, cap,
+                                       parity, n, k)
+        return plan_moves(b, g, a_try, cap, parity, n, k)
 
     a_dev = jnp.asarray(np.concatenate(
         [np.asarray(assign, np.int32), np.zeros(1, np.int32)]))
@@ -259,32 +386,41 @@ def refine_assignment(assign: np.ndarray, stream, n: int, k: int,
         cap = jnp.float32(alpha * float(np.sum(weights)) / k)
     else:
         cap = jnp.int32(int(alpha * (-(-n // k))))
-    best_cut, total = score(a_dev)
-    stats = {"refine_rounds_run": 0, "refine_cut_before": best_cut,
+
+    # Full-histogram mode runs 2R+1 passes instead of the old 1+3R:
+    # each round's FIRST histogram pass also scores the previous round's
+    # result (same labels), so the separate scoring pass is gone and the
+    # rollback decision just moves to the top of the next iteration.
+    # Trajectory is unchanged: parity-0 moves are planned from the
+    # identical histogram that scored the accepted labels. Blocked mode
+    # keeps a dedicated 1-pass score (a histogram "pass" there costs
+    # ``blocks`` stream passes, so fusing would REGRESS pass counts —
+    # review finding) for the same 1 + R*(2*blocks + 1) as before.
+    stats = {"refine_rounds_run": 0,
              "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1,
              "refine_host_plan": int(host_plan)}
-    best = a_dev
-    for _ in range(rounds):
-        a_try = best
-        for parity in (0, 1):
-            b, g = gains(a_try)
-            if host_plan:
-                w_host = None if weights is None \
-                    else np.concatenate([np.asarray(weights, np.float32),
-                                         np.zeros(1, np.float32)])
-                a_try = jnp.asarray(plan_moves_host(
-                    np.asarray(b), np.asarray(g), np.asarray(a_try),
-                    float(cap) if weights is not None else int(cap),
-                    parity, n, k, w=w_host))
-            elif weights is not None:
-                a_try = plan_moves_weighted(b, g, a_try, w_dev, cap,
-                                            parity, n, k)
-            else:
-                a_try = plan_moves(b, g, a_try, cap, parity, n, k)
-        cut, _ = score(a_try)
-        if cut >= best_cut:
+    best = a_try = a_dev
+    best_cut = None
+    for it in range(rounds + 1):
+        if vb:
+            b = g = None
+            cut_now = score(a_try)
+        else:
+            b, g, cut_now = gains(a_try)
+        if best_cut is None:
+            best_cut = cut_now
+            stats["refine_cut_before"] = cut_now
+        elif cut_now < best_cut:
+            best_cut, best = cut_now, a_try
+            stats["refine_rounds_run"] += 1
+        else:
             break  # roll back this round; refined result never regresses
-        best_cut, best = cut, a_try
-        stats["refine_rounds_run"] += 1
+        if it == rounds:
+            break
+        if vb:
+            b, g, _ = gains(a_try)
+        a_try = plan(b, g, a_try, 0)
+        b, g, _ = gains(a_try)
+        a_try = plan(b, g, a_try, 1)
     stats["refine_cut_after"] = best_cut
     return np.asarray(best[:n]), stats
