@@ -76,9 +76,7 @@ def neighbor_hist_block(hist: jax.Array, chunk: jax.Array,
         idx = jnp.where((local >= 0) & (local < vb), local, vb)
         return h.at[idx, p].add(1, mode="drop")
 
-    cut = jnp.sum(valid & (pu != pv), dtype=jnp.int32)
-    total = jnp.sum(valid, dtype=jnp.int32)
-    return upd(upd(hist, u, pv), v, pu), cut, total
+    return upd(upd(hist, u, pv), v, pu)
 
 
 @partial(jax.jit, static_argnames=())
@@ -239,16 +237,20 @@ def spool_stream(stream, n: int, chunk_edges: int = 1 << 22,
                 f.write(np.ascontiguousarray(
                     np.asarray(c, np.int64).astype(dt)).tobytes())
         return EdgeStream.open(path, n_vertices=n), path
-    except OSError as e:
-        print(f"refine: stream spool failed ({e}); streaming direct",
-              file=sys.stderr)
+    except BaseException as e:
+        # NEVER leak the partial write — also on non-OSError failures
+        # raised by the source stream itself mid-spool (review finding)
         if fd is not None:
             os.close(fd)
         if path is not None:
             try:
-                os.unlink(path)  # never leak the partial write
+                os.unlink(path)
             except OSError:
                 pass
+        if not isinstance(e, OSError):
+            raise  # a broken SOURCE is the caller's problem, not spool's
+        print(f"refine: stream spool failed ({e}); streaming direct",
+              file=sys.stderr)
         return stream, None
 
 
@@ -351,7 +353,7 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
         for base in range(0, n + 1, vb):
             hist = jnp.zeros((vb + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
-                hist, _, _ = neighbor_hist_block(
+                hist = neighbor_hist_block(
                     hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
                     a_try, jnp.int32(base), n, k, vb)
             rows = a_try[base:base + vb]

@@ -1,0 +1,48 @@
+"""Balance/cut frontier sweep (VERDICT r4 item 5): BETA in {1.1, 1.25,
+1.5, 2.0} (as alpha = BETA - 1) plus the alpha=1.0 default, across the
+eval graph families, cpu + tpu backends. Cut/balance are deterministic
+per config; walls are not recorded (sweeps run contended). Decides the
+default-alpha question with data -> tools/out/soak/balance_frontier.json
+and the BASELINE.md table."""
+import json, os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from sheep_tpu.utils.platform import pin_platform
+pin_platform("cpu")
+import sheep_tpu
+
+GRAPHS = [
+    ("karate", "GOLDEN", 2),            # eval config 1
+    ("rmat-hash:14:8:5", None, 64),     # expander-like, config 3 shape class
+    ("sbm-hash:12:8:0.05:16:1", None, 8),  # community-structured, config 2 class
+]
+ALPHAS = [("default_1.0", 1.0), ("beta_2.0", 1.0), ("beta_1.5", 0.5),
+          ("beta_1.25", 0.25), ("beta_1.1", 0.1)]
+
+def main():
+    import tempfile
+    from sheep_tpu.io import formats, generators
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        kpath = os.path.join(td, "karate.edges")
+        formats.write_edges(kpath, generators.karate_club())
+        for gname, marker, k in GRAPHS:
+            path = kpath if marker == "GOLDEN" else gname
+            for be in ("cpu", "tpu"):
+                if be not in sheep_tpu.list_backends():
+                    continue
+                for aname, alpha in ALPHAS:
+                    r = sheep_tpu.partition(path, k, backend=be,
+                                            alpha=alpha, comm_volume=False)
+                    rows.append({"graph": gname, "k": k, "backend": be,
+                                 "config": aname, "alpha": alpha,
+                                 "cut_ratio": round(r.cut_ratio, 5),
+                                 "balance": round(float(r.balance), 4)})
+                    print(json.dumps(rows[-1]), flush=True)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "out", "soak", "balance_frontier.json")
+    with open(out, "w") as f:
+        json.dump(rows, f, indent=1)
+    print("written", out)
+
+if __name__ == "__main__":
+    main()
