@@ -284,7 +284,6 @@ def main(argv=None) -> int:
         ignored = [f for f, v in (
             ("--metrics-out", args.metrics_out),
             ("--profile-dir", args.profile_dir),
-            ("--num-vertices", args.num_vertices),
             ("--segment-rounds", args.segment_rounds),
             ("--warm-schedule", args.warm_schedule),
             ("--host-tail-threshold", args.host_tail_threshold),
@@ -314,7 +313,7 @@ def main(argv=None) -> int:
             chunk_edges=args.chunk_edges or (1 << 22),
             comm_volume=not args.no_comm_volume, weights=args.weights,
             balance=args.balance, final_refine=args.final_refine,
-            spill_dir=args.spill_dir,
+            spill_dir=args.spill_dir, n_vertices=args.num_vertices,
             **({} if args.balance is not None else
                {"alpha": args.alpha}))
         wall = time.perf_counter() - t0

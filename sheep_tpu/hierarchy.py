@@ -163,7 +163,8 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                            chunk_edges: int = 1 << 22,
                            balance: float | None = None,
                            final_refine: int = 0,
-                           spill_dir: str | None = None, **opts):
+                           spill_dir: str | None = None,
+                           n_vertices: int | None = None, **opts):
     """Partition into prod(k_levels) parts, one level at a time.
 
     ``k_levels`` — e.g. ``[8, 8]`` for k=64. ``refine`` rounds apply at
@@ -201,7 +202,9 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
 
     tmp_root = tempfile.mkdtemp(prefix="sheep_hier_", dir=spill_dir)
     try:
-        with open_input(path) as es:
+        # headerless binary formats otherwise pay a full stream scan
+        # just to learn V (30 GB at the uk-class soak)
+        with open_input(path, n_vertices=n_vertices) as es:
             final = _hier_assign(es, k_levels, backend, refine,
                                  refine_alpha, chunk_edges, tmp_root,
                                  dict(opts))
