@@ -100,12 +100,24 @@ def _spill_intra(stream, assign, k1, chunk_edges, tmpdir, local_id):
 
 
 def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
-                 chunk_edges, tmpdir, opts):
-    """Assignment over ``stream`` at k = prod(k_levels), recursing."""
+                 chunk_edges, tmpdir, opts, timings=None,
+                 spill_bytes=None, depth=0):
+    """Assignment over ``stream`` at k = prod(k_levels), recursing.
+    ``timings`` (top-level dict) accumulates per-depth partition/spill
+    walls under ``level{d}_partition`` / ``level{d}_spill`` keys;
+    ``spill_bytes`` (its own dict — bytes are not seconds) accumulates
+    per-depth spilled-shard sizes."""
+    import time
+
     from sheep_tpu import _partition_stream
     from sheep_tpu.io.edgestream import EdgeStream
 
+    def t_add(key, dt):
+        if timings is not None:
+            timings[key] = round(timings.get(key, 0.0) + dt, 3)
+
     n = stream.num_vertices
+    t0 = time.perf_counter()
     # comm volume of inner levels is discarded (the final full-stream
     # score recomputes it once); chunk_edges forwards as the backends'
     # ctor option so the user's memory ceiling applies at every level
@@ -114,6 +126,7 @@ def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
                             chunk_edges=chunk_edges,
                             **{**opts, "comm_volume": False})
     assign = np.asarray(res.assignment, np.int32)
+    t_add(f"level{depth}_partition", time.perf_counter() - t0)
     if len(k_levels) == 1:
         return assign
 
@@ -130,8 +143,14 @@ def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
                        - np.repeat(offsets[:-1], counts)).astype(np.int32)
 
     level_dir = tempfile.mkdtemp(prefix="lvl_", dir=tmpdir)
+    t0 = time.perf_counter()
     paths = _spill_intra(stream, assign, k1, chunk_edges, level_dir,
                          local_id)
+    t_add(f"level{depth}_spill", time.perf_counter() - t0)
+    if spill_bytes is not None:
+        key = f"level{depth}_spill_bytes"
+        spill_bytes[key] = spill_bytes.get(key, 0) + sum(
+            os.path.getsize(p) for p in paths)
     del local_id
 
     final = np.empty(n, np.int32)
@@ -150,7 +169,9 @@ def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
             sub = EdgeStream.open(paths[p], n_vertices=len(members))
             sub_assign = _hier_assign(sub, k_levels[1:], backend, refine,
                                       refine_alpha, chunk_edges, tmpdir,
-                                      opts)
+                                      opts, timings=timings,
+                                      spill_bytes=spill_bytes,
+                                      depth=depth + 1)
             final[members] = p * k_sub + sub_assign
             os.remove(paths[p])  # subtree done: reclaim the shard early
     finally:
@@ -200,30 +221,40 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
     comm_volume = opts.get("comm_volume", True)
     inner_backend = _resolve_backend(backend, {})[0].name
 
+    import time
+
     tmp_root = tempfile.mkdtemp(prefix="sheep_hier_", dir=spill_dir)
+    timings: dict = {}
+    spill_bytes: dict = {}
     try:
         # headerless binary formats otherwise pay a full stream scan
         # just to learn V (30 GB at the uk-class soak)
         with open_input(path, n_vertices=n_vertices) as es:
             final = _hier_assign(es, k_levels, backend, refine,
                                  refine_alpha, chunk_edges, tmp_root,
-                                 dict(opts))
+                                 dict(opts), timings=timings,
+                                 spill_bytes=spill_bytes)
             w = None
             if opts.get("weights") == "degree":
                 # score with the same weights the levels balanced
                 # against, like partition()/partition_multi
+                t0 = time.perf_counter()
                 n = es.num_vertices
                 w = np.zeros(n, dtype=np.int64)
                 for c in es.chunks(chunk_edges):
                     w += np.bincount(np.asarray(c, np.int64).ravel(),
                                      minlength=n)[:n]
+                timings["degrees_weights"] = round(
+                    time.perf_counter() - t0, 3)
             # with a final refine coming, the pre-refine comm volume
             # would be recomputed and discarded — defer it to one pass
             # over the FINAL assignment (review finding)
+            t0 = time.perf_counter()
             scored = score_stream(es, {k_total: final},
                                   chunk_edges=chunk_edges,
                                   comm_volume=comm_volume
                                   and not final_refine, weights=w)
+            timings["score"] = round(time.perf_counter() - t0, 3)
             cut, total, balance_got, cv = scored[k_total]
             from sheep_tpu.types import PartitionResult
 
@@ -231,24 +262,30 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                 assignment=final, k=k_total, edge_cut=cut,
                 total_edges=total, cut_ratio=cut / max(total, 1),
                 balance=balance_got, comm_volume=cv,
-                phase_times={},
+                phase_times=timings,
                 backend=f"{inner_backend}+hier{k_levels}",
-                diagnostics={})
+                diagnostics=spill_bytes)
             if final_refine:
                 # warm-start boundary repair at the full k; the cap is
                 # the end-to-end budget when one was given. The degree
                 # table computed for scoring is reused, not re-streamed.
+                t0 = time.perf_counter()
                 res = refine_result(
                     res, es, rounds=final_refine,
                     alpha=balance if balance is not None else refine_alpha,
                     weights=opts.get("weights", "unit"), degrees=w)
+                res.phase_times["final_refine"] = round(
+                    time.perf_counter() - t0, 3)
                 if comm_volume:
                     import dataclasses
 
+                    t0 = time.perf_counter()
                     res = dataclasses.replace(
                         res, comm_volume=comm_volume_of(
                             res.assignment, es, es.num_vertices, k_total,
                             chunk_edges))
+                    res.phase_times["comm_volume"] = round(
+                        time.perf_counter() - t0, 3)
             return res
     finally:
         shutil.rmtree(tmp_root, ignore_errors=True)
