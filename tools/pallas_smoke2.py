@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Second-round Mosaic lowering smoke: 2D gather forms.
+
+The round-5 first smoke (tools/pallas_smoke.py) got a definitive
+rejection for the 1D form: ``NotImplementedError: Only 2D gather is
+supported`` (tools/out/20260801T083204/pallas_smoke.json). That error
+names the supported surface, so this probe enumerates the candidate 2D
+forms and tries to LOWER each on the real chip (seconds apiece, no
+execution beyond a tiny correctness check for the ones that compile):
+
+  A. row-take:        table (R,128), idx (B,)    -> out (B,128)
+                      jnp.take(table, idx, axis=0)
+  B. sublane-gather:  table (R,128), idx (8,128) -> out (8,128)
+                      take_along_axis(table, idx, axis=0)
+  C. lane-gather:     x (8,128), idx (8,128)     -> out (8,128)
+                      take_along_axis(x, idx, axis=1)
+  D. composite scalar gather: arbitrary 1D idx via row=idx>>7 /
+     col=idx&127 — sublane-gather the rows (B broadcast across lanes),
+     then lane-gather the column (col broadcast), then take lane 0.
+     8 arbitrary gathers per two (8,128) VPU gathers from a
+     VMEM-resident table; if this lowers AND beats ~150 M elem/s it is
+     the single-chip R >= 1 escape hatch (BASELINE.md re-negotiation).
+
+Writes one JSON line per form: {form, lowered, error?, ok?, melems?}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _specs(pl, pltpu, shapes, out_shape):
+    kw = {"memory_space": pltpu.VMEM} if pltpu else {}
+    in_specs = [pl.BlockSpec(s, lambda i, r=len(s): (0,) * r, **kw)
+                for s in shapes]
+    out_specs = pl.BlockSpec(out_shape,
+                             lambda i, r=len(out_shape): (0,) * r, **kw)
+    return in_specs, out_specs
+
+
+INTERPRET = "--interpret" in sys.argv
+
+
+def try_form(name, kernel, in_arrays, out_shape_dtype, check=None):
+    import jax
+    from jax.experimental import pallas as pl
+
+    pltpu = None
+    if not INTERPRET:
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+        except Exception:
+            pltpu = None
+
+    rec = {"form": name}
+    try:
+        in_specs, out_specs = _specs(
+            pl, pltpu, [a.shape for a in in_arrays], out_shape_dtype.shape)
+        call = pl.pallas_call(
+            kernel, grid=(1,), in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape_dtype, interpret=INTERPRET)
+        t0 = time.perf_counter()
+        lowered = jax.jit(call).lower(*in_arrays)
+        compiled = lowered.compile()
+        rec["lowered"] = True
+        rec["compile_s"] = round(time.perf_counter() - t0, 2)
+        out = np.asarray(compiled(*in_arrays))
+        if check is not None:
+            rec["ok"] = bool(check(out))
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}".splitlines()[0][:300]
+        if rec.get("lowered"):
+            # lowering succeeded; the failure is at run time — that is a
+            # different (and better) answer than "does not lower"
+            rec["run_error"] = msg
+        else:
+            rec["lowered"] = False
+            rec["error"] = msg
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    plat = jax.devices()[0].platform
+    print(json.dumps({"platform": plat,
+                      "device": str(jax.devices()[0])}), flush=True)
+
+    R, B = 4096, 1024
+    rng = np.random.default_rng(0)
+    table2 = jnp.asarray(
+        rng.integers(0, 1 << 30, (R, 128), dtype=np.int32))
+    tnp = np.asarray(table2)
+
+    # A: row-take
+    idxA = jnp.asarray(rng.integers(0, R, (B,), dtype=np.int32))
+    try_form(
+        "A_row_take",
+        lambda t, i, o: o.__setitem__(
+            ..., jnp.take(t[...], i[...], axis=0, mode="clip")),
+        [table2, idxA],
+        jax.ShapeDtypeStruct((B, 128), jnp.int32),
+        check=lambda out: np.array_equal(out, tnp[np.asarray(idxA)]))
+
+    # B: sublane gather (axis=0), idx same shape as a (8,128) tile
+    idxB = jnp.asarray(rng.integers(0, R, (8, 128), dtype=np.int32))
+    try_form(
+        "B_sublane_gather",
+        lambda t, i, o: o.__setitem__(
+            ..., jnp.take_along_axis(t[...], i[...], axis=0)),
+        [table2, idxB],
+        jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        check=lambda out: np.array_equal(
+            out, np.take_along_axis(tnp, np.asarray(idxB), axis=0)))
+
+    # C: lane gather (axis=1) on one (8,128) tile
+    x8 = jnp.asarray(rng.integers(0, 1 << 30, (8, 128), dtype=np.int32))
+    idxC = jnp.asarray(rng.integers(0, 128, (8, 128), dtype=np.int32))
+    try_form(
+        "C_lane_gather",
+        lambda x, i, o: o.__setitem__(
+            ..., jnp.take_along_axis(x[...], i[...], axis=1)),
+        [x8, idxC],
+        jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        check=lambda out: np.array_equal(
+            out, np.take_along_axis(np.asarray(x8), np.asarray(idxC),
+                                    axis=1)))
+
+    # D: composite arbitrary-index scalar gather, 8 per two 2D gathers.
+    # idx (S, 8) int32 in [0, R*128); out (S, 8).
+    S = 64
+    idxD = jnp.asarray(rng.integers(0, R * 128, (S, 8), dtype=np.int32))
+
+    def kernel_D(t, i, o):
+        def one(s, _):
+            g = i[s, :]                        # (8,) arbitrary indices
+            row = (g >> 7).reshape(8, 1)       # broadcast rows across lanes
+            col = (g & 127).reshape(8, 1)
+            rows8 = jnp.take_along_axis(
+                t[...], jnp.broadcast_to(row, (8, 128)), axis=0)
+            z = jnp.take_along_axis(
+                rows8, jnp.broadcast_to(col, (8, 128)), axis=1)
+            o[s, :] = z[:, 0]
+            return _
+
+        import jax.lax as lax
+
+        lax.fori_loop(0, S, one, 0)
+
+    try_form(
+        "D_composite_scalar",
+        kernel_D,
+        [table2, idxD],
+        jax.ShapeDtypeStruct((S, 8), jnp.int32),
+        check=lambda out: np.array_equal(
+            out, tnp.reshape(-1)[np.asarray(idxD)]))
+
+
+if __name__ == "__main__":
+    main()
