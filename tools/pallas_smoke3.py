@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Third Mosaic probe: how wide can the lane-gather go?
+
+On-chip facts so far (tools/out/20260801T083204/pallas_smoke2.jsonl):
+lane gather (take_along_axis axis=1 on one (8,128) tile) LOWERS and is
+correct; every sublane-gather form (axis=0 with a multi-tile row
+extent) dies in a Mosaic assertion. So the only lowered primitive
+gathers WITHIN 128 lanes.
+
+The escape hatch that needs exactly one more fact: store the position
+table TRANSPOSED, t_T (128, R) with t_T[c, r] = flat[r*128 + c]; route
+indices by c = idx & 127 into the matching sublane; then every lookup
+is out[i, j] = t_T[c_i, idx >> 7] — a lane gather with lane extent R.
+If Mosaic lowers take_along_axis(axis=1) at R = 4096..32768 (table
+2^19..2^22 = VMEM ceiling), arbitrary-index gather decomposes into
+routed lane gathers. This probe measures lowers-or-not AND M elem/s
+per lane extent.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+INTERPRET = "--interpret" in sys.argv
+
+
+def probe_width(R):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    rec = {"probe": "lane_gather_width", "lane_extent": R,
+           "table_elems": 128 * R, "table_mb": round(128 * R * 4 / 2**20, 1)}
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 1 << 30, (8, R), dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, R, (8, R), dtype=np.int32))
+
+    kw = {}
+    if not INTERPRET:
+        from jax.experimental.pallas import tpu as pltpu
+
+        kw = {"memory_space": pltpu.VMEM}
+    try:
+        call = pl.pallas_call(
+            lambda xr, ir, o: o.__setitem__(
+                ..., jnp.take_along_axis(xr[...], ir[...], axis=1)),
+            grid=(1,),
+            in_specs=[pl.BlockSpec((8, R), lambda g: (0, 0), **kw),
+                      pl.BlockSpec((8, R), lambda g: (0, 0), **kw)],
+            out_specs=pl.BlockSpec((8, R), lambda g: (0, 0), **kw),
+            out_shape=jax.ShapeDtypeStruct((8, R), jnp.int32),
+            interpret=INTERPRET)
+        t0 = time.perf_counter()
+        compiled = jax.jit(call).lower(x, idx).compile()
+        rec["lowered"] = True
+        rec["compile_s"] = round(time.perf_counter() - t0, 2)
+        out = np.asarray(compiled(x, idx))
+        rec["ok"] = bool(np.array_equal(
+            out, np.take_along_axis(np.asarray(x), np.asarray(idx),
+                                    axis=1)))
+        n = 8 * R
+        jax.block_until_ready(compiled(x, idx))
+        t0 = time.perf_counter()
+        reps = 20
+        for _ in range(reps):
+            r = compiled(x, idx)
+        jax.block_until_ready(r)
+        s = (time.perf_counter() - t0) / reps
+        rec["melems"] = round(n / s / 1e6, 1)
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}".splitlines()[0][:300]
+        if rec.get("lowered"):
+            rec["run_error"] = msg
+        else:
+            rec["lowered"] = False
+            rec["error"] = msg
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    import jax
+
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "device": str(jax.devices()[0])}), flush=True)
+    widths = [128, 256, 512]
+    if not INTERPRET:
+        widths += [1024, 4096, 8192, 16384, 32768]
+    for R in widths:
+        rec = probe_width(R)
+        if not rec.get("lowered") and not INTERPRET:
+            break  # wider only gets harder; stop at first rejection
+
+
+if __name__ == "__main__":
+    main()
