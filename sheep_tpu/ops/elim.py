@@ -75,6 +75,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import time
+
 import numpy as np
 
 import jax
@@ -822,7 +824,18 @@ def _fold_adaptive_pos_impl(
     warm = list(warm_schedule)
     lift_stack = None
     segs_on_stack = 0
+
+    def t_add(key: str, dt: float) -> None:
+        # wall-clock attribution per segment KIND. Dispatches are async,
+        # but each loop iteration ends in exactly ONE device pull (the
+        # sv sync below), so iteration wall == that segment's true cost
+        # — this is what decomposed the round-5 bad-link capture's
+        # 227.8 s build (68 s device floor vs per-segment sync/transfer
+        # tax; BASELINE.md round-5 capture section)
+        stats[key] = round(stats.get(key, 0.0) + dt, 3)
+
     while True:
+        t0 = time.perf_counter()
         if warm and size > small_size:
             wrounds, wlevels = warm.pop(0)
             seg = min(wrounds, max_rounds - total)
@@ -830,6 +843,7 @@ def _fold_adaptive_pos_impl(
                 P, loP, hiP, n, lift_levels=wlevels,
                 segment_rounds=seg, descent="stream")
             stats["warm_segments"] = stats.get("warm_segments", 0) + 1
+            t_key = "t_warm_s"
         elif size > small_size:
             seg = min(segment_rounds, max_rounds - total)
             rl, rd = _resolve(n, lift_levels, descent)
@@ -859,11 +873,13 @@ def _fold_adaptive_pos_impl(
                     P, loP, hiP, n, lift_levels=lift_levels,
                     segment_rounds=seg, descent=descent)
             stats["full_segments"] = stats.get("full_segments", 0) + 1
+            t_key = "t_full_s"
         else:
             seg = min(max(segment_rounds, 64), max_rounds - total)
             loP, hiP, P, sv = fold_segment_small_pos(
                 P, loP, hiP, n, jumps=small_jumps, segment_rounds=seg)
             stats["small_segments"] = stats.get("small_segments", 0) + 1
+            t_key = "t_small_s"
         # ONE device pull per segment for all three control scalars
         # (each pull is a full round-trip on a tunneled device); the
         # duplicate collapse happens inside the dedup compactions, which
@@ -871,6 +887,7 @@ def _fold_adaptive_pos_impl(
         # full-buffer two-key sort every segment (measured: seconds at
         # C=2^24 on the v5e, swamping the rounds it saved)
         changed, r, live = (int(x) for x in np.asarray(sv))
+        t_add(t_key, time.perf_counter() - t0)
         total += r
         stats["device_rounds"] = stats.get("device_rounds", 0) + r
         # live == 0 is the fixpoint too (the table only changes through
@@ -900,9 +917,11 @@ def _fold_adaptive_pos_impl(
                 # size the pull by the live count, not the threshold:
                 # the tail ships two O(size) arrays over the host link
                 pull = pow2_at_least(live, floor=1 << 14)
-                return (_host_tail_finish_pos(P, loP, hiP, n,
-                                              min(pull, size), pos_host),
-                        total, None)
+                t0 = time.perf_counter()
+                out = _host_tail_finish_pos(P, loP, hiP, n,
+                                            min(pull, size), pos_host)
+                t_add("t_host_tail_s", time.perf_counter() - t0)
+                return out, total, None
         if size > small_size and live <= size // 2:
             new_size = pow2_at_least(2 * live, floor=small_size)
             if new_size < size:

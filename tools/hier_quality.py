@@ -24,10 +24,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 # quality runs are platform-invariant (cut/balance bit-identical cpu vs
 # tpu — balance_frontier.json) and must never contend for the tunnel
-# while the watcher is capturing: pin cpu unless told otherwise
+# while the watcher is capturing: pin cpu UNCONDITIONALLY. (Not the env
+# var: this environment sets JAX_PLATFORMS=axon globally, so an env
+# fallback would pin the tunneled chip — the exact failure this guard
+# exists to prevent. SHEEP_QUALITY_PLATFORM overrides deliberately.)
 from sheep_tpu.utils.platform import pin_platform  # noqa: E402
 
-pin_platform(os.environ.get("JAX_PLATFORMS", "cpu"))
+pin_platform(os.environ.get("SHEEP_QUALITY_PLATFORM") or "cpu")
 
 
 def main():

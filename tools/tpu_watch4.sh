@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Round-5 post-queue watcher: the decision queue is closed
+# (tools/out/20260801T083204/ + BASELINE.md round-5 capture section);
+# the one remaining chip prize is a GOOD-LINK headline re-capture. The
+# fresh banked number (vs_baseline 0.067) was taken at h2d 5.1 MB/s;
+# the good-link regime (43 MB/s, r3) gave 0.215. So: probe the link
+# every cycle, bank its state, and spend a bench run ONLY when h2d
+# clears a threshold — an 0.067-class window has nothing left to give.
+# Inherits watch3's rules: subprocess probes with hard timeouts,
+# CAPTURING flag to quiesce the (pause-aware) CPU jobs during the
+# bench so the native denominator is honest, single-instance pidfile.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+interval=${SHEEP_WATCH_INTERVAL:-600}
+h2d_min=${SHEEP_H2D_MIN:-15}
+deadline=$(( $(date +%s) + ${SHEEP_WATCH_HOURS:-10} * 3600 ))
+flag=tools/out/CAPTURING
+pidfile=tools/out/watcher.pid
+
+if [ -f "$pidfile" ] && kill -0 "$(cat "$pidfile")" 2>/dev/null; then
+  echo "another watcher (pid $(cat "$pidfile")) is alive; refusing to start"
+  exit 2
+fi
+echo $$ >"$pidfile"
+cleanup() { rm -f "$flag" "$pidfile"; }
+trap cleanup EXIT
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp, numpy as np
+assert int(np.asarray(jnp.sum(jnp.arange(8)))) == 28
+print('ok')" 2>/dev/null | grep -q ok
+}
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if probe; then
+    ts=$(date -u +%Y%m%dT%H%M%S)
+    link=$(timeout 150 python tools/tpu_probe_quick.py 2>/dev/null | tail -1)
+    echo "$link" >> tools/out/watch4_link.log
+    h2d=$(printf '%s' "$link" | python -c "
+import json,sys
+try: print(json.load(sys.stdin).get('h2d_mbs', 0))
+except Exception: print(0)")
+    good=$(python -c "print(1 if float('${h2d:-0}' or 0) >= $h2d_min else 0)")
+    if [ "$good" = 1 ]; then
+      out="tools/out/$ts"
+      mkdir -p "$out"
+      printf '%s\n' "$link" > "$out/linkstate.json"
+      touch "$flag"
+      echo "good link (h2d ${h2d} MB/s) at $ts; benching" | tee "$out/watch.log"
+      timeout 2400 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
+      rc=$?
+      rm -f "$flag"
+      cat "$out/bench.json" | tee -a "$out/watch.log"
+      if [ "$rc" = 0 ] && grep -q '"platform": "tpu"' "$out/bench.json"; then
+        echo "GOOD-LINK HEADLINE LANDED in $out" | tee -a "$out/watch.log"
+        exit 0
+      fi
+      echo "bench rc=$rc; continuing to poll" | tee -a "$out/watch.log"
+    fi
+  fi
+  sleep "$interval"
+done
