@@ -174,6 +174,13 @@ def measure(scale: int, platform: str) -> dict:
         f"cut_ratio={res_tpu.cut_ratio:.4f} balance={res_tpu.balance:.3f} "
         f"rounds={res_tpu.diagnostics.get('fixpoint_rounds')} "
         f"phases={ {p: round(s, 2) for p, s in res_tpu.phase_times.items()} }")
+    # per-segment build-wall attribution (t_warm_s/t_full_s/t_small_s/
+    # t_host_tail_s — elim.py accumulates them per sync), the numbers
+    # that decompose build wall into device floor vs tunnel/host tax
+    seg_t = {k: v for k, v in res_tpu.diagnostics.items()
+             if k.startswith("t_")}
+    if seg_t:
+        log(f"build wall attribution: {seg_t}")
     reg = (res_tpu.cut_ratio - res_cpu.cut_ratio) / max(res_cpu.cut_ratio, 1e-9)
     log(f"edge-cut regression vs cpu: {100 * reg:+.2f}% (target <= +2%)")
     out.update(tpu_eps=round(tpu_eps, 1), ratio=round(tpu_eps / cpu_eps, 3),
