@@ -491,6 +491,36 @@ def test_stale_reuse_rebuild_cadence():
     assert stats.get("stack_rebuilds", 0) == -(-full // 3)
 
 
+def test_fold_stats_wall_attribution():
+    """Every segment kind executed must leave its t_* wall key in
+    stats, each key non-negative and summing to (well under) the call's
+    own wall — the contract bench.py's 'build wall attribution' line
+    and BASELINE.md's round-5 decomposition read from."""
+    import time as _time
+
+    e, n = _cases()["rmat"]
+    pos, order = _device_order(e, n)
+    loP, hiP = elim_ops.orient_edges_pos(
+        jnp.asarray(pad_chunk(e, len(e), n)), pos, n)
+    stats: dict = {}
+    P0 = jnp.full(n + 1, n, dtype=jnp.int32)
+    t0 = _time.perf_counter()
+    elim_ops.fold_edges_adaptive_pos(
+        P0, loP, hiP, n, segment_rounds=2, small_size=8, host_tail=False,
+        warm_schedule=((1, 1),), stats=stats)
+    wall = _time.perf_counter() - t0
+    kinds = {"warm_segments": "t_warm_s", "full_segments": "t_full_s",
+             "small_segments": "t_small_s"}
+    seen = 0
+    for count_key, t_key in kinds.items():
+        if stats.get(count_key, 0):
+            seen += 1
+            assert t_key in stats, f"{count_key} ran but {t_key} missing"
+            assert stats[t_key] >= 0
+    assert seen > 0, "config must exercise at least one segment kind"
+    assert sum(stats.get(t, 0) for t in kinds.values()) <= wall + 1e-6
+
+
 def test_pipeline_runs_under_debug_nans():
     """SURVEY.md §5 race-detection line: the JAX path is functional/pure,
     so the structural check is that a full partition runs clean under
