@@ -83,12 +83,16 @@ def main():
         "history": {"flat_r30": 0.8467, "hier_r4": 0.4313,
                     "hier_fr10": 0.3364},
     }
+    # every quality-relevant knob keys the filename (ADVICE r4's clobber
+    # lesson, re-learned once: a balance run overwrote its unbalanced
+    # twin before the budget joined the name)
     tag = f"_{args.tag}" if args.tag else ""
+    bal = f"_b{args.balance}".replace(".", "") if args.balance else ""
     lv = "x".join(str(k) for k in k_levels)
     path = os.path.join(
         os.path.dirname(__file__), "out", "soak",
         f"hier_s{args.scale}_k{args.blocks}_L{lv}"
-        f"_r{args.refine}_fr{args.final_refine}{tag}.json")
+        f"_r{args.refine}_fr{args.final_refine}{bal}{tag}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
