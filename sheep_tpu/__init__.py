@@ -149,13 +149,17 @@ def comm_volume_of(assignment, stream, n, k, chunk_edges=1 << 22):
 
 
 def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit",
-                  degrees=None):
+                  degrees=None, budget_bytes: int = 4 << 30):
     """Apply the post-pass refinement to a PartitionResult (shared by the
     library API and the CLI's --refine flag); rescores cut/balance (and
     comm volume when the input carried one). ``weights="degree"`` caps
     parts by degree weight, matching the backend's balance semantics
     (one extra stream pass recomputes the degrees — pass ``degrees`` to
-    reuse an already-computed table instead)."""
+    reuse an already-computed table instead). ``budget_bytes`` bounds
+    the (V+1) x k histogram before refinement switches to the blocked
+    (multi-pass) mode — s22/k=256 misses the 4 GB default by exactly
+    1 KB and quintuples its stream passes, so callers with RAM should
+    raise it."""
     import dataclasses
 
     import numpy as np
@@ -173,7 +177,7 @@ def refine_result(res, stream, rounds=3, alpha=1.10, weights="unit",
     try:
         new_assign, rstats = refine_assignment(
             res.assignment, stream, n, res.k, rounds=rounds, alpha=alpha,
-            weights=w)
+            weights=w, budget_bytes=budget_bytes)
     except ValueError as e:
         # never lose a finished partition to an over-budget refinement —
         # return it unrefined with the reason in the diagnostics

@@ -185,7 +185,8 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                            balance: float | None = None,
                            final_refine: int = 0,
                            spill_dir: str | None = None,
-                           n_vertices: int | None = None, **opts):
+                           n_vertices: int | None = None,
+                           refine_budget_bytes: int = 4 << 30, **opts):
     """Partition into prod(k_levels) parts, one level at a time.
 
     ``k_levels`` — e.g. ``[8, 8]`` for k=64. ``refine`` rounds apply at
@@ -273,7 +274,8 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                 res = refine_result(
                     res, es, rounds=final_refine,
                     alpha=balance if balance is not None else refine_alpha,
-                    weights=opts.get("weights", "unit"), degrees=w)
+                    weights=opts.get("weights", "unit"), degrees=w,
+                    budget_bytes=refine_budget_bytes)
                 res.phase_times["final_refine"] = round(
                     time.perf_counter() - t0, 3)
                 if comm_volume:
