@@ -137,6 +137,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "explicit 0 for unrefined levels)")
     p.add_argument("--refine-alpha", type=float, default=1.10,
                    help="refinement balance cap (x ceil(V/k) per part)")
+    p.add_argument("--refine-budget-gb", type=float, default=4.0,
+                   metavar="GB",
+                   help="histogram budget for refinement: above "
+                        "(V+1)*k*4 bytes it switches to multi-pass "
+                        "blocked mode (bit-identical, ~(2B+1)/2x the "
+                        "stream passes at B blocks). s22/k=256 misses "
+                        "the 4 GB default by 1 KB — raise on big-RAM "
+                        "hosts")
     p.add_argument("--no-comm-volume", action="store_true",
                    help="skip communication-volume computation (saves a pass of memory)")
     p.add_argument("--num-vertices", type=int, default=None,
@@ -314,6 +322,7 @@ def main(argv=None) -> int:
             comm_volume=not args.no_comm_volume, weights=args.weights,
             balance=args.balance, final_refine=args.final_refine,
             spill_dir=args.spill_dir, n_vertices=args.num_vertices,
+            refine_budget_bytes=int(args.refine_budget_gb * (1 << 30)),
             **({} if args.balance is not None else
                {"alpha": args.alpha}))
         wall = time.perf_counter() - t0
@@ -537,9 +546,10 @@ def main(argv=None) -> int:
             if args.refine and is_main:
                 from sheep_tpu import refine_result
 
-                res = refine_result(res, es, rounds=args.refine,
-                                    alpha=args.refine_alpha,
-                                    weights=args.weights)
+                res = refine_result(
+                    res, es, rounds=args.refine,
+                    alpha=args.refine_alpha, weights=args.weights,
+                    budget_bytes=int(args.refine_budget_gb * (1 << 30)))
         finally:
             if profile is not None:
                 profile.__exit__(None, None, None)
