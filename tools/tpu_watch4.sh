@@ -49,7 +49,8 @@ except Exception: print(0)")
       printf '%s\n' "$link" > "$out/linkstate.json"
       touch "$flag"
       echo "good link (h2d ${h2d} MB/s) at $ts; benching" | tee "$out/watch.log"
-      timeout 2400 python bench.py >"$out/bench.json" 2>"$out/bench.stderr"
+      timeout "${SHEEP_BENCH_TIMEOUT:-3300}" python bench.py \
+        >"$out/bench.json" 2>"$out/bench.stderr"
       rc=$?
       rm -f "$flag"
       cat "$out/bench.json" | tee -a "$out/watch.log"
