@@ -92,6 +92,27 @@ def test_final_refine_never_worse():
     assert a.min() >= 0 and a.max() < 16
 
 
+def test_refine_budget_plumbing_output_invariant():
+    """refine_budget_bytes threads partition_hierarchical ->
+    refine_result -> refine_assignment without changing output (the
+    --refine-budget-gb contract: the budget trades stream passes,
+    never output). At this scale the min_block floor keeps even a
+    starved budget in full-histogram mode, so mode-switch equality
+    itself is pinned by test_refine_blocked_histogram_matches_full
+    (min_block=64); this test pins the hierarchy-level kwarg path."""
+    import numpy as np
+
+    full = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=2, final_refine=3,
+        comm_volume=False)
+    starved = sheep_tpu.partition_hierarchical(
+        SPEC, [4, 4], backend="pure", refine=2, final_refine=3,
+        comm_volume=False, refine_budget_bytes=1 << 16)
+    np.testing.assert_array_equal(np.asarray(full.assignment),
+                                  np.asarray(starved.assignment))
+    assert starved.edge_cut == full.edge_cut
+
+
 def test_spill_matches_scoring_and_bounds_disk(tmp_path):
     # the spilled file-backed recursion must produce a valid, internally
     # consistent result (scored cut == recount over the raw stream), and
