@@ -25,6 +25,14 @@ toolchain is absent.
 
 Secondary metrics (cut ratio parity vs CPU, per-phase times) go to stderr
 so the stdout contract stays one line.
+
+Link-state contract (VERDICT.md round 5 item 7): every emitted JSON line
+carries its own window's ``{rtt_ms, h2d_mbs, d2h_mbs}`` plus
+``r_colo_est`` (the ratio with the measured per-sync link tax removed —
+the co-located-host R estimate) and the dispatch-count attribution
+inputs ``{host_syncs, device_rounds}``, so headline numbers are
+comparable across the ~8x link-quality swing without artifact
+archaeology.
 """
 
 import json
@@ -45,6 +53,47 @@ def emit(value, vs_baseline, metric=METRIC, **extra):
             "vs_baseline": vs_baseline}
     line.update(extra)
     print(json.dumps(line), flush=True)
+
+
+def measure_link_state() -> dict:
+    """Per-window link state (VERDICT r5 item 7): the quantities that
+    explained the 0.215 -> 0.064 headline swing (same code, ~8x link
+    difference). Measured in the worker right before the timed legs so
+    every bench JSON is normalizable without linkstate.jsonl
+    archaeology: median tiny-put RTT plus one 16 MiB transfer each way,
+    with host pulls as completion barriers (block_until_ready is not a
+    barrier through the tunnel — BASELINE.md round-2 fact).
+
+    Returns {} when jax cannot run a device op at all — the probe must
+    never take down the jax-free cpu-vs-itself diagnostic path."""
+    try:
+        import numpy as np
+
+        import jax
+
+        np.asarray(jax.device_put(np.zeros(1, np.int32)))
+    except Exception as e:
+        log(f"link-state probe unavailable: {type(e).__name__}: "
+            f"{str(e)[:120]}")
+        return {}
+
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(np.zeros(1, np.int32)))
+        rtts.append(time.perf_counter() - t0)
+    rtt = sorted(rtts)[len(rtts) // 2]
+    host = np.zeros(1 << 22, np.int32)  # 16 MiB
+    t0 = time.perf_counter()
+    dev = jax.device_put(host)
+    np.asarray(dev[:1])
+    h2d = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(dev)
+    d2h = time.perf_counter() - t0
+    return {"rtt_ms": round(1e3 * rtt, 2),
+            "h2d_mbs": round(16 / max(h2d, 1e-9), 1),
+            "d2h_mbs": round(16 / max(d2h, 1e-9), 1)}
 
 
 _PROBE_SRC = """
@@ -143,9 +192,19 @@ def measure(scale: int, platform: str) -> dict:
            "baseline": base_name, "cpu_eps": round(cpu_eps, 1),
            "cpu_cut_ratio": round(res_cpu.cut_ratio, 6)}
 
+    # per-window link state rides in the contract so any capture from
+    # this window normalizes to the co-located bound (VERDICT r5 item 7)
+    link = measure_link_state()
+    if link:
+        log(f"link state: rtt {link['rtt_ms']} ms  h2d {link['h2d_mbs']} "
+            f"MB/s  d2h {link['d2h_mbs']} MB/s")
+        out.update(link)
+
     if "tpu" not in list_backends():
         log("tpu backend unavailable; reporting cpu vs itself")
-        out.update(tpu_eps=round(cpu_eps, 1), ratio=1.0,
+        # cpu vs itself: no link tax to remove, so the co-located
+        # estimate IS the ratio — the field stays on every emitted line
+        out.update(tpu_eps=round(cpu_eps, 1), ratio=1.0, r_colo_est=1.0,
                    error="tpu backend unregistered")
         return out
 
@@ -177,10 +236,36 @@ def measure(scale: int, platform: str) -> dict:
     # per-segment build-wall attribution (t_warm_s/t_full_s/t_small_s/
     # t_host_tail_s — elim.py accumulates them per sync), the numbers
     # that decompose build wall into device floor vs tunnel/host tax
-    seg_t = {k: v for k, v in res_tpu.diagnostics.items()
+    seg_t = {k: round(v, 3) for k, v in res_tpu.diagnostics.items()
              if k.startswith("t_")}
     if seg_t:
         log(f"build wall attribution: {seg_t}")
+    # count x round-cost attribution inputs: with the dispatch counts in
+    # the contract, two bench rows at different --dispatch-batch solve
+    # per-dispatch overhead vs per-round device cost exactly
+    # (sheep_tpu.utils.metrics.solve_dispatch_attribution) — the batched
+    # dispatch win is provable from counts alone, even on the CPU mesh
+    disp = {k: int(res_tpu.diagnostics[k])
+            for k in ("host_syncs", "device_rounds", "batch_execs",
+                      "dispatch_batch")
+            if k in res_tpu.diagnostics}
+    if disp:
+        log(f"dispatch counts (count x round-cost attribution): {disp}")
+        out.update(disp)
+    # r_colo_est: the headline ratio with this window's measured
+    # per-sync link tax subtracted — the co-located-host R estimate that
+    # makes rounds comparable across the ~8x link swing. If the rtt
+    # sample claims MORE tax than the whole measured wall (a probe-time
+    # spike on a link that later recovered), the estimate is invalid —
+    # fall back to the unnormalized ratio rather than emitting a
+    # clamped-denominator absurdity into the contract.
+    syncs = disp.get("host_syncs", 0)
+    colo_s = tpu_s - syncs * link.get("rtt_ms", 0.0) / 1e3
+    if colo_s <= 0:
+        log(f"rtt sample ({link.get('rtt_ms')} ms x {syncs} syncs) "
+            f"exceeds the measured wall; r_colo_est left unnormalized")
+        colo_s = tpu_s
+    out["r_colo_est"] = round((m / colo_s) / cpu_eps, 3)
     reg = (res_tpu.cut_ratio - res_cpu.cut_ratio) / max(res_cpu.cut_ratio, 1e-9)
     log(f"edge-cut regression vs cpu: {100 * reg:+.2f}% (target <= +2%)")
     out.update(tpu_eps=round(tpu_eps, 1), ratio=round(tpu_eps / cpu_eps, 3),
@@ -330,6 +415,14 @@ def main():
     metric = (f"{METRIC} (RMAT-{result['scale']}, k={result['k']}, "
               f"{result['platform']} vs 1-socket CPU)")
     extra = {"platform": result["platform"]}
+    # link-state + dispatch-attribution contract fields (VERDICT r5
+    # items 2/7): every bench row carries its own window's link state
+    # and the co-located R estimate, so numbers stay comparable across
+    # link-quality swings without artifact archaeology
+    for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est", "host_syncs",
+              "device_rounds", "dispatch_batch"):
+        if f in result:
+            extra[f] = result[f]
     if failures:
         extra["retries"] = failures
     vs = result["ratio"]

@@ -32,7 +32,7 @@ from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
 from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
-from sheep_tpu.utils.prefetch import prefetch
+from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
 
 
 def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
@@ -118,9 +118,42 @@ def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
         cache.complete = True
 
 
-def _chunk_cache_budget(n: int, chunk_edges: int) -> int:
+def _device_hbm_bytes(purpose: str = "the chunk cache") -> int:
+    """Reported (or generation-inferred) HBM bytes of the default
+    device; 0 when nothing trustworthy is known."""
+    dev = jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", 0))
+    except Exception:
+        hbm = 0
+    if hbm <= 0:
+        # no reported limit: infer only from a known device generation;
+        # an unknown accelerator gets 0 rather than a guessed budget
+        # that could OOM it (SHEEP_CACHE_BYTES overrides). Exact kind
+        # match first so a future kind merely *containing* one of these
+        # substrings (with different HBM) prefers its own entry, and
+        # log the inference so an OOM is traceable to it.
+        kind = getattr(dev, "device_kind", "").lower()
+        known = {"v5 lite": 16, "v5e": 16, "v4": 32, "v5p": 95, "v6": 32}
+        g = known.get(kind) or next(
+            (g for key, g in known.items() if key in kind), 0)
+        hbm = g << 30
+        if hbm:
+            import sys
+
+            print(f"note: device reports no bytes_limit; inferring "
+                  f"{g} GiB HBM from device_kind {kind!r} for {purpose} "
+                  f"(override with SHEEP_CACHE_BYTES)",
+                  file=sys.stderr)
+    return hbm
+
+
+def _chunk_cache_budget(n: int, chunk_edges: int,
+                        dispatch_batch: int = 1) -> int:
     """Bytes of HBM safely spendable on cached chunks: the device limit
-    minus the build phase's modeled peak and a safety margin.
+    minus the build phase's modeled peak (including the batched
+    dispatch's [N, C] staging blocks) and a safety margin.
 
     0 (cache disabled) on cpu-jax — there the "device" IS host RAM, so
     caching would duplicate the stream in memory to save a transfer that
@@ -133,33 +166,63 @@ def _chunk_cache_budget(n: int, chunk_edges: int) -> int:
     env = os.environ.get("SHEEP_CACHE_BYTES")
     if env is not None:
         return max(0, int(env))
-    dev = jax.local_devices()[0]
-    try:
-        stats = dev.memory_stats() or {}
-        hbm = int(stats.get("bytes_limit", 0))
-    except Exception:
-        hbm = 0
-    if hbm <= 0:
-        # no reported limit: infer only from a known device generation;
-        # an unknown accelerator gets no cache rather than a guessed
-        # budget that could OOM it (SHEEP_CACHE_BYTES overrides). Exact
-        # kind match first so a future kind merely *containing* one of
-        # these substrings (with different HBM) prefers its own entry,
-        # and log the inference so an OOM is traceable to it.
-        kind = getattr(dev, "device_kind", "").lower()
-        known = {"v5 lite": 16, "v5e": 16, "v4": 32, "v5p": 95, "v6": 32}
-        g = known.get(kind) or next(
-            (g for key, g in known.items() if key in kind), 0)
-        hbm = g << 30
-        if hbm:
-            import sys
-
-            print(f"note: device reports no bytes_limit; inferring "
-                  f"{g} GiB HBM from device_kind {kind!r} for the chunk "
-                  f"cache (override with SHEEP_CACHE_BYTES)",
-                  file=sys.stderr)
-    reserve = build_phase_bytes(n, chunk_edges)["total_bytes"] + (1 << 30)
+    hbm = _device_hbm_bytes()
+    reserve = build_phase_bytes(
+        n, chunk_edges,
+        dispatch_batch=dispatch_batch)["total_bytes"] + (1 << 30)
     return max(0, int(0.9 * hbm) - reserve)
+
+
+def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int) -> int:
+    """The one auto-sizing rule for ``dispatch_batch`` (shared by the
+    single-device and sharded backends): explicit N passes through,
+    0 (auto) resolves to per-segment on cpu-jax — host dispatch is
+    cheap there and the adaptive driver's compaction/host-tail schedule
+    wins — and otherwise to the largest N whose O(N*C) staging fits the
+    HBM model (utils/membudget.dispatch_batch_for)."""
+    if dispatch_batch != 0:
+        return max(1, int(dispatch_batch))
+    if jax.default_backend() == "cpu":
+        return 1
+    hbm = _device_hbm_bytes(purpose="the dispatch batch")
+    if hbm <= 0:
+        return 1
+    from sheep_tpu.utils.membudget import dispatch_batch_for
+
+    return dispatch_batch_for(int(0.9 * hbm), n, cs)
+
+
+def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
+                         batch: int):
+    """Yield lists of up to ``batch`` padded (cs, 2) int32 DEVICE chunks
+    — the staged groups of the batched segment dispatch.
+
+    Host-format streams stage a FULL group of parsed + padded chunks on
+    the prefetch worker (:func:`prefetch_batched`) before the uploads
+    are issued, so all N host reads of the next batched program overlap
+    the current enlarged device execution; device-materializing
+    (``device_chunk``) and cache-served chunks group over the plain
+    per-chunk iterator (no host I/O to overlap, and the cache's
+    prefix-fill invariant stays in one place)."""
+    if batch <= 1:
+        for d in _device_chunks(stream, cs, n, cache, start_chunk):
+            yield [d]
+        return
+    if cache is None and getattr(stream, "device_chunk", None) is None:
+        for host_group in prefetch_batched(
+                (pad_chunk(c, cs, n)
+                 for c in stream.chunks(cs, start_chunk=start_chunk)),
+                batch):
+            yield [jnp.asarray(p) for p in host_group]
+        return
+    group: list = []
+    for d in _device_chunks(stream, cs, n, cache, start_chunk):
+        group.append(d)
+        if len(group) == batch:
+            yield group
+            group = []
+    if group:
+        yield group
 
 
 @register
@@ -173,7 +236,8 @@ class TpuBackend(Partitioner):
                  host_tail_threshold: int = -1,
                  carry_tail: Optional[bool] = None,
                  tail_overlap: Optional[bool] = None,
-                 stale_reuse: int = 1):
+                 stale_reuse: int = 1,
+                 dispatch_batch: int = 0):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -220,9 +284,31 @@ class TpuBackend(Partitioner):
         # hoisting; K > 1 reuses the stack across K segments — see
         # elim.py fold_segment_pos_stale; A/B axis in tune_fixpoint)
         self.stale_reuse = stale_reuse
+        # batched segment dispatch (ops/elim.py fold_segments_batch):
+        # stage N streamed chunks as one padded [N, C] oriented block
+        # and fold them in single bounded device programs — one packed
+        # stats sync per execution instead of per segment. 0 = auto:
+        # per-segment on cpu-jax (host dispatch is cheap there and the
+        # adaptive driver's compaction/host-tail schedule wins), else
+        # the largest N whose O(N*C) staging fits the HBM model
+        # (utils/membudget.dispatch_batch_for). The forest is
+        # bit-identical either way (the fixpoint is unique).
+        if dispatch_batch < 0:
+            raise ValueError("dispatch_batch must be >= 0 (0 = auto)")
+        self.dispatch_batch = dispatch_batch
+        if dispatch_batch > 1 and (carry_tail or tail_overlap):
+            raise ValueError("dispatch_batch > 1 folds whole segments on "
+                             "device; it excludes the per-chunk tail "
+                             "strategies (carry_tail / tail_overlap)")
         if carry_tail and tail_overlap:
             raise ValueError("carry_tail and tail_overlap are mutually "
                              "exclusive tail strategies")
+
+    def _resolve_dispatch_batch(self, n: int, cs: int) -> int:
+        if self.dispatch_batch == 0 and (self.carry_tail or
+                                         self.tail_overlap):
+            return 1  # auto defers to an explicit per-chunk tail strategy
+        return resolve_dispatch_batch(self.dispatch_batch, n, cs)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -253,7 +339,9 @@ class TpuBackend(Partitioner):
             deg_host = state.arrays["deg"].copy()
         else:
             deg_host = np.zeros(n, dtype=np.int64)
-        cache_budget = _chunk_cache_budget(n, cs) if self.cache_chunks else 0
+        batch_n = self._resolve_dispatch_batch(n, cs)
+        cache_budget = _chunk_cache_budget(n, cs, dispatch_batch=batch_n) \
+            if self.cache_chunks else 0
         cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
         if from_phase == 0:
             start = state.chunk_idx if state else 0
@@ -346,43 +434,87 @@ class TpuBackend(Partitioner):
                             pos_host=pos_host_cache, stats=build_stats)
                         total_rounds += int(r)
 
-                for padded in _device_chunks(stream, cs, n, cache, start):
-                    if overlap:
-                        # pick up any host-resolved tails without waiting;
-                        # they enter this fold as ordinary actives
-                        ov.drain(False)
-                        carry = ov.take_inject()
-                    step = elim_ops.build_chunk_step_adaptive_pos(
-                        P, padded, pos, pos_host_cache, n,
-                        lift_levels=self.lift_levels,
-                        segment_rounds=self.segment_rounds,
-                        warm_schedule=self.warm_schedule, stats=build_stats,
-                        host_tail_threshold=tail_at,
-                        stale_reuse=self.stale_reuse,
-                        carry=carry, carry_out=carry_mode or overlap)
-                    if carry_mode:
-                        P, rounds, carry = step
-                    elif overlap:
-                        P, rounds, tail = step
-                        carry = None
-                        if int(tail[0].shape[0]):
-                            build_stats["overlap_tails"] = \
-                                build_stats.get("overlap_tails", 0) + 1
-                            ov.submit(P, tail[0], tail[1])
-                    else:
-                        P, rounds = step
-                    total_rounds += int(rounds)
-                    idx += 1
-                    maybe_fail("build", idx - start)
-                    if checkpointer is not None and \
-                            checkpointer.due(idx - start):
+                if batch_n > 1 and not carry_mode and not overlap:
+                    # batched segment dispatch: stage batch_n chunks as
+                    # one oriented [N, C] block and fold them in bounded
+                    # multi-segment device programs — one packed stats
+                    # sync per execution instead of per segment
+                    # (ops/elim.py fold_segments_batch). Warm schedule /
+                    # compaction / host tail are per-segment host
+                    # decisions and do not apply here; the forest is the
+                    # same unique fixpoint either way.
+                    build_stats["dispatch_batch"] = batch_n
+                    sentinel_chunk = None
+                    for group in _device_chunk_groups(
+                            stream, cs, n, cache, start, batch_n):
+                        gl = len(group)
+                        if gl < batch_n:
+                            if sentinel_chunk is None:
+                                sentinel_chunk = jnp.full((cs, 2), n,
+                                                          jnp.int32)
+                            group = group + [sentinel_chunk] * \
+                                (batch_n - gl)
+                        loB, hiB = elim_ops.orient_chunks_batch_pos(
+                            jnp.stack(group), pos, n)
+                        P, rounds = elim_ops.fold_segments_batch(
+                            P, loB, hiB, n,
+                            lift_levels=self.lift_levels,
+                            segment_rounds=self.segment_rounds,
+                            stats=build_stats)
+                        total_rounds += int(rounds)
+                        prev = idx
+                        idx += gl
+                        for i in range(prev + 1, idx + 1):
+                            maybe_fail("build", i - start)
+                        if checkpointer is not None and \
+                                checkpointer.due_span(prev - start,
+                                                      idx - start):
+                            checkpointer.save(
+                                "build", idx,
+                                {"deg": deg_host,
+                                 "minp": np.asarray(P[pos])}, meta)
+                else:
+                    for padded in _device_chunks(stream, cs, n, cache,
+                                                 start):
                         if overlap:
-                            _flush_deltas()
-                        arrays = {"deg": deg_host, "minp": np.asarray(P[pos])}
+                            # pick up any host-resolved tails without
+                            # waiting; they enter this fold as ordinary
+                            # actives
+                            ov.drain(False)
+                            carry = ov.take_inject()
+                        step = elim_ops.build_chunk_step_adaptive_pos(
+                            P, padded, pos, pos_host_cache, n,
+                            lift_levels=self.lift_levels,
+                            segment_rounds=self.segment_rounds,
+                            warm_schedule=self.warm_schedule,
+                            stats=build_stats,
+                            host_tail_threshold=tail_at,
+                            stale_reuse=self.stale_reuse,
+                            carry=carry, carry_out=carry_mode or overlap)
                         if carry_mode:
-                            arrays["carry_lo"] = np.asarray(carry[0])
-                            arrays["carry_hi"] = np.asarray(carry[1])
-                        checkpointer.save("build", idx, arrays, meta)
+                            P, rounds, carry = step
+                        elif overlap:
+                            P, rounds, tail = step
+                            carry = None
+                            if int(tail[0].shape[0]):
+                                build_stats["overlap_tails"] = \
+                                    build_stats.get("overlap_tails", 0) + 1
+                                ov.submit(P, tail[0], tail[1])
+                        else:
+                            P, rounds = step
+                        total_rounds += int(rounds)
+                        idx += 1
+                        maybe_fail("build", idx - start)
+                        if checkpointer is not None and \
+                                checkpointer.due(idx - start):
+                            if overlap:
+                                _flush_deltas()
+                            arrays = {"deg": deg_host,
+                                      "minp": np.asarray(P[pos])}
+                            if carry_mode:
+                                arrays["carry_lo"] = np.asarray(carry[0])
+                                arrays["carry_hi"] = np.asarray(carry[1])
+                            checkpointer.save("build", idx, arrays, meta)
                 if overlap:
                     _flush_deltas()
             if carry_mode and carry is not None and int(carry[0].shape[0]):
@@ -451,8 +583,13 @@ class TpuBackend(Partitioner):
             assignment=assign_host, k=k, edge_cut=cut, total_edges=total,
             cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
             phase_times=t, backend=self.name,
+            # t_* walls accumulate unrounded (elim.py t_add) and are
+            # rounded HERE, at read time, so sum(t_*) never drifts past
+            # the measured wall by per-add rounding quanta
             diagnostics={"fixpoint_rounds": float(total_rounds),
-                         **{k: float(v) for k, v in build_stats.items()}},
+                         **{k: (round(float(v), 3) if k.startswith("t_")
+                                else float(v))
+                            for k, v in build_stats.items()}},
             tree={"parent": np.asarray(parent), "pos": pos_host,
                   "deg": deg_host} if opts.get("keep_tree") else None,
         )

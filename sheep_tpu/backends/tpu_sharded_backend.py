@@ -25,13 +25,20 @@ class TpuShardedBackend(Partitioner):
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0, n_devices: int | None = None,
-                 segment_rounds: int = 32, warm_schedule=((1, 8),)):
+                 segment_rounds: int = 32, warm_schedule=((1, 8),),
+                 dispatch_batch: int = 0):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
         self.n_devices = n_devices
         self.segment_rounds = segment_rounds
         self.warm_schedule = tuple(warm_schedule)
+        # batched segment dispatch (see ShardedPipeline): 0 = auto
+        # (per-segment on cpu-jax; HBM-model-sized N on accelerators),
+        # 1 = per-segment, N > 1 = stage N sharded batches per program
+        if dispatch_batch < 0:
+            raise ValueError("dispatch_batch must be >= 0 (0 = auto)")
+        self.dispatch_batch = dispatch_batch
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -47,9 +54,13 @@ class TpuShardedBackend(Partitioner):
         # chunk sizing (and checkpoint fingerprints) cannot diverge
         cs = stream.clamp_chunk_edges(self.chunk_edges,
                                       parts=mesh.devices.size)
+        from sheep_tpu.backends.tpu_backend import resolve_dispatch_batch
+
+        nb = resolve_dispatch_batch(self.dispatch_batch, n, cs)
         pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels,
                                segment_rounds=self.segment_rounds,
-                               warm_schedule=self.warm_schedule)
+                               warm_schedule=self.warm_schedule,
+                               dispatch_batch=nb)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,
@@ -62,7 +73,8 @@ class TpuShardedBackend(Partitioner):
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
             diagnostics={k_: (v if isinstance(v, (int, float)) else str(v))
-                         for k_, v in out.get("merge_stats", {}).items()},
+                         for k_, v in {**out.get("build_stats", {}),
+                                       **out.get("merge_stats", {})}.items()},
             tree={"parent": np.asarray(out["parent"]), "pos": out["pos"],
                   "deg": out["degrees"]} if opts.get("keep_tree") else None,
         )

@@ -118,6 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tpu backend: full segments per lifting-stack "
                         "rebuild (1 = per-segment hoisting; K > 1 reuses "
                         "one stale stack across K segments)")
+    p.add_argument("--dispatch-batch", type=int, default=None, metavar="N",
+                   help="tpu/tpu-sharded: stage N streamed chunks as one "
+                        "padded [N, C] block and fold them in single "
+                        "bounded device programs — one packed stats sync "
+                        "per execution instead of per fixpoint segment "
+                        "(0 = auto: per-segment on cpu-jax, HBM-model-"
+                        "sized N on accelerators; 1 = per-segment "
+                        "dispatch; the forest is bit-identical either "
+                        "way). Excludes --carry-tail/--tail-overlap")
     p.add_argument("--lift-levels", type=int, default=None,
                    help="binary-lifting depth of the fixpoint climb "
                         "(0 = auto; tpu and tpu-bigv backends)")
@@ -299,6 +308,7 @@ def main(argv=None) -> int:
             ("--carry-tail", args.carry_tail),
             ("--tail-overlap", args.tail_overlap),
             ("--stale-reuse", args.stale_reuse),
+            ("--dispatch-batch", args.dispatch_batch),
             ("--lift-levels", args.lift_levels),
             ("--jumps", args.jumps),
             ("--hoist-bytes", args.hoist_bytes),
@@ -474,6 +484,15 @@ def main(argv=None) -> int:
             if args.stale_reuse < 1:
                 parser.error("--stale-reuse must be >= 1")
             ctor["stale_reuse"] = args.stale_reuse
+        if args.dispatch_batch is not None:
+            if args.dispatch_batch < 0:
+                parser.error("--dispatch-batch must be >= 0 (0 = auto)")
+            if args.dispatch_batch > 1 and (args.carry_tail or
+                                            args.tail_overlap):
+                parser.error("--dispatch-batch > 1 folds whole segments "
+                             "on device; it excludes --carry-tail/"
+                             "--tail-overlap")
+            ctor["dispatch_batch"] = args.dispatch_batch
         if args.lift_levels is not None:
             if args.lift_levels < 0:
                 parser.error("--lift-levels must be >= 0")

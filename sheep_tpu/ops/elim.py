@@ -73,9 +73,8 @@ Sentinel encoding: index ``n`` means "none"; ``pos[n] = n`` acts as +inf,
 
 from __future__ import annotations
 
-from functools import partial
-
 import time
+from functools import partial
 
 import numpy as np
 
@@ -404,6 +403,164 @@ def fold_segment_small_pos(
     Same (loP, hiP, P, stats) contract as :func:`fold_segment_pos`."""
     body = _pos_small_round_body(n, jumps)
     return _run_segment(body, P, loP, hiP, n, segment_rounds)
+
+
+# ---------------------------------------------------------------------------
+# batched segment dispatch (ISSUE 1 tentpole): fold N staged streaming
+# segments inside ONE bounded device program. The per-segment driver
+# above pays one host round-trip (the sv pull) per bounded segment —
+# measured as the dominant build cost through a degraded link (~160 s of
+# the 227.8 s round-5 build against a 68 s device floor, VERDICT r5
+# item 2). Here the host stages N segments as padded [N, C] position
+# blocks, the device runs an outer while_loop that advances segment by
+# segment (each segment's rounds are the SAME _pos_round_body), and the
+# host pulls one packed stats word per execution: O(segments / N) syncs
+# instead of O(segments). The forest is bit-identical — the elimination
+# fixpoint is unique given the constraint multiset, independent of how
+# the segments are scheduled (tests/test_dispatch_batch.py).
+# ---------------------------------------------------------------------------
+
+def batch_segment_fixpoint(
+    P: jax.Array,
+    loB: jax.Array,
+    hiB: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    descent: str = "auto",
+    batch_rounds: int = 0,
+):
+    """Traceable core of the batched dispatch: advance through the rows
+    of the [N, C] active blocks, one fixpoint round per loop step, with
+    on-device stop conditions — a segment is done when a round changes
+    nothing (the genuine fixpoint, see :func:`_pos_round_body`), the
+    program exits when every segment is done or ``batch_rounds`` total
+    rounds are spent (watchdog bounding; the host re-dispatches on the
+    returned blocks to resume). A converged segment's row is stored
+    all-sentinel — its residual live slots are implied by the table —
+    so re-entry after a budget exhaustion re-confirms it in one round.
+
+    Returns ``(loB, hiB, P, sv)`` with ``sv`` int32[4] =
+    (segments_done, rounds, live, retired) — ONE packed stats word per
+    batch. Callable directly under shard_map (the sharded pipeline's
+    per-device form); :func:`fold_segments_batch_pos` is the jitted
+    single-device entry."""
+    N, _ = loB.shape
+    lift_levels, descent = _resolve(n, lift_levels, descent)
+    if batch_rounds <= 0:
+        batch_rounds = 32 * N
+    round_body = _pos_round_body(n, lift_levels, descent)
+    # derive carried scalars from the block so their sharding/varying
+    # axes match the loop outputs (required under shard_map, as in
+    # _init_state)
+    zero = (loB[0, 0] * 0).astype(jnp.int32)
+    dummy_changed = loB[0, 0] == loB[0, 0]
+
+    def load(block, i):
+        return lax.dynamic_index_in_dim(block, i, axis=0, keepdims=False)
+
+    def cond(state):
+        i, _, _, _, _, _, rounds, _ = state
+        return (i < N) & (rounds < batch_rounds)
+
+    def body(state):
+        i, lo, hi, loB_, hiB_, P_, rounds, retired = state
+        lo2, hi2, P2, changed, _ = round_body(
+            (lo, hi, P_, dummy_changed, zero))
+        retired = retired + jnp.sum((lo2 == n) & (lo != n),
+                                    dtype=jnp.int32)
+        seg_done = ~changed
+        sent = jnp.full_like(lo2, n)
+        # store the working buffer back every round so the blocks always
+        # reflect resumable state when the round budget exhausts
+        loB_ = lax.dynamic_update_index_in_dim(
+            loB_, jnp.where(seg_done, sent, lo2), i, axis=0)
+        hiB_ = lax.dynamic_update_index_in_dim(
+            hiB_, jnp.where(seg_done, sent, hi2), i, axis=0)
+        i2 = jnp.where(seg_done, i + 1, i)
+        nxt = jnp.minimum(i2, N - 1)
+        lo3 = jnp.where(seg_done, load(loB_, nxt), lo2)
+        hi3 = jnp.where(seg_done, load(hiB_, nxt), hi2)
+        return (i2, lo3, hi3, loB_, hiB_, P2, rounds + 1, retired)
+
+    state = (zero, load(loB, zero), load(hiB, zero), loB, hiB,
+             P.astype(jnp.int32), zero, zero)
+    i_f, _, _, loB_f, hiB_f, P_f, rounds_f, retired_f = lax.while_loop(
+        cond, body, state)
+    live = jnp.sum(loB_f != n, dtype=jnp.int32)
+    sv = jnp.stack([i_f, rounds_f, live, retired_f])
+    return loB_f, hiB_f, P_f, sv
+
+
+@partial(jax.jit, static_argnames=("n", "lift_levels", "descent",
+                                   "batch_rounds"))
+def fold_segments_batch_pos(
+    P: jax.Array,
+    loB: jax.Array,
+    hiB: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    descent: str = "auto",
+    batch_rounds: int = 0,
+):
+    """Jitted :func:`batch_segment_fixpoint` — the single-device batched
+    dispatch program."""
+    return batch_segment_fixpoint(P, loB, hiB, n, lift_levels=lift_levels,
+                                  descent=descent,
+                                  batch_rounds=batch_rounds)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def orient_chunks_batch_pos(chunks: jax.Array, pos: jax.Array, n: int):
+    """(N, C, 2) stacked padded chunks -> oriented POSITION blocks
+    (loB, hiB), each row an independent [C] active buffer — the [N, C]
+    staging block of the batched dispatch. Sentinel-padded rows (and the
+    per-chunk padding tail) orient to the inert (n, n), which is the
+    per-segment live mask: a fully-inert row converges in one round."""
+    return jax.vmap(lambda c: orient_edges_pos(c, pos, n))(chunks)
+
+
+def fold_segments_batch(
+    P: jax.Array,
+    loB: jax.Array,
+    hiB: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    segment_rounds: int = 2,
+    descent: str = "auto",
+    batch_rounds: int = 0,
+    max_rounds: int = 1 << 20,
+    stats=None,
+):
+    """Host driver of the batched dispatch: loop bounded
+    :func:`fold_segments_batch_pos` executions until every staged
+    segment reports done — ONE packed-stats pull per EXECUTION instead
+    of per segment. The default per-execution round budget is
+    ``segment_rounds * N`` (the same round allowance the per-segment
+    driver would spread over N syncs), so the host sync count drops by
+    ~N while no single device execution runs longer than N bounded
+    segments back to back (the watchdog envelope scales with the staged
+    batch, not with the stream). Returns ``(P, total_rounds)``."""
+    N = int(loB.shape[0])
+    if batch_rounds <= 0:
+        batch_rounds = max(1, segment_rounds) * max(N, 1)
+    if stats is None:
+        stats = {}
+    total = 0
+    while True:
+        t0 = time.perf_counter()
+        loB, hiB, P, sv = fold_segments_batch_pos(
+            P, loB, hiB, n, lift_levels=lift_levels, descent=descent,
+            batch_rounds=batch_rounds)
+        done, r, live, retired = (int(x) for x in np.asarray(sv))
+        stats["host_syncs"] = stats.get("host_syncs", 0) + 1
+        stats["batch_execs"] = stats.get("batch_execs", 0) + 1
+        stats["batch_retired"] = stats.get("batch_retired", 0) + retired
+        stats["device_rounds"] = stats.get("device_rounds", 0) + r
+        stats["t_batch_s"] = stats.get("t_batch_s", 0.0) + \
+            (time.perf_counter() - t0)
+        total += r
+        if done >= N or total >= max_rounds:
+            return P, total
 
 
 # ---------------------------------------------------------------------------
@@ -831,8 +988,11 @@ def _fold_adaptive_pos_impl(
         # sv sync below), so iteration wall == that segment's true cost
         # — this is what decomposed the round-5 bad-link capture's
         # 227.8 s build (68 s device floor vs per-segment sync/transfer
-        # tax; BASELINE.md round-5 capture section)
-        stats[key] = round(stats.get(key, 0.0) + dt, 3)
+        # tax; BASELINE.md round-5 capture section). Accumulate
+        # UNROUNDED: consumers round at read time — a per-add 3-decimal
+        # quantum over hundreds of segments can push sum(t_*) past the
+        # measured wall on fast machines
+        stats[key] = stats.get(key, 0.0) + dt
 
     while True:
         t0 = time.perf_counter()
@@ -887,6 +1047,11 @@ def _fold_adaptive_pos_impl(
         # full-buffer two-key sort every segment (measured: seconds at
         # C=2^24 on the v5e, swamping the rounds it saved)
         changed, r, live = (int(x) for x in np.asarray(sv))
+        # dispatch-count attribution: one host->device SYNC per segment
+        # is this driver's cost shape (each sv pull is a full link
+        # round-trip); the batched dispatch (fold_segments_batch) exists
+        # to amortize exactly this counter
+        stats["host_syncs"] = stats.get("host_syncs", 0) + 1
         t_add(t_key, time.perf_counter() - t0)
         total += r
         stats["device_rounds"] = stats.get("device_rounds", 0) + r

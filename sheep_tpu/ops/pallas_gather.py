@@ -1,6 +1,15 @@
 """Pallas VMEM-staged gather probe (SURVEY.md §7 step 7; VERDICT r3
 weak #3).
 
+CLOSED 2026-08-01: answered on real hardware — Mosaic rejects or
+crashes on every gather form larger than one (8, 128) register tile,
+probed exhaustively on-chip (tools/pallas_smoke{,2,3}.py; BASELINE.md
+round-5 capture section), so XLA's native gather stands as the
+hot-loop primitive by measurement. This module stays as the recorded
+artifact of that evaluation and for the interpreter-mode semantics pin
+(tests/test_pallas_gather.py); do not reopen without a new Mosaic
+toolchain.
+
 The build fixpoint is bound by random int32 gathers from the position
 table. XLA's arbitrary-index gather measured ~100-150 M elem/s on the
 v5e — ~50x under the HBM roofline — which is precisely the "XLA leaves

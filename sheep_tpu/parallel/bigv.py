@@ -70,10 +70,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from sheep_tpu.ops.elim import pow2_at_least
-from sheep_tpu.parallel.mesh import SHARD_AXIS
+from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 class BigVPipeline:

@@ -34,13 +34,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
-from sheep_tpu.parallel.mesh import SHARD_AXIS
+from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 def chunk_batches(stream, chunk_edges: int, n_devices: int, n: int,
@@ -125,11 +124,20 @@ class ShardedPipeline:
     """Compiled sharded pipeline for a fixed (n, chunk_edges, mesh)."""
 
     def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0,
-                 segment_rounds: int = 32, warm_schedule=((1, 8),)):
+                 segment_rounds: int = 32, warm_schedule=((1, 8),),
+                 dispatch_batch: int = 1):
         self.n = n
         self.cs = chunk_edges
         self.mesh = mesh
         self.lift_levels = lift_levels
+        # batched segment dispatch (ops/elim.py batch_segment_fixpoint):
+        # stage N sharded batches as (D, N, C) oriented blocks and fold
+        # them per device inside single bounded programs, pulling one
+        # replicated packed-stats word per execution instead of one
+        # changed/live pair per segment step. 1 = per-segment (the
+        # adaptive _fold_actives loop); the merged forest is the same
+        # unique fixpoint either way.
+        self.dispatch_batch = max(1, int(dispatch_batch))
         # fixpoint rounds per device execution in the build phase; the
         # host loops bounded segments so no single accelerator call runs
         # unboundedly long (the TPU worker watchdog kills those)
@@ -390,7 +398,91 @@ class ShardedPipeline:
         self.make_order = make_order
         self.score_step = score_step
 
+        nb = self.dispatch_batch
+        if nb > 1:
+            self.block_sharding = NamedSharding(
+                mesh, P(SHARD_AXIS, None, None))
+            self.block_edges_sharding = NamedSharding(
+                mesh, P(SHARD_AXIS, None, None, None))
+
+            @partial(jax.jit,
+                     in_shardings=(self.block_edges_sharding,
+                                   self.repl_sharding),
+                     out_shardings=(self.block_sharding,
+                                    self.block_sharding))
+            def orient_batch_step(blocks, pos):
+                def f(block_local, pos_):
+                    lo, hi = jax.vmap(
+                        lambda c: elim_ops.orient_edges_pos(c, pos_, n_))(
+                            block_local[0])
+                    return lo[None], hi[None]
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None, None, None), P()),
+                    out_specs=(P(SHARD_AXIS, None, None),
+                               P(SHARD_AXIS, None, None)))(blocks, pos)
+
+            # per-execution round budget: the same allowance the
+            # per-segment loop would spread over nb segment syncs
+            br = max(1, seg_) * nb
+
+            @partial(jax.jit,
+                     in_shardings=(self.state_sharding,
+                                   self.block_sharding,
+                                   self.block_sharding),
+                     out_shardings=(self.state_sharding,
+                                    self.block_sharding,
+                                    self.block_sharding,
+                                    self.repl_sharding))
+            def fold_batch_step(P_all, loB_all, hiB_all):
+                def f(P_local, loB_local, hiB_local):
+                    loB2, hiB2, Pn, sv = elim_ops.batch_segment_fixpoint(
+                        P_local[0], loB_local[0], hiB_local[0], n_,
+                        lift_levels=lift, batch_rounds=br)
+                    # lockstep: every device and process re-dispatches
+                    # until the SLOWEST device's block is drained (pmin
+                    # of segments-done); rounds/live are pmax'd, retires
+                    # psum'd — one replicated word, one host pull
+                    done_all = lax.pmin(sv[0], SHARD_AXIS)
+                    rounds_mx = lax.pmax(sv[1], SHARD_AXIS)
+                    live_mx = lax.pmax(sv[2], SHARD_AXIS)
+                    ret_sum = lax.psum(sv[3], SHARD_AXIS)
+                    return (Pn[None], loB2[None], hiB2[None],
+                            jnp.stack([done_all, rounds_mx, live_mx,
+                                       ret_sum]))
+                return shard_map(
+                    f, mesh=mesh,
+                    in_specs=(P(SHARD_AXIS, None),
+                              P(SHARD_AXIS, None, None),
+                              P(SHARD_AXIS, None, None)),
+                    out_specs=(P(SHARD_AXIS, None),
+                               P(SHARD_AXIS, None, None),
+                               P(SHARD_AXIS, None, None), P()))(
+                        P_all, loB_all, hiB_all)
+
+            self.orient_batch_step = orient_batch_step
+            self.fold_batch_step = fold_batch_step
+
     SMALL_SIZE = 1 << 14
+
+    def build_step_batch(self, P_all, blocks_dev, pos, stats=None):
+        """Fold ``dispatch_batch`` staged sharded batches — a
+        (D, N, C, 2) edge block — into the per-device forests with ONE
+        replicated stats pull per bounded batched execution (vs one
+        ``changed`` pull per segment step in :meth:`build_step`)."""
+        loB, hiB = self.orient_batch_step(blocks_dev, pos)
+        while True:
+            P_all, loB, hiB, sv = self.fold_batch_step(P_all, loB, hiB)
+            done, r, live, ret = (int(x) for x in np.asarray(sv))
+            if stats is not None:
+                stats["host_syncs"] = stats.get("host_syncs", 0) + 1
+                stats["batch_execs"] = stats.get("batch_execs", 0) + 1
+                stats["batch_retired"] = stats.get("batch_retired", 0) + ret
+                # max over devices: the lockstep wall is the slowest one
+                stats["device_rounds"] = \
+                    stats.get("device_rounds", 0) + r
+            if done >= self.dispatch_batch:
+                return P_all
 
     def _fold_actives(self, P_all, lo_all, hi_all, skip_warm: bool = False):
         """Adaptive host-driven fold of (D, W) active-constraint buffers
@@ -554,7 +646,7 @@ class ShardedPipeline:
         from sheep_tpu.ops.split import tree_split_host
         from sheep_tpu.utils import checkpoint as ckpt
         from sheep_tpu.utils.fault import maybe_fail
-        from sheep_tpu.utils.prefetch import prefetch
+        from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
@@ -623,6 +715,7 @@ class ShardedPipeline:
         # checkpoint/phase boundaries.
         t0 = time.perf_counter()
         merge_stats: dict = {}
+        build_stats: dict = {}
         if state and from_phase >= 2:
             merged_minp = jnp.asarray(state.arrays["merged"])
         else:
@@ -646,17 +739,56 @@ class ShardedPipeline:
                 P_all = self.init_forest()
                 start = 0
             batches = 0
-            for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
-                P_all = self.build_step(P_all, self.put_batch(batch), pos)
-                batches += 1
-                maybe_fail("build", batches)
-                if checkpointer is not None and \
-                        checkpointer.due_span((batches - 1) * d, batches * d):
-                    partial = np.asarray(self.to_minp(
-                        self.merge(P_all, stats=merge_stats), pos))
-                    checkpointer.save(
-                        "build", start + batches * d,
-                        {"deg": deg_host, "merged_partial": partial}, meta)
+            if self.dispatch_batch > 1:
+                # batched segment dispatch: stage dispatch_batch sharded
+                # batches as one (rows, N, C, 2) block per process —
+                # the prefetch worker groups the lockstep batch stream,
+                # so every process stages identical groups and the
+                # pmin'd stats keep the collective schedules aligned
+                nb = self.dispatch_batch
+                build_stats["dispatch_batch"] = nb
+                empty = None
+                for group in prefetch_batched(
+                        self.iter_batches(stream, start_chunk=start), nb):
+                    gl = len(group)
+                    if gl < nb:
+                        if empty is None:
+                            empty = np.full((self.n_local, cs, 2), n,
+                                            np.int32)
+                        group = group + [empty] * (nb - gl)
+                    blocks = np.stack(group, axis=1)
+                    before = batches
+                    P_all = self.build_step_batch(
+                        P_all,
+                        self._put(self.block_edges_sharding, blocks),
+                        pos, stats=build_stats)
+                    batches += gl
+                    for b in range(before + 1, batches + 1):
+                        maybe_fail("build", b)
+                    if checkpointer is not None and \
+                            checkpointer.due_span(before * d, batches * d):
+                        partial = np.asarray(self.to_minp(
+                            self.merge(P_all, stats=merge_stats), pos))
+                        checkpointer.save(
+                            "build", start + batches * d,
+                            {"deg": deg_host, "merged_partial": partial},
+                            meta)
+            else:
+                for batch in prefetch(self.iter_batches(stream,
+                                                        start_chunk=start)):
+                    P_all = self.build_step(P_all, self.put_batch(batch),
+                                            pos)
+                    batches += 1
+                    maybe_fail("build", batches)
+                    if checkpointer is not None and \
+                            checkpointer.due_span((batches - 1) * d,
+                                                  batches * d):
+                        partial = np.asarray(self.to_minp(
+                            self.merge(P_all, stats=merge_stats), pos))
+                        checkpointer.save(
+                            "build", start + batches * d,
+                            {"deg": deg_host, "merged_partial": partial},
+                            meta)
             merged_minp = self.to_minp(
                 self.merge(P_all, stats=merge_stats), pos)
             np.asarray(merged_minp[:1])  # real completion barrier
@@ -726,5 +858,5 @@ class ShardedPipeline:
             "assignment": assign_host, "parent": parent, "pos": pos_host,
             "degrees": deg_host, "edge_cut": cut, "total_edges": total,
             "balance": balance, "comm_volume": cv, "k": k,
-            "merge_stats": merge_stats,
+            "merge_stats": merge_stats, "build_stats": build_stats,
         }

@@ -16,7 +16,7 @@ from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
 
 
 def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
-                      descent: str = "auto") -> dict:
+                      descent: str = "auto", dispatch_batch: int = 1) -> dict:
     """Estimated peak device bytes for one build_chunk_step.
 
     The displacement fixpoint (ops/elim.py fold_edges) keeps the carried
@@ -27,6 +27,12 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     (lo/hi/poshi/old_at_lo/now/new_lo), and the lifting table stack
     (exact descent: lift_levels tables bounded by EXACT_TABLE_BYTES;
     stream descent: 1 table).
+
+    ``dispatch_batch`` > 1 (the batched segment dispatch,
+    ops/elim.py fold_segments_batch) additionally stages N segments on
+    device at once: the raw (N, C, 2) chunk stack plus the oriented
+    [N, C] lo/hi blocks — the O(C) transient invariant becomes O(N*C),
+    which is exactly what :func:`dispatch_batch_for` sizes N against.
     """
     if lift_levels <= 0:
         lift_levels = max(1, int(n).bit_length())
@@ -37,14 +43,35 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     lift_bytes = min(stack, EXACT_TABLE_BYTES) if descent == "exact" else table
     persistent = 4 * table  # pos, order, minp x2 (loop carry)
     transient = 6 * 4 * chunk_edges
-    total = persistent + transient + lift_bytes
+    # chunk stack (2C words/row) + oriented lo/hi blocks (2C words/row)
+    staging = 4 * 4 * chunk_edges * dispatch_batch if dispatch_batch > 1 \
+        else 0
+    total = persistent + transient + staging + lift_bytes
     return {
         "persistent_bytes": persistent,
         "transient_bytes": transient,
+        "staging_bytes": staging,
         "lift_bytes": lift_bytes,
         "descent": descent,
         "total_bytes": total,
     }
+
+
+def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
+                       cap: int = 16) -> int:
+    """Largest power-of-two dispatch batch N in [1, cap] whose staged
+    build phase fits ``hbm_bytes`` — the ``--dispatch-batch 0`` (auto)
+    sizing rule. Power-of-two N keeps the set of compiled batch-program
+    shapes logarithmic, like every other buffer-sizing rule here."""
+    best = 1
+    nb = 2
+    while nb <= cap:
+        if build_phase_bytes(n, chunk_edges,
+                             dispatch_batch=nb)["total_bytes"] > hbm_bytes:
+            break
+        best = nb
+        nb *= 2
+    return best
 
 
 def max_vertices_for(hbm_bytes: int, chunk_edges: int) -> int:

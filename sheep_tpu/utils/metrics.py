@@ -60,6 +60,30 @@ def _jsonable(x):
     raise TypeError(f"not JSON serializable: {type(x)}")
 
 
+def solve_dispatch_attribution(a: dict, b: dict) -> Optional[dict]:
+    """Count x round-cost A/B attribution (VERDICT r5 items 2/7): given
+    two measurements of the same build with different dispatch batching
+    — dicts with ``wall_s``, ``syncs`` (host->device sync count, the
+    ``host_syncs`` diagnostic) and ``rounds`` (``device_rounds``) —
+    solve the 2x2 system
+
+        wall = syncs * per_dispatch_s + rounds * per_round_s
+
+    for the per-dispatch overhead and per-round device cost. This is
+    what makes the batched-dispatch win provable from dispatch counts
+    alone, even on the CPU mesh: the counts are deterministic, only the
+    two cost coefficients are hardware-dependent. Returns None when the
+    system is degenerate (the two runs have the same sync/round mix —
+    nothing to attribute)."""
+    det = a["syncs"] * b["rounds"] - b["syncs"] * a["rounds"]
+    if det == 0:
+        return None
+    per_dispatch = (a["wall_s"] * b["rounds"]
+                    - b["wall_s"] * a["rounds"]) / det
+    per_round = (a["syncs"] * b["wall_s"] - b["syncs"] * a["wall_s"]) / det
+    return {"per_dispatch_s": per_dispatch, "per_round_s": per_round}
+
+
 def device_memory_stats() -> Optional[dict]:
     """Allocator stats of the default device (HBM high-water mark on TPU);
     None where the platform doesn't expose them (e.g. CPU)."""

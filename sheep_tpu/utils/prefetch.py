@@ -76,3 +76,29 @@ def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
             yield item
     finally:
         stop.set()
+
+
+def prefetch_batched(iterable: Iterable[T], batch: int,
+                     depth: int = 2) -> Iterator[list]:
+    """Group ``iterable`` into lists of up to ``batch`` items on the
+    prefetch worker thread — the staging primitive of the batched
+    segment dispatch: all N chunks of the NEXT enlarged device program
+    are read + parsed + padded while the device runs the current one
+    (``depth`` counts staged *groups*, so depth 2 keeps up to 2N items
+    in flight). Order, completeness, exception propagation and early
+    consumer exit behave exactly as :func:`prefetch`; the final group
+    may be shorter than ``batch``."""
+    if batch < 1:
+        raise ValueError("prefetch batch must be >= 1")
+
+    def grouped():
+        buf: list = []
+        for item in iterable:
+            buf.append(item)
+            if len(buf) == batch:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    return prefetch(grouped(), depth=depth)

@@ -1,13 +1,17 @@
-"""bench.py JSON contract tests (VERDICT r3 item 6).
+"""bench.py JSON contract tests (VERDICT r3 item 6, r5 item 7).
 
-Two properties the driver relies on:
+Three properties the driver relies on:
   (a) the multi-chip leg — the exact code path that will emit
       ``vs_baseline_8chip`` on real multi-chip hardware — compiles and
       runs on the 8-device virtual mesh (``SHEEP_BENCH_MULTICHIP=1``
       forces it on cpu-jax);
   (b) a cpu-jax fallback run emits ``vs_baseline: null`` (the cpu-jax vs
       native-CPU ratio is framework overhead, not the north-star metric,
-      and lives under ``cpu_jax_vs_native_cpu``).
+      and lives under ``cpu_jax_vs_native_cpu``);
+  (c) every emitted line carries the per-window link-state fields
+      ``{rtt_ms, h2d_mbs, d2h_mbs}`` plus ``r_colo_est`` and the
+      dispatch-count attribution inputs, so headline numbers are
+      comparable across link-quality swings.
 """
 
 import json
@@ -34,6 +38,10 @@ def test_measure_multichip_leg_on_virtual_mesh(monkeypatch):
     assert out["n_devices"] == 8
     assert out["sharded_eps"] > 0
     assert out["ratio_multichip"] > 0
+    # link-state + co-located-R contract fields (VERDICT r5 item 7)
+    for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est"):
+        assert out[f] > 0, f
+    assert out["host_syncs"] >= 0 and out["device_rounds"] > 0
     # the sharded path partitions the same counter-hash graph: its cut
     # must be in the same regime as the baselines (not degenerate)
     assert 0.0 < out["sharded_cut_ratio"] <= 1.0
@@ -52,3 +60,8 @@ def test_fallback_emits_null_vs_baseline():
     assert line["value"] > 0
     assert line["cpu_jax_vs_native_cpu"] > 0
     assert "error" in line
+    # the link-state + r_colo_est contract rides on EVERY emitted line,
+    # fallback included — that is what makes a degraded-window capture
+    # normalizable after the fact
+    for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est"):
+        assert line[f] > 0, f

@@ -33,6 +33,17 @@ from sheep_tpu.utils.platform import pin_platform  # noqa: E402
 pin_platform(os.environ.get("SHEEP_QUALITY_PLATFORM") or "cpu")
 
 
+def _num(v):
+    """Diagnostics values are floats in the common case but can be
+    status strings (e.g. the refine pass's 'refine_skipped' fallback) —
+    coerce defensively so a completed multi-hour partition always writes
+    its artifact instead of dying on float('refine_skipped')."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=int, default=22)
@@ -82,7 +93,7 @@ def main():
                        else int(res.comm_volume),
         "wall_s_contended": round(wall, 1),
         "phase_times": res.phase_times,
-        "diagnostics": {k: float(v) for k, v in
+        "diagnostics": {k: _num(v) for k, v in
                         (res.diagnostics or {}).items()},
         "planted_optimum": round(planted, 4),
         "history": {"flat_r30": 0.8467, "hier_r4": 0.4313,

@@ -98,6 +98,10 @@ def main():
                     help="pin a platform (e.g. cpu) before jax init")
     ap.add_argument("--hbm-gbps", type=float, default=820.0,
                     help="roofline bandwidth for the ratio column")
+    ap.add_argument("--only-gather-conc", action="store_true",
+                    help="run ONLY the gather-concurrency leg (VERDICT "
+                         "r5 item 8) — cheap enough for a short healthy "
+                         "tunnel window; the watcher arms this form")
     args = ap.parse_args()
 
     if args.platform:
@@ -116,6 +120,60 @@ def main():
     lat = calibrate_latency()
     emit(bench="call_latency", seconds=round(lat, 6), platform=plat)
     log(f"per-call round-trip latency: {lat * 1e3:.1f} ms (subtracted)")
+
+    def report(name, seconds, bytes_moved, extra=None):
+        gbps = bytes_moved / seconds / 1e9
+        line = {"bench": name, "seconds": round(seconds, 6),
+                "effective_GBps": round(gbps, 2),
+                "vs_hbm_roofline": round(gbps / args.hbm_gbps, 4),
+                "platform": plat}
+        if extra:
+            line.update(extra)
+        emit(**line)
+        log(f"{name:28s} {seconds * 1e3:9.2f} ms   {gbps:8.1f} GB/s "
+            f"({100 * gbps / args.hbm_gbps:5.1f}% of roofline)")
+
+    def gather_concurrency_leg():
+        """The last falsifiable R probe (VERDICT r5 item 8): XLA's
+        ~120 M elem/s gather is 0.2% of HBM roofline — if per-op LATENCY
+        (not bandwidth) binds, K independent C-from-V gathers inside one
+        XLA program overlap and the K=4 one-program row beats 4x the
+        K=1 row; if the rows are flat per gather, R is formally closed.
+        Both forms measured: one fused program vs K separate program
+        dispatches (completion forced once at the end either way)."""
+        for K in (1, 2, 4):
+            tabs = [jax.random.randint(jax.random.PRNGKey(10 + j),
+                                       (n + 1,), 0, n, dtype=jnp.int32)
+                    for j in range(K)]
+            idxs = [jax.random.randint(jax.random.PRNGKey(20 + j),
+                                       (c,), 0, n, dtype=jnp.int32)
+                    for j in range(K)]
+
+            def fused(*ops):
+                ts, is_ = ops[:K], ops[K:]
+                return sum(jnp.sum(t[i], dtype=jnp.int64)
+                           for t, i in zip(ts, is_))
+
+            s = timeit(jax.jit(fused), *tabs, *idxs)
+            report(f"gather_conc_K{K}_one_program", s, 4 * 3 * c * K,
+                   {"K": K, "melems_per_s": round(K * c / s / 1e6, 1)})
+
+            g = jax.jit(lambda t, i: jnp.sum(t[i], dtype=jnp.int64))
+
+            def k_programs():
+                acc = None
+                for t, i in zip(tabs, idxs):
+                    o = g(t, i)
+                    acc = o if acc is None else acc + o
+                return acc
+
+            s = timeit(k_programs)
+            report(f"gather_conc_K{K}_k_programs", s, 4 * 3 * c * K,
+                   {"K": K, "melems_per_s": round(K * c / s / 1e6, 1)})
+
+    if args.only_gather_conc:
+        gather_concurrency_leg()
+        return
 
     # transfer bandwidth: the tunnel's h2d/d2h rate bounds every phase
     # that streams chunks from host (64 MiB probes)
@@ -141,18 +199,6 @@ def main():
     table = jax.random.randint(k1, (n + 1,), 0, n, dtype=jnp.int32)
     idx_c = jax.random.randint(k2, (c,), 0, n, dtype=jnp.int32)
     vals = jax.random.randint(k3, (c,), 0, n, dtype=jnp.int32)
-
-    def report(name, seconds, bytes_moved, extra=None):
-        gbps = bytes_moved / seconds / 1e9
-        line = {"bench": name, "seconds": round(seconds, 6),
-                "effective_GBps": round(gbps, 2),
-                "vs_hbm_roofline": round(gbps / args.hbm_gbps, 4),
-                "platform": plat}
-        if extra:
-            line.update(extra)
-        emit(**line)
-        log(f"{name:28s} {seconds * 1e3:9.2f} ms   {gbps:8.1f} GB/s "
-            f"({100 * gbps / args.hbm_gbps:5.1f}% of roofline)")
 
     # 1. random gather, C indices into a V-table (the climb's dominant op)
     g = jax.jit(lambda t, i: t[i])
@@ -257,6 +303,9 @@ def main():
         m, l, h, n, segment_rounds=1)[2]),
         minp, pos[lo[:small]], pos[hi[:small]])
     report("jump_round_16k", s, 4 * 16 * 2 * small)
+
+    # 7. gather concurrency (VERDICT r5 item 8) — see the leg's docstring
+    gather_concurrency_leg()
 
     if args.profile_dir:
         with jax.profiler.trace(args.profile_dir):

@@ -54,6 +54,15 @@ except Exception: print(0)")
       rc=$?
       rm -f "$flag"
       cat "$out/bench.json" | tee -a "$out/watch.log"
+      # armed probe (VERDICT r5 item 8): the gather-concurrency leg is
+      # the last falsifiable R lever — K independent gathers in one
+      # program vs K programs. Cheap (<2 min warm), runs in EVERY good
+      # window the bench used, win or lose, so even a window that dies
+      # mid-bench can still close R with an artifact.
+      timeout 300 python tools/microbench_fixpoint.py --only-gather-conc \
+        > "$out/gather_conc.jsonl" 2>>"$out/watch.log"
+      echo "gather-concurrency rows banked in $out/gather_conc.jsonl" \
+        | tee -a "$out/watch.log"
       if [ "$rc" = 0 ] && grep -q '"platform": "tpu"' "$out/bench.json"; then
         echo "GOOD-LINK HEADLINE LANDED in $out" | tee -a "$out/watch.log"
         exit 0
