@@ -218,11 +218,32 @@ def measure(scale: int, platform: str) -> dict:
     def timed_leg(backend_name):
         """Warm-up (compile) partition + one timed partition; shared by
         the single-chip and multi-chip legs so the timing methodology
-        cannot drift between them."""
+        cannot drift between them. SHEEP_BENCH_TRACE=DIR captures a
+        structured obs trace (manifest + span tree + counters; see
+        tools/trace_report.py) of the TIMED leg only — the warm-up's
+        compile wall would drown the steady-state tree. Tracing off is
+        the default and adds nothing to the measured path."""
         be = get_backend(backend_name, chunk_edges=min(accel_chunk, m))
         t0 = time.perf_counter()
         be.partition(dev_stream, k, comm_volume=False)  # compile warm-up
         warm = time.perf_counter() - t0
+        trace_dir = os.environ.get("SHEEP_BENCH_TRACE")
+        if trace_dir:
+            from sheep_tpu import obs
+
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(trace_dir,
+                                f"trace_{backend_name}_s{scale}.jsonl")
+            with obs.tracing(path) as tr:
+                obs.emit_manifest(tr, backend=backend_name,
+                                  config={"scale": scale, "k": k,
+                                          "edge_factor": edge_factor,
+                                          "platform": platform})
+                t0 = time.perf_counter()
+                res = be.partition(dev_stream, k, comm_volume=False)
+                leg_s = time.perf_counter() - t0
+            log(f"obs trace captured: {path}")
+            return res, leg_s, warm
         t0 = time.perf_counter()
         res = be.partition(dev_stream, k, comm_volume=False)
         return res, time.perf_counter() - t0, warm

@@ -12,6 +12,7 @@ import time
 
 import numpy as np
 
+from sheep_tpu import obs
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.core import native, pure
 from sheep_tpu.types import PartitionResult
@@ -37,6 +38,10 @@ class CpuBackend(Partitioner):
         t = {}
         t0 = time.perf_counter()
         n = stream.num_vertices
+        root_sp = obs.begin("partition", backend=self.name, k=int(k),
+                            n=int(n))
+        m_cheap = stream.num_edges_cheap
+        obs.progress(backend=self.name, k=int(k), edges_total=m_cheap)
         meta = ckpt.stream_meta(stream, k, self.chunk_edges, weights=weights,
                                 alpha=self.alpha, comm_volume=comm_volume,
                                 state_format="parent")
@@ -47,6 +52,8 @@ class CpuBackend(Partitioner):
             deg = state.arrays["deg"].copy()
         else:
             deg = np.zeros(n, dtype=np.int64)
+        sp = obs.begin("degrees")
+        obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             idx = start
@@ -54,15 +61,21 @@ class CpuBackend(Partitioner):
                 native.degrees(chunk, n, out=deg)
                 idx += 1
                 maybe_fail("degrees", idx - start)
+                obs.chunk_progress(idx, self.chunk_edges, m_cheap)
                 if checkpointer is not None and checkpointer.due(idx - start):
                     checkpointer.save("degrees", idx, {"deg": deg}, meta)
         t["degrees"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("sort")
         pos = native.elim_order(deg)
         t["sort"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("build")
+        obs.progress(phase="build", chunks_done=0, edges_done=0)
         if state and from_phase >= 2:
             parent = state.arrays["parent"].copy()
         else:
@@ -77,17 +90,23 @@ class CpuBackend(Partitioner):
                 native.build_elim_tree(chunk, pos, parent=parent)
                 idx += 1
                 maybe_fail("build", idx - start)
+                obs.chunk_progress(idx, self.chunk_edges, m_cheap)
                 if checkpointer is not None and checkpointer.due(idx - start):
                     checkpointer.save("build", idx,
                                       {"deg": deg, "parent": parent}, meta)
         t["build"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("split")
         w = deg.astype(np.float64) if weights == "degree" else None
         assignment = native.tree_split(parent, pos, k, weights=w, alpha=self.alpha)
         t["split"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("score")
+        obs.progress(phase="score", chunks_done=0, edges_done=0)
         cut = total = 0
         cv_parts = []
         start = 0
@@ -106,6 +125,7 @@ class CpuBackend(Partitioner):
                 cv_parts.append(native.cut_pairs(chunk, assignment, n, k))
             idx += 1
             maybe_fail("score", idx - start)
+            obs.chunk_progress(idx, self.chunk_edges, m_cheap)
             if checkpointer is not None and checkpointer.due(idx - start):
                 cv_parts = ckpt.save_score_state(
                     checkpointer, idx, cut, total, cv_parts,
@@ -113,6 +133,8 @@ class CpuBackend(Partitioner):
         cv = int(len(ckpt.compact_cv_keys(cv_parts))) if comm_volume else None
         balance = pure.part_balance(assignment, k, deg if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        sp.end()
+        root_sp.end()
         if checkpointer is not None:
             checkpointer.clear()
 

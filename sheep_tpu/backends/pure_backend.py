@@ -12,6 +12,7 @@ import time
 
 import numpy as np
 
+from sheep_tpu import obs
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.core import pure
 from sheep_tpu.types import ElimTree, PartitionResult
@@ -33,30 +34,51 @@ class PureBackend(Partitioner):
         t = {}
         t0 = time.perf_counter()
         n = stream.num_vertices
+        root_sp = obs.begin("partition", backend=self.name, k=int(k), n=int(n))
+        m_cheap = stream.num_edges_cheap
+        obs.progress(backend=self.name, k=int(k),
+                     edges_total=m_cheap, phase="degrees", chunks_done=0)
+        sp = obs.begin("degrees")
         deg = np.zeros(n, dtype=np.int64)
+        idx = 0
         for chunk in stream.chunks(self.chunk_edges):
             deg += pure.degrees(chunk, n)
+            idx += 1
+            obs.chunk_progress(idx, self.chunk_edges, m_cheap)
         t["degrees"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("sort")
         pos = pure.elimination_order(deg)
         t["sort"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("build")
+        obs.progress(phase="build", chunks_done=0, edges_done=0)
         parent = None
+        idx = 0
         for chunk in stream.chunks(self.chunk_edges):
             parent = pure.build_elim_tree(chunk, pos, parent=parent).parent
+            idx += 1
+            obs.chunk_progress(idx, self.chunk_edges, m_cheap)
         if parent is None:
             parent = np.full(n, -1, dtype=np.int64)
         tree = ElimTree(parent=parent, pos=pos, n=n)
         t["build"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("split")
         w = deg if weights == "degree" else None
         assignment = pure.tree_split(tree, k, w, alpha=self.alpha)
         t["split"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("score")
+        obs.progress(phase="score", chunks_done=0, edges_done=0)
         cut = total = 0
         cv_pairs = []
         for chunk in stream.chunks(self.chunk_edges):
@@ -69,6 +91,8 @@ class PureBackend(Partitioner):
             if comm_volume else None
         balance = pure.part_balance(assignment, k, w)
         t["score"] = time.perf_counter() - t0
+        sp.end()
+        root_sp.end()
 
         return PartitionResult(
             assignment=assignment,

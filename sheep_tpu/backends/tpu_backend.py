@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sheep_tpu import obs
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
@@ -142,9 +143,15 @@ def _device_hbm_bytes(purpose: str = "the chunk cache") -> int:
         if hbm:
             import sys
 
+            # the override differs by purpose: SHEEP_CACHE_BYTES only
+            # budgets the chunk cache; the dispatch batch is overridden
+            # by its own knob — advising the wrong one sends an OOMing
+            # operator in circles
+            override = "SHEEP_CACHE_BYTES" \
+                if purpose == "the chunk cache" else "--dispatch-batch N"
             print(f"note: device reports no bytes_limit; inferring "
                   f"{g} GiB HBM from device_kind {kind!r} for {purpose} "
-                  f"(override with SHEEP_CACHE_BYTES)",
+                  f"(override with {override})",
                   file=sys.stderr)
     return hbm
 
@@ -323,6 +330,12 @@ class TpuBackend(Partitioner):
         t0 = time.perf_counter()
         n = stream.num_vertices
         check_tpu_vertex_range(n, self.name)
+        root_sp = obs.begin("partition", backend=self.name, k=int(k),
+                            n=int(n), chunk_edges=int(cs))
+        stats_acc = obs.stats_accumulator()
+        m_cheap = stream.num_edges_cheap
+        obs.progress(backend=self.name, k=int(k), edges_total=m_cheap,
+                     chunks_total=-(-m_cheap // cs) if m_cheap else None)
         carry_mode = bool(self.carry_tail)
         meta = ckpt.stream_meta(stream, k, cs, weights=weights,
                                 alpha=self.alpha, comm_volume=comm_volume,
@@ -343,6 +356,8 @@ class TpuBackend(Partitioner):
         cache_budget = _chunk_cache_budget(n, cs, dispatch_batch=batch_n) \
             if self.cache_chunks else 0
         cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
+        sp = obs.begin("degrees")
+        obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             deg = degrees_ops.init_degrees(n)
@@ -354,6 +369,7 @@ class TpuBackend(Partitioner):
                 since_flush += 1
                 idx += 1
                 maybe_fail("degrees", idx - start)
+                obs.chunk_progress(idx, cs, m_cheap)
                 at_ckpt = checkpointer is not None and checkpointer.due(idx - start)
                 if since_flush >= flush_every or at_ckpt:
                     deg_host += np.asarray(deg[:n], dtype=np.int64)
@@ -363,8 +379,10 @@ class TpuBackend(Partitioner):
                     checkpointer.save("degrees", idx, {"deg": deg_host}, meta)
             deg_host += np.asarray(deg[:n], dtype=np.int64)
         t["degrees"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("sort")
         # positions are int32 ranks; degree values only matter ordinally, so
         # clip the int64 totals into int32 for the device sort via rankdata
         deg_rank = deg_host if deg_host.size == 0 or deg_host.max() < 2**31 \
@@ -375,9 +393,12 @@ class TpuBackend(Partitioner):
         # not a real barrier on a tunneled device (BASELINE.md fact 3)
         np.asarray(pos[:1])
         t["sort"] = time.perf_counter() - t0
+        sp.end()
         pos_host_cache = None
 
         t0 = time.perf_counter()
+        sp = obs.begin("build")
+        obs.progress(phase="build", chunks_done=0, edges_done=0)
         build_stats: dict = {}
         total_rounds = 0
         if state and from_phase >= 2:
@@ -454,6 +475,7 @@ class TpuBackend(Partitioner):
                                                           jnp.int32)
                             group = group + [sentinel_chunk] * \
                                 (batch_n - gl)
+                        dsp = obs.begin("dispatch", i=idx, chunks=gl)
                         loB, hiB = elim_ops.orient_chunks_batch_pos(
                             jnp.stack(group), pos, n)
                         P, rounds = elim_ops.fold_segments_batch(
@@ -462,8 +484,11 @@ class TpuBackend(Partitioner):
                             segment_rounds=self.segment_rounds,
                             stats=build_stats)
                         total_rounds += int(rounds)
+                        stats_acc.absorb(build_stats)
+                        dsp.end(rounds=int(rounds))
                         prev = idx
                         idx += gl
+                        obs.chunk_progress(idx, cs, m_cheap)
                         for i in range(prev + 1, idx + 1):
                             maybe_fail("build", i - start)
                         if checkpointer is not None and \
@@ -476,6 +501,7 @@ class TpuBackend(Partitioner):
                 else:
                     for padded in _device_chunks(stream, cs, n, cache,
                                                  start):
+                        seg_sp = obs.begin("segment", i=idx)
                         if overlap:
                             # pick up any host-resolved tails without
                             # waiting; they enter this fold as ordinary
@@ -503,7 +529,10 @@ class TpuBackend(Partitioner):
                         else:
                             P, rounds = step
                         total_rounds += int(rounds)
+                        stats_acc.absorb(build_stats)
+                        seg_sp.end(rounds=int(rounds))
                         idx += 1
+                        obs.chunk_progress(idx, cs, m_cheap)
                         maybe_fail("build", idx - start)
                         if checkpointer is not None and \
                                 checkpointer.due(idx - start):
@@ -531,8 +560,11 @@ class TpuBackend(Partitioner):
             minp = P[pos]
             np.asarray(minp[:1])  # real completion barrier (see above)
         t["build"] = time.perf_counter() - t0
+        stats_acc.absorb(build_stats)
+        sp.end(fixpoint_rounds=int(total_rounds))
 
         t0 = time.perf_counter()
+        sp = obs.begin("split")
         parent = elim_ops.minp_to_parent(minp, order, n)
         pos_host = pos_host_cache if pos_host_cache is not None \
             else np.asarray(pos[:n])
@@ -543,8 +575,11 @@ class TpuBackend(Partitioner):
             [jnp.asarray(assign_host, dtype=jnp.int32),
              jnp.zeros(1, dtype=jnp.int32)])
         t["split"] = time.perf_counter() - t0
+        sp.end()
 
         t0 = time.perf_counter()
+        sp = obs.begin("score")
+        obs.progress(phase="score", chunks_done=0, edges_done=0)
         cut = total = 0
         cv_chunks = []
         start = 0
@@ -565,6 +600,7 @@ class TpuBackend(Partitioner):
                     score_ops.cut_pair_keys_host(padded, assign, n, k))
             idx += 1
             maybe_fail("score", idx - start)
+            obs.chunk_progress(idx, cs, m_cheap)
             if checkpointer is not None and checkpointer.due(idx - start):
                 cv_chunks = ckpt.save_score_state(
                     checkpointer, idx, cut, total, cv_chunks,
@@ -576,6 +612,8 @@ class TpuBackend(Partitioner):
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        sp.end()
+        root_sp.end()
         if checkpointer is not None:
             checkpointer.clear()
 

@@ -72,7 +72,13 @@ class TpuShardedBackend(Partitioner):
             cut_ratio=out["edge_cut"] / max(out["total_edges"], 1),
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
-            diagnostics={k_: (v if isinstance(v, (int, float)) else str(v))
+            # t_* walls accumulate unrounded (elim.py t_add convention)
+            # and are rounded here at read time, matching the tpu
+            # backend and bench.py so artifacts stay diffable
+            diagnostics={k_: (round(v, 3) if k_.startswith("t_")
+                              and isinstance(v, float)
+                              else v if isinstance(v, (int, float))
+                              else str(v))
                          for k_, v in {**out.get("build_stats", {}),
                                        **out.get("merge_stats", {})}.items()},
             tree={"parent": np.asarray(out["parent"]), "pos": out["pos"],

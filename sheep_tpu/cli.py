@@ -163,6 +163,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-out", default=None,
                    help="append structured JSONL metrics (phases, scores, "
                         "part loads, device memory) to this file")
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="append a structured trace (JSONL: run manifest, "
+                        "hierarchical span tree with counter deltas, "
+                        "heartbeats, scores) to FILE; render with "
+                        "tools/trace_report.py. Multi-host runs trace on "
+                        "process 0 only")
+    p.add_argument("--heartbeat-secs", type=float, default=None,
+                   metavar="S",
+                   help="with --trace: emit a progress heartbeat record "
+                        "(phase, chunks done, edges/sec, ETA, dispatch "
+                        "counts, device memory) every S seconds, plus one "
+                        "final flush — a dead run stops heartbeating, a "
+                        "slow one doesn't")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save O(V) chunk-level checkpoints to this dir")
     p.add_argument("--checkpoint-every", type=int, default=64,
@@ -206,6 +219,65 @@ def _parse_warm_schedule(spec: str, parser) -> tuple:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.heartbeat_secs is not None:
+        if args.trace is None:
+            parser.error("--heartbeat-secs requires --trace (heartbeats "
+                         "are trace records)")
+        if args.heartbeat_secs <= 0:
+            parser.error("--heartbeat-secs must be > 0")
+    # multi-host: one trace file, written by process 0 (every other rank
+    # runs untraced — the obs facade is a no-op without an installed
+    # tracer, so the instrumented loops cost nothing there). A
+    # rank-autodetected launch (--coordinator without --process-id)
+    # cannot know its rank this early, so it runs untraced rather than
+    # risking every rank appending to one file.
+    multi_host = args.coordinator or args.num_processes
+    is_rank0 = args.process_id == 0 or (args.process_id is None
+                                        and not multi_host)
+    if args.trace is None or not is_rank0:
+        return _run(parser, args)
+
+    # pin the platform BEFORE the manifest's topology probe, for the
+    # same reason _run pins it before touching backends (a TPU plugin
+    # pre-import makes JAX_PLATFORMS a no-op on its own)
+    from sheep_tpu.utils.platform import pin_platform
+
+    pin_platform()
+    from sheep_tpu import obs
+
+    tracer = obs.install(obs.Tracer(args.trace))
+    root = None
+    try:
+        if not multi_host:
+            _start_trace_run(tracer, args)
+        # multi-host: the manifest's topology probe would initialize the
+        # jax backend, and jax.distributed.initialize REQUIRES that no
+        # computation ran yet — _run emits manifest + starts the
+        # heartbeat right after the distributed bring-up instead
+        root = obs.begin("run")
+        return _run(parser, args)
+    finally:
+        if tracer.heartbeat is not None:
+            tracer.heartbeat.stop()
+        if root is not None:
+            root.end()
+        obs.uninstall()
+        tracer.close()
+
+
+def _start_trace_run(tracer, args) -> None:
+    """Manifest + heartbeat for a traced run. Called only once probing
+    the jax topology is safe: immediately for single-process runs,
+    after ``jax.distributed.initialize`` for multi-host ones."""
+    from sheep_tpu import obs
+
+    obs.emit_manifest(tracer, config=vars(args), backend=args.backend)
+    if args.heartbeat_secs:
+        tracer.heartbeat = obs.Heartbeat(
+            tracer, args.heartbeat_secs).start()
+
+
+def _run(parser, args) -> int:
 
     def _score_only(args):
         """--score-only PARTS: evaluate an existing partition map against
@@ -245,6 +317,9 @@ def main(argv=None) -> int:
                 "cut_ratio": cut / max(total, 1), "balance": balance,
                 "comm_volume": cv, "backend": "score-only",
                 "wall_seconds": round(wall, 4), "n_vertices": n}
+        from sheep_tpu import obs
+
+        obs.event("scores", **line)
         if not args.json:
             print(f"score-only: {args.score_only} vs {args.input}")
             print(f"k={k}: edge cut {cut:,} "
@@ -341,6 +416,9 @@ def main(argv=None) -> int:
         summary = res.summary()
         summary["wall_seconds"] = round(wall, 4)
         summary["n_vertices"] = int(len(res.assignment))
+        from sheep_tpu import obs
+
+        obs.event("scores", **summary)
         if not args.json:
             print(f"graph: {args.input}  k-levels: {levels}")
             print(f"k={res.k}: edge cut {res.edge_cut:,} "
@@ -411,6 +489,15 @@ def main(argv=None) -> int:
         is_main = process_id == 0
         if args.backend is None:
             args.backend = "tpu-sharded"
+        from sheep_tpu import obs as _obs
+
+        tracer = _obs.get_tracer()
+        if tracer is not None:
+            # deferred trace bring-up (see main): the topology probe is
+            # safe now that the distributed runtime is initialized — and
+            # it sits after the backend default so the manifest records
+            # the backend that will actually run
+            _start_trace_run(tracer, args)
 
     backend = args.backend
     if backend is None:
@@ -529,6 +616,12 @@ def main(argv=None) -> int:
                     print(f"note: backend {backend!r} does not take "
                           f"{', '.join(dropped)}; ignored", file=sys.stderr)
         be = get_backend(backend, **accepted)
+        from sheep_tpu import obs
+
+        # the manifest records the REQUESTED backend (null for auto);
+        # this event records what auto-selection actually picked —
+        # trace_report's manifest line falls back to it
+        obs.event("backend_resolved", backend=backend, auto=auto)
         ckpt_kw = {}
         if args.checkpoint_dir:
             from sheep_tpu.utils.checkpoint import Checkpointer
@@ -594,6 +687,18 @@ def main(argv=None) -> int:
         with MetricsWriter(args.metrics_out) as mw:
             for r in results:
                 emit_run_metrics(mw, r, n, wall, graph=args.input)
+
+    from sheep_tpu import obs
+
+    tracer = obs.get_tracer()
+    if tracer is not None and is_main:
+        # the trace is self-contained: scores/phases/part-loads ride in
+        # the same JSONL as the span tree (Tracer.emit is MetricsWriter-
+        # compatible, so the one record-set implementation serves both)
+        from sheep_tpu.utils.metrics import emit_run_metrics
+
+        for r in results:
+            emit_run_metrics(tracer, r, n, wall, graph=args.input)
 
     if not is_main:
         return 0

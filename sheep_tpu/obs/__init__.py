@@ -1,0 +1,158 @@
+"""sheep_tpu.obs — the observability spine (ISSUE 3 tentpole).
+
+One module-level tracer that everything threads through:
+
+- **spans** — hierarchical timed intervals emitted as JSONL
+  (``span_start``/``span_end`` with parent ids), so a run renders as a
+  tree (``tools/trace_report.py``) instead of a flat phase list;
+- **counters** — a registry the ad-hoc ``host_syncs``/``device_rounds``
+  /fold diagnostics absorb into, sampled as deltas at span boundaries
+  and live by the heartbeat;
+- **heartbeat** — a thread emitting periodic progress records so a
+  multi-hour soak is observable while running (and a dead run is
+  distinguishable from a slow one);
+- **manifest** — config/topology/version/git-SHA provenance on every
+  traced run.
+
+Instrumentation calls are UNCONDITIONAL at the call sites (backends,
+pipelines, CLI) and near-free when tracing is off: every facade
+function reads one module global and returns a shared no-op. Install a
+tracer (CLI ``--trace``, or :func:`tracing` in tests/tools) and the
+same call sites produce the full trace.
+
+    from sheep_tpu import obs
+
+    acc = obs.stats_accumulator()            # one per stats dict
+    with obs.span("build"):
+        for i, chunk in enumerate(chunks):
+            sp = obs.begin("segment", i=i)
+            ...fold...
+            acc.absorb(build_stats)          # counter increments -> registry
+            obs.progress(chunks_done=i + 1)  # heartbeat inputs
+            sp.end(rounds=r)
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import IO, Optional, Union
+
+from sheep_tpu.obs.heartbeat import Heartbeat  # noqa: F401
+from sheep_tpu.obs.manifest import collect_manifest, emit_manifest  # noqa: F401
+from sheep_tpu.obs.tracer import (NULL_SPAN, NULL_STATS, CounterRegistry,  # noqa: F401
+                                  NullSpan, Span, StatsAccumulator, Tracer)
+
+_TRACER: Optional[Tracer] = None
+
+
+def install(tracer: Tracer) -> Tracer:
+    """Make ``tracer`` the process-wide active tracer."""
+    global _TRACER
+    _TRACER = tracer
+    return tracer
+
+
+def uninstall() -> Optional[Tracer]:
+    """Deactivate (and return) the active tracer without closing it."""
+    global _TRACER
+    t, _TRACER = _TRACER, None
+    return t
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **attrs):
+    """Context-manager span under the active tracer (shared no-op when
+    tracing is off)."""
+    t = _TRACER
+    return t.span(name, **attrs) if t is not None else NULL_SPAN
+
+
+def begin(name: str, **attrs):
+    """Explicitly-started span (``.end()`` when done) — the
+    no-reindent form for instrumenting existing phase blocks."""
+    t = _TRACER
+    return t.begin(name, **attrs) if t is not None else NULL_SPAN
+
+
+def absorb(stats: dict) -> None:
+    """One-shot overwrite-merge of a stats dict into the registry (see
+    CounterRegistry.absorb). For the per-chunk absorption of a RUN's
+    cumulative stats dict use :func:`stats_accumulator` — re-absorbing
+    fresh dicts from several runs through THIS function would overwrite
+    totals instead of summing them."""
+    t = _TRACER
+    if t is not None:
+        t.counters.absorb(stats)
+
+
+def stats_accumulator():
+    """A per-run :class:`StatsAccumulator` bound to the active tracer's
+    registry (shared no-op when tracing is off). Create one per
+    cumulative stats dict, at the start of the run that owns it."""
+    t = _TRACER
+    return StatsAccumulator(t.counters) if t is not None else NULL_STATS
+
+
+def inc(name: str, v=1) -> None:
+    t = _TRACER
+    if t is not None:
+        t.counters.inc(name, v)
+
+
+def gauge(name: str, v) -> None:
+    t = _TRACER
+    if t is not None:
+        t.counters.gauge(name, v)
+
+
+def progress(**fields) -> None:
+    """Update the heartbeat's progress fields (racy scalar writes)."""
+    t = _TRACER
+    if t is not None:
+        t.progress.update(fields)
+
+
+def chunk_progress(idx: int, chunk_edges: int, edges_total=None) -> None:
+    """The streamed-chunk loops' one-line progress update: chunks done
+    plus the approximate edges_done they imply (capped at the stream
+    total when one is cheaply known)."""
+    t = _TRACER
+    if t is None:
+        return
+    done = idx * chunk_edges
+    t.progress.update(chunks_done=idx,
+                      edges_done=min(done, edges_total)
+                      if edges_total else done)
+
+
+def event(name: str, **fields) -> None:
+    """Emit a free-form event through the active tracer (no-op off)."""
+    t = _TRACER
+    if t is not None:
+        t.emit(name, **fields)
+
+
+@contextmanager
+def tracing(dest: Union[str, IO], heartbeat_secs: Optional[float] = None):
+    """Scoped tracing for tests/tools: install a fresh Tracer on
+    ``dest`` (path or writable handle), optionally with a heartbeat,
+    restore the previous tracer and close on exit."""
+    global _TRACER
+    prev = _TRACER
+    t = Tracer(dest)
+    _TRACER = t
+    hb = Heartbeat(t, heartbeat_secs).start() if heartbeat_secs else None
+    try:
+        yield t
+    finally:
+        if hb is not None:
+            hb.stop()
+        _TRACER = prev
+        t.close()

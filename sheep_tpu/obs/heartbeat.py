@@ -1,0 +1,104 @@
+"""Heartbeat thread: periodic progress records for streaming builds.
+
+A multi-hour soak is a black box between launch and the final scores
+line unless something emits while it runs; the heartbeat makes a DEAD
+run distinguishable from a SLOW one (last heartbeat age vs cadence).
+Each record carries the instrumented loops' racily-updated progress
+fields (phase, chunks done/total, approximate edges done), a computed
+edges/sec + ETA, the counter registry snapshot (dispatch counts live,
+not just at the end), and the device-memory high-water mark where the
+platform exposes one:
+
+    {"event": "heartbeat", "ts": ..., "seq": 3, "phase": "build",
+     "chunks_done": 12, "chunks_total": 64, "edges_done": 100663296,
+     "edges_per_sec": 3.1e6, "eta_s": 140.9,
+     "counters": {"host_syncs": 13, "device_rounds": 29, ...},
+     "memory": {"peak_bytes_in_use": ..., ...}}
+
+``stop()`` always emits one final record (``"final": true``) after the
+thread has joined, so even a run faster than the cadence leaves >= 1
+heartbeat in the trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from sheep_tpu.utils.metrics import device_memory_stats
+
+
+class Heartbeat:
+    """Daemon thread emitting ``heartbeat`` events every ``interval_s``
+    seconds through ``tracer`` until :meth:`stop`."""
+
+    def __init__(self, tracer, interval_s: float, memory: bool = True):
+        self.tracer = tracer
+        self.interval = max(0.05, float(interval_s))
+        self._memory = memory
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="sheep-heartbeat", daemon=True)
+        self._seq = 0
+        self._last = None  # (perf_counter, edges_done) of the last beat
+
+    def start(self) -> "Heartbeat":
+        self._last = (time.perf_counter(), 0)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the thread and emit the final flush (after the join, so
+        the final record cannot race a periodic one)."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2 * self.interval + 5)
+        try:
+            self._beat(final=True)
+        except Exception:
+            # teardown runs inside the CLI's finally: a failed final
+            # flush must not mask the run's real exit status
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except Exception:
+                # one transient emit failure (disk blip, flaky NFS) must
+                # not kill the thread for the rest of a multi-hour soak:
+                # silenced heartbeats would read as a DEAD run — the
+                # exact misdiagnosis this feature exists to prevent.
+                # Keep ticking; the next beat retries the sink.
+                continue
+
+    def _beat(self, final: bool = False) -> None:
+        tr = self.tracer
+        prog = dict(tr.progress)  # racy copy by design; fields are scalars
+        now = time.perf_counter()
+        rec = {"seq": self._seq}
+        rec.update(prog)
+        edges = prog.get("edges_done")
+        if isinstance(edges, (int, float)) and self._last is not None:
+            t0, e0 = self._last
+            # rate over the inter-beat window; a phase change resets
+            # edges_done, making the delta negative — skip those beats
+            if now > t0 and edges >= e0:
+                rate = (edges - e0) / (now - t0)
+                if rate > 0:
+                    rec["edges_per_sec"] = round(rate, 1)
+                    total = prog.get("edges_total")
+                    if isinstance(total, (int, float)) and total >= edges:
+                        rec["eta_s"] = round((total - edges) / rate, 1)
+            self._last = (now, edges)
+        counters = tr.counters.snapshot()
+        if counters:
+            rec["counters"] = counters
+        if self._memory:
+            mem = device_memory_stats()
+            if mem:
+                rec["memory"] = mem
+        if final:
+            rec["final"] = True
+        tr.emit("heartbeat", **rec)
+        self._seq += 1

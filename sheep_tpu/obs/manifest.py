@@ -1,0 +1,115 @@
+"""Run manifest: what exactly ran, captured at launch.
+
+One ``manifest`` event per traced run, so any trace file is
+self-describing — config, backend, device/mesh topology, jax/jaxlib
+versions, git SHA — and two captures are comparable without artifact
+archaeology (the BENCH contract's lesson, applied to every run).
+Collection is best-effort throughout: a broken accelerator runtime or
+a git-less checkout degrades fields to null, never takes the run down.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def _git_sha(repo_dir: str) -> Optional[str]:
+    """HEAD commit (short) — ``git`` first, manual .git parse fallback
+    so a container without the git binary still records provenance."""
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
+                           cwd=repo_dir, capture_output=True, text=True,
+                           timeout=5)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip()
+    except Exception:
+        pass
+    try:
+        head_path = os.path.join(repo_dir, ".git", "HEAD")
+        with open(head_path) as f:
+            head = f.read().strip()
+        if head.startswith("ref: "):
+            ref = os.path.join(repo_dir, ".git", *head[5:].split("/"))
+            with open(ref) as f:
+                return f.read().strip()[:12]
+        return head[:12]
+    except Exception:
+        return None
+
+
+def _jsonable_config(config: dict) -> dict:
+    """argparse namespaces carry only simple values, but be defensive:
+    anything not JSON-representable is stringified rather than crashing
+    the manifest emit."""
+    import json
+
+    out = {}
+    for k, v in config.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = str(v)
+    return out
+
+
+def collect_manifest(config: Optional[dict] = None,
+                     backend: Optional[str] = None) -> dict:
+    """The manifest record body. Device topology and versions come from
+    jax when it is importable and initialized cleanly; every field
+    degrades to null/absent rather than raising."""
+    import platform as _platform
+
+    repo_dir = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    rec: dict = {
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "hostname": _platform.node(),
+        "pid": os.getpid(),
+        "git_sha": _git_sha(repo_dir),
+    }
+    if backend is not None:
+        rec["backend"] = backend
+    if config is not None:
+        rec["config"] = _jsonable_config(dict(config))
+    try:
+        import numpy as np
+
+        rec["numpy_version"] = np.__version__
+    except Exception:
+        pass
+    try:
+        import jax
+
+        rec["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+
+            rec["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            rec["jaxlib_version"] = None
+        # topology: initializes the backend if nothing else has yet —
+        # manifests are emitted by runs that are about to anyway
+        rec["platform"] = jax.default_backend()
+        rec["process_index"] = jax.process_index()
+        rec["process_count"] = jax.process_count()
+        rec["device_count"] = jax.device_count()
+        rec["local_device_count"] = jax.local_device_count()
+        rec["devices"] = [
+            {"id": d.id, "kind": getattr(d, "device_kind", "?"),
+             "process": d.process_index}
+            for d in jax.local_devices()]
+    except Exception as e:
+        rec["jax_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    return rec
+
+
+def emit_manifest(tracer, config: Optional[dict] = None,
+                  backend: Optional[str] = None) -> dict:
+    rec = collect_manifest(config=config, backend=backend)
+    tracer.emit("manifest", **rec)
+    return rec

@@ -1,0 +1,270 @@
+"""Hierarchical span tracer + counter registry (the obs core).
+
+A run traced through this module renders as a TREE, not a flat phase
+list: every span carries an id and its parent's id, so
+``span("build") > span("segment", i=k) > span("dispatch")`` nests in
+the JSONL exactly as it nested in time. Two event kinds:
+
+    {"event": "span_start", "ts": ..., "span": "build", "id": 3,
+     "parent": 1, ...attrs}
+    {"event": "span_end", "ts": ..., "span": "build", "id": 3,
+     "parent": 1, "secs": 8.21, "counters": {"host_syncs": 4, ...}}
+
+``counters`` on span_end is the DELTA of the tracer's registry between
+span entry and exit — the ad-hoc ``host_syncs``/``device_rounds``/fold
+diagnostics become named metrics sampled at span boundaries. A span
+that never ends (process killed mid-build) leaves its span_start as
+the last word on where the run died — ``tools/trace_report.py`` flags
+those, which is how a dead soak is distinguished from a slow one after
+the fact (the round-5 s30 soak died silently for lack of exactly
+this).
+
+Spans are context managers, but every span also exposes explicit
+``start()``/``end()`` so hot loops can bracket work without
+re-indenting (``sp = obs.begin("segment", i=k); ...; sp.end()``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import IO, Optional, Union
+
+from sheep_tpu.utils.metrics import MetricsWriter
+
+
+class CounterRegistry(dict):
+    """Named counters/gauges. A plain-dict subclass on purpose: the
+    existing ad-hoc stats dicts (``stats["host_syncs"] = ...`` in
+    ops/elim.py and the pipelines) absorb without adaptation, and
+    ``snapshot``/``delta`` give the span tracer and heartbeat a
+    queryable view."""
+
+    def inc(self, name: str, v=1) -> None:
+        self[name] = self.get(name, 0) + v
+
+    def gauge(self, name: str, v) -> None:
+        self[name] = v
+
+    def absorb(self, stats: dict) -> None:
+        """Overwrite-merge a CUMULATIVE stats dict. The elim-ops/pipeline
+        counters grow monotonically within a run, so overwriting makes
+        absorb idempotent — callers may re-absorb the same dict every
+        segment and the registry always holds the latest totals."""
+        for k, v in stats.items():
+            self[k] = v
+
+    def snapshot(self) -> dict:
+        return dict(self)
+
+    @staticmethod
+    def delta(before: dict, after: dict) -> dict:
+        """Numeric keys: after - before (omitted when zero). Non-numeric
+        keys (mode strings etc.): included when changed."""
+        out = {}
+        for k, v in after.items():
+            v0 = before.get(k, 0 if isinstance(v, (int, float))
+                            and not isinstance(v, bool) else None)
+            if (isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and isinstance(v0, (int, float))
+                    and not isinstance(v0, bool)):
+                d = v - v0
+                if d:
+                    out[k] = round(d, 6) if isinstance(d, float) else d
+            elif v0 != v:
+                out[k] = v
+        return out
+
+
+class StatsAccumulator:
+    """Per-run bridge from one CUMULATIVE stats dict into a registry.
+
+    The ad-hoc stats dicts grow monotonically WITHIN one partition
+    call, but each call starts a fresh dict — several calls under one
+    tracer (hierarchy levels, partition_multi legs, appended CLI runs)
+    must SUM into the registry, not overwrite it (overwrite would emit
+    negative span deltas and report only the last call's totals).
+    Each ``absorb`` adds only the increment since THIS accumulator's
+    previous absorb; create one per stats dict, at the start of the
+    run that owns it. Non-numeric values (mode strings) overwrite."""
+
+    __slots__ = ("_reg", "_last")
+
+    def __init__(self, registry: CounterRegistry):
+        self._reg = registry
+        self._last: dict = {}
+
+    def absorb(self, stats: dict) -> None:
+        for k, v in stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                prev = self._last.get(k, 0)
+                if not isinstance(prev, (int, float)) \
+                        or isinstance(prev, bool):
+                    prev = 0
+                d = v - prev
+                if d:
+                    self._reg[k] = self._reg.get(k, 0) + d
+            else:
+                self._reg[k] = v
+            self._last[k] = v
+
+
+class NullStatsAccumulator:
+    __slots__ = ()
+
+    def absorb(self, stats: dict) -> None:
+        pass
+
+
+NULL_STATS = NullStatsAccumulator()
+
+
+class Span:
+    """One traced interval. Usable as a context manager or via explicit
+    ``start()``/``end()`` (unbalanced on purpose when the process dies —
+    see module docstring)."""
+
+    __slots__ = ("_tracer", "name", "attrs", "id", "parent", "_t0",
+                 "_snap", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.id = None
+        self.parent = None
+        self._t0 = 0.0
+        self._snap: dict = {}
+        self._done = False
+
+    def start(self) -> "Span":
+        tr = self._tracer
+        self.parent = tr._current_id()
+        self.id = tr._next_id()
+        self._snap = tr.counters.snapshot()
+        tr._push(self.id)
+        tr.emit("span_start", span=self.name, id=self.id,
+                parent=self.parent, **self.attrs)
+        self._t0 = time.perf_counter()
+        return self
+
+    def end(self, **extra) -> None:
+        if self._done or self.id is None:
+            return
+        self._done = True
+        tr = self._tracer
+        secs = time.perf_counter() - self._t0
+        tr._pop(self.id)
+        fields = dict(span=self.name, id=self.id, parent=self.parent,
+                      secs=round(secs, 6), **self.attrs)
+        fields.update(extra)
+        delta = CounterRegistry.delta(self._snap, tr.counters)
+        if delta:
+            fields["counters"] = delta
+        tr.emit("span_end", **fields)
+
+    def __enter__(self) -> "Span":
+        return self.start()
+
+    def __exit__(self, et, ev, tb) -> bool:
+        self.end(**({"error": et.__name__} if et is not None else {}))
+        return False
+
+
+class NullSpan:
+    """The disabled-tracing span: every operation is a no-op on a shared
+    singleton, so instrumentation left in hot loops costs one global
+    read + one attribute call when tracing is off."""
+
+    __slots__ = ()
+
+    def start(self) -> "NullSpan":
+        return self
+
+    def end(self, **extra) -> None:
+        pass
+
+    def __enter__(self) -> "NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NULL_SPAN = NullSpan()
+
+
+class Tracer:
+    """JSONL span/counter/heartbeat sink for one run.
+
+    Thread model: span ids come from an atomic counter and the span
+    stack is thread-local (a span opened on a worker thread parents to
+    that thread's enclosing span, or to nothing). ``progress`` is a
+    plain dict updated racily by the instrumented loops and read by the
+    heartbeat thread — single fields only, no cross-field invariants.
+    The underlying MetricsWriter serializes concurrent emits."""
+
+    def __init__(self, dest: Union[str, IO]):
+        self._mw = MetricsWriter(dest)
+        self.counters = CounterRegistry()
+        self.progress: dict = {}
+        self.heartbeat = None  # owner-managed Heartbeat, if any
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        self._closed = False
+
+    # -- events ------------------------------------------------------------
+    def emit(self, event: str, **fields) -> None:
+        self._mw.emit(event, **fields)
+
+    # -- spans -------------------------------------------------------------
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def begin(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs).start()
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _current_id(self) -> Optional[int]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def _next_id(self) -> int:
+        return next(self._ids)  # itertools.count: atomic under the GIL
+
+    def _push(self, span_id: int) -> None:
+        self._stack().append(span_id)
+
+    def _pop(self, span_id: int) -> None:
+        st = self._stack()
+        # tolerate out-of-order ends (a caller leaking a handle must not
+        # corrupt every later parent attribution): pop through to ours
+        while st and st[-1] != span_id:
+            st.pop()
+        if st:
+            st.pop()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Flush the final counter totals (one ``counters`` event — the
+        queryable end-state tools read without re-deriving span deltas)
+        and close the sink."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.counters:
+            self.emit("counters", **self.counters.snapshot())
+        self._mw.close()
+
+    def __enter__(self) -> "Tracer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -543,6 +543,13 @@ def fold_segments_batch(
     N = int(loB.shape[0])
     if batch_rounds <= 0:
         batch_rounds = max(1, segment_rounds) * max(N, 1)
+    # every execution restarts the segment cursor at 0, and each
+    # already-converged segment still costs one confirmation round: a
+    # per-execution budget below N can stall the cursor at the same
+    # prefix forever and silently return an unconverged forest at the
+    # max_rounds backstop — clamp so one execution can always cross the
+    # whole block
+    batch_rounds = max(batch_rounds, max(N, 1))
     if stats is None:
         stats = {}
     total = 0
@@ -559,7 +566,12 @@ def fold_segments_batch(
         stats["t_batch_s"] = stats.get("t_batch_s", 0.0) + \
             (time.perf_counter() - t0)
         total += r
-        if done >= N or total >= max_rounds:
+        if done >= N:
+            return P, total
+        if total >= max_rounds:
+            # never exit silently with unfolded segments: the caller's
+            # diagnostics must distinguish this from convergence
+            stats["batch_incomplete_segments"] = N - done
             return P, total
 
 

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheep_tpu import obs
 from sheep_tpu.ops.elim import pow2_at_least
 from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
@@ -670,11 +671,19 @@ class BigVPipeline:
             state = ckpt.reconcile_multihost_resume(checkpointer, state, meta)
         from_phase = ckpt.phase_index(state.phase) if state else 0
 
+        root_sp = obs.begin("partition", backend="tpu-bigv", k=int(k),
+                            n=int(n), devices=int(d))
+        stats_acc = obs.stats_accumulator()
+        m_cheap = stream.num_edges_cheap
+        obs.progress(backend="tpu-bigv", k=int(k), edges_total=m_cheap)
+
         # pass 1: degrees (block-sharded int32 accumulator + host fold of
         # the LOCAL block, int32 when the edge bound proves no overflow;
         # resets are jitted on-device zeros, no
         # host zero uploads; one final allgather assembles the table)
         t0 = time.perf_counter()
+        sp = obs.begin("degrees+sort")
+        obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
         if state:
             deg_local = state.arrays["deg_local"].copy()
@@ -697,6 +706,7 @@ class BigVPipeline:
                 since += 1
                 nb += 1
                 maybe_fail("degrees", nb)
+                obs.chunk_progress(nb * d, cs, m_cheap)
                 at_ckpt = (checkpointer is not None and
                            checkpointer.due_span((nb - 1) * d, nb * d))
                 if since >= flush_every or at_ckpt:
@@ -725,9 +735,12 @@ class BigVPipeline:
         pos_sh = self._shard_table(pos_pad)
         del pos_pad
         t["degrees+sort"] = time.perf_counter() - t0
+        sp.end()
 
         # pass 2: the single distributed forest (position-indexed table)
         t0 = time.perf_counter()
+        sp = obs.begin("build")
+        obs.progress(phase="build", chunks_done=0, edges_done=0)
         total_rounds = 0
         build_stats: dict = {}
         if state and from_phase >= 2:
@@ -741,11 +754,15 @@ class BigVPipeline:
                 start = 0
             nb = 0
             for batch in batches(start):
+                seg_sp = obs.begin("segment", i=nb)
                 P_sh, rounds = self.build_step(
                     P_sh, pos_sh, self._put(self.batch_sharding, batch),
                     stats=build_stats)
                 total_rounds += rounds
                 nb += 1
+                stats_acc.absorb(build_stats)
+                seg_sp.end(rounds=int(rounds))
+                obs.chunk_progress(nb * d, cs, m_cheap)
                 maybe_fail("build", nb)
                 if checkpointer is not None and \
                         checkpointer.due_span((nb - 1) * d, nb * d):
@@ -756,10 +773,13 @@ class BigVPipeline:
         P_host = self._allgather_table(
             self._local_block(P_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
+        stats_acc.absorb(build_stats)
+        sp.end(fixpoint_rounds=int(total_rounds))
 
         # split on host over O(V) state (native C++); position-indexed
         # table -> vertex parent array: parent[v] = order[P[pos[v]]]
         t0 = time.perf_counter()
+        sp = obs.begin("split")
         pp = P_host[pos_np]
         parent = np.where(pp < n, order_np[np.minimum(pp, n)], -1)
         # the native split upcasts parent/pos to int64 copies; drop the
@@ -773,10 +793,13 @@ class BigVPipeline:
                                     np.zeros(1, np.int32)])
         assign_sh = self._shard_table(assign_np)
         t["split"] = time.perf_counter() - t0
+        sp.end()
 
         # pass 3: scoring (sharded chunks, routed lookups into the
         # block-sharded assignment, psum counters)
         t0 = time.perf_counter()
+        sp = obs.begin("score")
+        obs.progress(phase="score", chunks_done=0, edges_done=0)
         cut = total = 0
         cv_chunks = []
         start = 0
@@ -798,6 +821,7 @@ class BigVPipeline:
                     score_ops.cut_pair_keys_host(batch, assign_np, n, k))
             nb += 1
             maybe_fail("score", nb)
+            obs.chunk_progress(nb * d, cs, m_cheap)
             if checkpointer is not None and \
                     checkpointer.due_span((nb - 1) * d, nb * d):
                 cv_chunks = ckpt.save_score_state(
@@ -824,6 +848,8 @@ class BigVPipeline:
         balance = pure.part_balance(
             assign_host, k, deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        sp.end()
+        root_sp.end()
         if checkpointer is not None:
             checkpointer.clear()
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheep_tpu import obs
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
@@ -666,9 +667,19 @@ class ShardedPipeline:
             state = ckpt.reconcile_multihost_resume(checkpointer, state, meta)
         from_phase = ckpt.phase_index(state.phase) if state else 0
 
+        root_sp = obs.begin("partition", backend="tpu-sharded", k=int(k),
+                            n=int(n), devices=int(d),
+                            dispatch_batch=int(self.dispatch_batch))
+        stats_acc = obs.stats_accumulator()
+        merge_acc = obs.stats_accumulator()
+        m_cheap = stream.num_edges_cheap
+        obs.progress(backend="tpu-sharded", k=int(k), edges_total=m_cheap)
+
         # pass 1: degrees, int32 on device with int64 host flushes so no
         # per-vertex endpoint count can reach 2^31 between flushes
         t0 = time.perf_counter()
+        sp = obs.begin("degrees+sort")
+        obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         flush_every = max(1, (2**31 - 1) // max(2 * cs * d, 1))
         if state:
             deg_host = state.arrays["deg"].copy()
@@ -683,6 +694,7 @@ class ShardedPipeline:
                 since += 1
                 batches += 1
                 maybe_fail("degrees", batches)
+                obs.chunk_progress(batches * d, cs, m_cheap)
                 # cadence is in *chunks* (one batch = d chunks), matching
                 # the single-device backends and the --checkpoint-every doc
                 at_ckpt = (checkpointer is not None and
@@ -707,6 +719,7 @@ class ShardedPipeline:
         pos, order = self.make_order(deg_total)
         pos.block_until_ready()
         t["degrees+sort"] = time.perf_counter() - t0
+        sp.end()
 
         # pass 2: per-device forests, then butterfly merge (comm point 2).
         # Device state is position-space (P tables); checkpoints and the
@@ -714,6 +727,8 @@ class ShardedPipeline:
         # conversions (one replicated gather each way) happen only at
         # checkpoint/phase boundaries.
         t0 = time.perf_counter()
+        sp = obs.begin("build+merge")
+        obs.progress(phase="build", chunks_done=0, edges_done=0)
         merge_stats: dict = {}
         build_stats: dict = {}
         if state and from_phase >= 2:
@@ -758,11 +773,15 @@ class ShardedPipeline:
                         group = group + [empty] * (nb - gl)
                     blocks = np.stack(group, axis=1)
                     before = batches
+                    dsp = obs.begin("dispatch", i=before, batches=gl)
                     P_all = self.build_step_batch(
                         P_all,
                         self._put(self.block_edges_sharding, blocks),
                         pos, stats=build_stats)
                     batches += gl
+                    stats_acc.absorb(build_stats)
+                    dsp.end()
+                    obs.chunk_progress(batches * d, cs, m_cheap)
                     for b in range(before + 1, batches + 1):
                         maybe_fail("build", b)
                     if checkpointer is not None and \
@@ -776,9 +795,12 @@ class ShardedPipeline:
             else:
                 for batch in prefetch(self.iter_batches(stream,
                                                         start_chunk=start)):
+                    seg_sp = obs.begin("segment", i=batches)
                     P_all = self.build_step(P_all, self.put_batch(batch),
                                             pos)
                     batches += 1
+                    seg_sp.end()
+                    obs.chunk_progress(batches * d, cs, m_cheap)
                     maybe_fail("build", batches)
                     if checkpointer is not None and \
                             checkpointer.due_span((batches - 1) * d,
@@ -789,13 +811,19 @@ class ShardedPipeline:
                             "build", start + batches * d,
                             {"deg": deg_host, "merged_partial": partial},
                             meta)
+            msp = obs.begin("merge", devices=int(d))
             merged_minp = self.to_minp(
                 self.merge(P_all, stats=merge_stats), pos)
             np.asarray(merged_minp[:1])  # real completion barrier
+            merge_acc.absorb(merge_stats)
+            msp.end()
         t["build+merge"] = time.perf_counter() - t0
+        stats_acc.absorb(build_stats)
+        sp.end()
 
         # split on host over O(V) state
         t0 = time.perf_counter()
+        sp = obs.begin("split")
         parent = elim_ops.minp_to_parent(merged_minp, order, n)
         pos_host = np.asarray(pos[:n])
         w = deg_host.astype(np.float64) if weights == "degree" else None
@@ -803,9 +831,12 @@ class ShardedPipeline:
         assign = self.put_replicated(
             np.concatenate([assign_host.astype(np.int32), np.zeros(1, np.int32)]))
         t["split"] = time.perf_counter() - t0
+        sp.end()
 
         # pass 3: scoring (comm point 3)
         t0 = time.perf_counter()
+        sp = obs.begin("score")
+        obs.progress(phase="score", chunks_done=0, edges_done=0)
         cut = total = 0
         cv_chunks = []
         start = 0
@@ -827,6 +858,7 @@ class ShardedPipeline:
                     score_ops.cut_pair_keys_host(batch, assign, n, k))
             batches += 1
             maybe_fail("score", batches)
+            obs.chunk_progress(batches * d, cs, m_cheap)
             if checkpointer is not None and \
                     checkpointer.due_span((batches - 1) * d, batches * d):
                 cv_chunks = ckpt.save_score_state(
@@ -852,6 +884,8 @@ class ShardedPipeline:
         balance = pure.part_balance(assign_host, k,
                                     deg_host if weights == "degree" else None)
         t["score"] = time.perf_counter() - t0
+        sp.end()
+        root_sp.end()
         if checkpointer is not None:
             checkpointer.clear()
         return {

@@ -15,6 +15,7 @@ Usage:
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import IO, Optional, Union
 
@@ -22,7 +23,11 @@ import numpy as np
 
 
 class MetricsWriter:
-    """Append-only JSONL sink; every record gets ``event`` and ``ts``."""
+    """Append-only JSONL sink; every record gets ``event`` and ``ts``.
+
+    ``emit`` is serialized by a lock: the obs heartbeat thread and the
+    main thread share one writer, and interleaved lines would corrupt
+    the whole trace for every downstream parser."""
 
     def __init__(self, dest: Union[str, IO]):
         if isinstance(dest, str):
@@ -31,12 +36,15 @@ class MetricsWriter:
         else:
             self._fh = dest
             self._owns = False
+        self._lock = threading.Lock()
 
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event, "ts": round(time.time(), 3)}
         rec.update(fields)
-        self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
-        self._fh.flush()
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
 
     def close(self) -> None:
         if self._owns:
@@ -51,12 +59,30 @@ class MetricsWriter:
 
 
 def _jsonable(x):
+    # np.bool_ first: it is not an np.integer, and bool(np.bool_) is the
+    # only faithful JSON mapping (int() would silently change the type)
+    if isinstance(x, np.bool_):
+        return bool(x)
     if isinstance(x, (np.integer,)):
         return int(x)
     if isinstance(x, (np.floating,)):
         return float(x)
     if isinstance(x, np.ndarray):
         return x.tolist()
+    if isinstance(x, np.generic):
+        # remaining numpy scalar subtypes (np.str_, np.bytes_,
+        # np.datetime64, ...): item() yields the Python-native value —
+        # and when THAT is still not JSON-native (bytes, datetime),
+        # degrade to a string rather than re-raising the mid-run
+        # TypeError this branch exists to prevent
+        v = x.item()
+        if isinstance(v, bytes):
+            return v.decode("utf-8", "replace")
+        try:
+            json.dumps(v)
+            return v
+        except TypeError:
+            return str(v)
     raise TypeError(f"not JSON serializable: {type(x)}")
 
 

@@ -109,6 +109,31 @@ def test_batched_stats_word_shape():
     assert retired >= live0 > 0
 
 
+def test_small_explicit_batch_rounds_still_converges():
+    """An explicit per-execution round budget below N used to stall the
+    segment cursor forever on already-converged prefixes (each costs one
+    confirmation round, and every execution restarts at segment 0), then
+    silently return an unconverged forest at the max_rounds backstop —
+    the budget is now clamped to N (review finding)."""
+    e = generators.rmat(10, 8, seed=9)
+    n = 1 << 10
+    pos, order = _order(e, n)
+    cs = 256
+    N = 4
+    oracle = None
+    P = jnp.full(n + 1, n, dtype=jnp.int32)
+    for loB, hiB in _staged_blocks(e, cs, n, pos, N):
+        stats: dict = {}
+        P, _ = elim_ops.fold_segments_batch(P, loB, hiB, n,
+                                            batch_rounds=1, stats=stats)
+        assert "batch_incomplete_segments" not in stats, stats
+    ref = jnp.full(n + 1, n, dtype=jnp.int32)
+    for loB, hiB in _staged_blocks(e, cs, n, pos, N):
+        ref, _ = elim_ops.fold_segments_batch(ref, loB, hiB, n,
+                                              segment_rounds=2)
+    np.testing.assert_array_equal(np.asarray(P), np.asarray(ref))
+
+
 def test_dispatch_count_drops_o_segments_over_n():
     """The acceptance criterion: host syncs per chunk drop from
     O(segments) to O(segments / N). A = the per-segment driver (one sv

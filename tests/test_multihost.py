@@ -19,6 +19,74 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_MP_PROBE = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+addr, pid = sys.argv[1], int(sys.argv[2])
+jax.distributed.initialize(coordinator_address=addr, num_processes=2,
+                           process_id=pid)
+import numpy as np
+from jax.experimental import multihost_utils
+out = multihost_utils.process_allgather(np.array([pid], np.int32))
+assert sorted(np.asarray(out).ravel().tolist()) == [0, 1]
+print("MULTIPROC_OK")
+"""
+
+
+def _cpu_multiprocess_supported():
+    """Some jaxlib CPU builds reject every cross-process computation
+    with 'Multiprocess computations aren't implemented on the CPU
+    backend' — in such environments ALL of this module's tests fail
+    for the same environmental reason. Probe once with a tiny
+    2-process allgather; on failure the module skips with the probe's
+    last error line as the reason (importorskip-style)."""
+    addr = f"127.0.0.1:{_free_port()}"
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _MP_PROBE, addr, str(pid)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+        for pid in range(2)]
+    outs = []
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return False, "2-process CPU probe timed out"
+        outs.append(out or "")
+        ok = ok and p.returncode == 0 and "MULTIPROC_OK" in out
+    if ok:
+        return True, ""
+    tail = next((ln for o in outs
+                 for ln in reversed(o.strip().splitlines())
+                 if ln.strip()), "no output")
+    return False, tail[:300]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _require_cpu_multiprocess():
+    """Module-wide skip gate, evaluated LAZILY: fixtures only run when a
+    test here actually executes, so --collect-only and deselected runs
+    never pay the probe's subprocess spawns."""
+    ok, why = _cpu_multiprocess_supported()
+    if not ok:
+        pytest.skip(f"jaxlib lacks CPU multiprocess support here: {why}")
+
 WORKER = r"""
 import json, os, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -77,14 +145,6 @@ json.dump({
     "parent": out["parent"].tolist(),
 }, open(out_path, "w"))
 """
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _spawn(nprocs, tmp_path, tag, ckdir="", fault="", resume="0", graph="",

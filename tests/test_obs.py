@@ -1,0 +1,301 @@
+"""Observability layer (ISSUE 3): span nesting/parent ids, counter
+registry, heartbeat cadence + final flush, manifest completeness, CLI
+--trace/--heartbeat-secs end-to-end."""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+
+from sheep_tpu import cli, obs
+from sheep_tpu.io import formats, generators
+from sheep_tpu.obs import CounterRegistry, Heartbeat, Tracer, collect_manifest
+
+
+def _records(buf):
+    return [json.loads(l) for l in buf.getvalue().splitlines()]
+
+
+# -- spans -----------------------------------------------------------------
+
+def test_span_nesting_parent_ids():
+    buf = io.StringIO()
+    with obs.tracing(buf):
+        with obs.span("a"):
+            with obs.span("b", i=1):
+                pass
+            with obs.span("b", i=2):
+                with obs.span("c"):
+                    pass
+    recs = _records(buf)
+    starts = {r["id"]: r for r in recs if r["event"] == "span_start"}
+    ends = {r["id"]: r for r in recs if r["event"] == "span_end"}
+    assert set(starts) == set(ends), "every start has a matching end"
+    by_name = {}
+    for r in ends.values():
+        by_name.setdefault(r["span"], []).append(r)
+    a = by_name["a"][0]
+    assert a["parent"] is None
+    assert all(b["parent"] == a["id"] for b in by_name["b"])
+    assert by_name["c"][0]["parent"] == by_name["b"][1]["id"]
+    # start/end agree on parent, and attrs ride both
+    for r in ends.values():
+        assert starts[r["id"]]["parent"] == r["parent"]
+    assert sorted(b["i"] for b in by_name["b"]) == [1, 2]
+    assert all(e["secs"] >= 0 for e in ends.values())
+
+
+def test_span_explicit_begin_end_and_extra_fields():
+    buf = io.StringIO()
+    with obs.tracing(buf):
+        sp = obs.begin("seg", i=7)
+        sp.end(rounds=3)
+        sp.end(rounds=99)  # double end is a no-op, not a duplicate record
+    ends = [r for r in _records(buf) if r["event"] == "span_end"]
+    assert len(ends) == 1 and ends[0]["rounds"] == 3 and ends[0]["i"] == 7
+
+
+def test_span_counter_deltas_at_boundaries():
+    buf = io.StringIO()
+    with obs.tracing(buf):
+        with obs.span("outer"):
+            obs.inc("syncs")
+            with obs.span("inner"):
+                obs.inc("syncs")
+                obs.absorb({"rounds": 5, "mode": "compact"})
+    recs = _records(buf)
+    ends = {r["span"]: r for r in recs if r["event"] == "span_end"}
+    assert ends["inner"]["counters"] == {"syncs": 1, "rounds": 5,
+                                         "mode": "compact"}
+    assert ends["outer"]["counters"]["syncs"] == 2
+    # close() flushed the final registry totals as one counters event
+    final = [r for r in recs if r["event"] == "counters"]
+    assert final and final[0]["syncs"] == 2 and final[0]["rounds"] == 5
+
+
+def test_disabled_tracing_is_noop():
+    assert obs.get_tracer() is None
+    with obs.span("x", i=1) as sp:
+        sp.end()
+    obs.inc("c")
+    obs.absorb({"a": 1})
+    obs.progress(chunks_done=3)
+    obs.chunk_progress(1, 10)
+    obs.event("whatever", x=1)
+    assert obs.get_tracer() is None
+
+
+def test_error_inside_span_is_recorded_and_closed():
+    buf = io.StringIO()
+    try:
+        with obs.tracing(buf):
+            with obs.span("doomed"):
+                raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    ends = [r for r in _records(buf) if r["event"] == "span_end"]
+    assert ends and ends[0]["error"] == "RuntimeError"
+
+
+def test_stats_accumulator_sums_across_runs():
+    """Each partition call starts a FRESH cumulative stats dict; two
+    runs under one tracer must sum into the registry (not overwrite),
+    and span deltas must never go negative (review finding)."""
+    buf = io.StringIO()
+    with obs.tracing(buf):
+        for run in range(2):
+            acc = obs.stats_accumulator()  # fresh per run, like backends
+            stats = {}
+            with obs.span("build", run=run):
+                for syncs in (1, 2, 3):
+                    stats["host_syncs"] = syncs
+                    stats["mode"] = "compact"
+                    acc.absorb(stats)
+    recs = _records(buf)
+    builds = [r for r in recs if r["event"] == "span_end"]
+    assert builds[0]["counters"]["host_syncs"] == 3
+    assert builds[1]["counters"]["host_syncs"] == 3, \
+        "second run's delta is its own +3, not 3-overwrites-3 = nothing"
+    final = [r for r in recs if r["event"] == "counters"][0]
+    assert final["host_syncs"] == 6 and final["mode"] == "compact"
+
+
+def test_registry_inc_gauge_absorb_delta():
+    reg = CounterRegistry()
+    reg.inc("a")
+    reg.inc("a", 4)
+    reg.gauge("mode", "dense")
+    before = reg.snapshot()
+    reg.absorb({"a": 9, "b": 2.5, "mode": "compact"})
+    d = CounterRegistry.delta(before, reg.snapshot())
+    assert d == {"a": 4, "b": 2.5, "mode": "compact"}
+    # absorb is overwrite-merge: re-absorbing is idempotent
+    reg.absorb({"a": 9, "b": 2.5})
+    assert reg["a"] == 9 and reg["b"] == 2.5
+
+
+def test_writer_is_thread_safe():
+    buf = io.StringIO()
+    tr = Tracer(buf)
+
+    def hammer(tid):
+        for i in range(50):
+            tr.emit("e", tid=tid, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = _records(buf)  # raises if any line interleaved/corrupted
+    assert len(recs) == 200
+
+
+# -- heartbeat -------------------------------------------------------------
+
+def test_heartbeat_cadence_and_final_flush():
+    buf = io.StringIO()
+    tr = Tracer(buf)
+    obs.install(tr)
+    try:
+        hb = Heartbeat(tr, 0.05).start()
+        obs.progress(phase="build", edges_done=0, edges_total=1000)
+        for i in range(4):
+            time.sleep(0.15)
+            obs.progress(edges_done=(i + 1) * 250)
+            obs.inc("host_syncs")
+        hb.stop()
+    finally:
+        obs.uninstall()
+        tr.close()
+    beats = [r for r in _records(buf) if r["event"] == "heartbeat"]
+    # ~600ms of work at a 50ms cadence: even a heavily-loaded 1-core
+    # host lands several periodic beats plus the final flush
+    assert len(beats) >= 3, beats
+    assert [b["seq"] for b in beats] == list(range(len(beats)))
+    assert beats[-1]["final"] is True
+    assert beats[-1]["edges_done"] == 1000
+    assert beats[-1]["counters"]["host_syncs"] == 4
+    assert any("edges_per_sec" in b for b in beats)
+    assert any("eta_s" in b for b in beats)
+
+
+def test_heartbeat_final_flush_even_when_faster_than_cadence():
+    buf = io.StringIO()
+    tr = Tracer(buf)
+    hb = Heartbeat(tr, 60.0).start()  # would never fire on its own
+    hb.stop()
+    tr.close()
+    beats = [r for r in _records(buf) if r["event"] == "heartbeat"]
+    assert len(beats) == 1 and beats[0]["final"] is True
+
+
+# -- manifest --------------------------------------------------------------
+
+def test_manifest_completeness():
+    m = collect_manifest(config={"input": "g.edges", "k": 8,
+                                 "weird": object()},
+                         backend="pure")
+    for key in ("argv", "python", "hostname", "pid", "git_sha", "backend",
+                "config", "jax_version", "jaxlib_version", "platform",
+                "device_count", "local_device_count", "process_count",
+                "devices"):
+        assert key in m, key
+    assert m["git_sha"], "repo is a git checkout; sha must resolve"
+    assert m["platform"] == "cpu" and m["device_count"] >= 1
+    assert m["config"]["k"] == 8
+    json.dumps(m)  # the whole record must be JSON-clean
+
+
+# -- numpy scalar serialization (satellite) --------------------------------
+
+def test_jsonable_numpy_scalar_subtypes():
+    buf = io.StringIO()
+    from sheep_tpu.utils.metrics import MetricsWriter
+
+    mw = MetricsWriter(buf)
+    mw.emit("diag", flag=np.bool_(True), f32=np.float32(1.5),
+            i16=np.int16(-3), s=np.str_("hi"), b=np.bytes_(b"raw"),
+            dt=np.datetime64("2026-08-03"),
+            arr=np.array([np.bool_(False)]))
+    rec = _records(buf)[0]
+    assert rec["flag"] is True and rec["f32"] == 1.5 and rec["i16"] == -3
+    assert rec["s"] == "hi" and rec["arr"] == [False]
+    assert rec["b"] == "raw", "np.bytes_ degrades to text, not a crash"
+    assert "2026-08-03" in rec["dt"]
+
+
+def test_heartbeat_survives_emit_failures():
+    """One transient sink failure must not kill the thread: silenced
+    heartbeats read as a dead run (review finding)."""
+    buf = io.StringIO()
+    tr = Tracer(buf)
+    fails = {"n": 2}
+    real_emit = tr.emit
+
+    def flaky_emit(event, **fields):
+        if event == "heartbeat" and fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("disk blip")
+        real_emit(event, **fields)
+
+    tr.emit = flaky_emit
+    hb = Heartbeat(tr, 0.03).start()
+    deadline = time.time() + 5
+    while fails["n"] > 0 and time.time() < deadline:
+        time.sleep(0.03)
+    time.sleep(0.1)  # at least one post-failure periodic beat
+    hb.stop()
+    tr.close()
+    beats = [r for r in _records(buf) if r["event"] == "heartbeat"]
+    assert fails["n"] == 0, "both injected failures fired"
+    assert len(beats) >= 2 and beats[-1]["final"] is True
+
+
+# -- CLI end-to-end (the acceptance criterion, in miniature) ---------------
+
+def test_cli_trace_and_heartbeat(tmp_path):
+    gpath = str(tmp_path / "g.edges")
+    formats.write_edges(gpath, generators.karate_club())
+    tpath = str(tmp_path / "trace.jsonl")
+    rc = cli.main(["--input", gpath, "--k", "2", "--backend", "pure",
+                   "--trace", tpath, "--heartbeat-secs", "0.1", "--json"])
+    assert rc == 0
+    recs = [json.loads(l) for l in open(tpath)]
+    events = [r["event"] for r in recs]
+    assert events[0] == "manifest"
+    m = recs[0]
+    assert m["config"]["k"] == "2" and m["git_sha"]
+    starts = {r["id"]: r for r in recs if r["event"] == "span_start"}
+    ends = {r["id"]: r for r in recs if r["event"] == "span_end"}
+    assert set(starts) == set(ends) and starts, "complete span tree"
+    for r in ends.values():  # every parent resolves within the trace
+        assert r["parent"] is None or r["parent"] in starts
+    names = {r["span"] for r in ends.values()}
+    assert {"run", "partition", "degrees", "build", "split",
+            "score"} <= names
+    assert sum(1 for r in recs if r["event"] == "heartbeat") >= 1
+    assert any(r["event"] == "scores" for r in recs)
+    assert obs.get_tracer() is None, "CLI uninstalled its tracer"
+
+
+def test_cli_heartbeat_requires_trace(tmp_path, capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        cli.main(["--input", "x", "--k", "2", "--heartbeat-secs", "1"])
+
+
+def test_cli_trace_appends_across_runs(tmp_path):
+    """--trace opens append-mode (like --metrics-out): two runs into one
+    file yield two manifests, and ids stay resolvable per run."""
+    gpath = str(tmp_path / "g.edges")
+    formats.write_edges(gpath, generators.karate_club())
+    tpath = str(tmp_path / "trace.jsonl")
+    for _ in range(2):
+        assert cli.main(["--input", gpath, "--k", "2", "--backend",
+                         "pure", "--trace", tpath, "--json"]) == 0
+    recs = [json.loads(l) for l in open(tpath)]
+    assert sum(1 for r in recs if r["event"] == "manifest") == 2

@@ -1,0 +1,35 @@
+"""tools/obs_smoke.sh wired as a fast tier-1 gate (ISSUE 3): a tiny
+traced RMAT build through the real CLI must produce a parseable trace
+with a manifest, a complete span tree, and >= 1 heartbeat."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_obs_smoke_script(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "obs_smoke.sh"),
+         str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "obs smoke OK" in r.stdout
+    report = open(tmp_path / "report.txt").read()
+    assert "UNCLOSED" not in report
+    assert "heartbeats:" in report
+
+
+def test_obs_smoke_report_check_gate(tmp_path):
+    """The --check gate the smoke relies on actually fails a trace with
+    a hole in it (guards against the gate rotting into a no-op)."""
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "span_start", "ts": 1.0, "span": "x", '
+                   '"id": 1, "parent": null}\n')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(bad), "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3

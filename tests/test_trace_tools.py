@@ -1,0 +1,288 @@
+"""tools/trace_report.py + tools/bench_regress.py (ISSUE 3 toolchain):
+golden render, well-formedness checks, dispatch attribution, regression
+gate pass/fail."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_report = _load_tool("trace_report")
+bench_regress = _load_tool("bench_regress")
+
+
+def _run_report(argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_report.main(argv)
+    return rc, buf.getvalue()
+
+
+# -- trace_report ----------------------------------------------------------
+
+def test_trace_report_golden():
+    """Pinned render of a recorded trace: self/total decomposition,
+    x-count aggregation, counter deltas net of children, heartbeat and
+    final-counter summaries. The golden path is relative, so run with
+    the repo-relative path the fixture was recorded with."""
+    rel = os.path.join("tests", "golden", "trace_small.jsonl")
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        rc, out = _run_report([rel, "--check"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    expect = open(os.path.join(GOLDEN, "trace_report.txt")).read()
+    assert out == expect
+
+
+def test_trace_report_json_tree_structure():
+    rc, out = _run_report(
+        [os.path.join(GOLDEN, "trace_small.jsonl"), "--json"])
+    assert rc == 0
+    doc = json.loads(out)
+    t = doc["traces"][0]
+    assert t["heartbeats"] == 2 and not t["unclosed"]
+    run = t["spans"][0]
+    assert run["name"] == "run" and run["total_s"] == 4.6
+    part = run["children"][0]
+    build = next(c for c in part["children"] if c["name"] == "build")
+    seg = build["children"][0]
+    assert seg["count"] == 2 and seg["total_s"] == 3.0
+    assert seg["counters"] == {"device_rounds": 22, "host_syncs": 2}
+    # build's self-delta nets out its children's counters entirely
+    assert build["counters"] == {}
+    assert abs(build["self_s"] - 0.2) < 1e-9
+
+
+def test_trace_report_appended_runs_not_merged(tmp_path):
+    """--trace appends and span ids restart per run: a two-run file must
+    report the LAST run (with an n_runs note), never merge both trees
+    under colliding ids (review finding)."""
+    src = open(os.path.join(GOLDEN, "trace_small.jsonl")).read()
+    p = str(tmp_path / "two.jsonl")
+    open(p, "w").write(src + src)  # rerun appended to the same file
+    rc, out = _run_report([p, "--check"])
+    assert rc == 0, "each run alone is complete; no merge corruption"
+    assert "holds 2 appended runs" in out
+    rc, out = _run_report([p, "--json"])
+    t = json.loads(out)["traces"][0]
+    assert t["n_runs"] == 2 and not t["unclosed"]
+    run = t["spans"][0]
+    assert run["count"] == 1 and run["total_s"] == 4.6, \
+        "one run's tree, not two runs summed"
+
+
+def test_trace_report_deferred_manifest_not_split(tmp_path):
+    """Multi-host CLI traces open the root span BEFORE the manifest
+    (deferred until after jax.distributed.initialize): that ordering is
+    ONE run, not two — splitting there orphaned the root's span_end and
+    mis-reported a valid trace as malformed (review finding)."""
+    p = str(tmp_path / "mh.jsonl")
+    with open(p, "w") as f:
+        for rec in [
+            {"event": "span_start", "ts": 1.0, "span": "run", "id": 1,
+             "parent": None},
+            {"event": "manifest", "ts": 1.2, "backend": "tpu-sharded"},
+            {"event": "span_start", "ts": 1.3, "span": "partition",
+             "id": 2, "parent": 1},
+            {"event": "span_end", "ts": 2.0, "span": "partition", "id": 2,
+             "parent": 1, "secs": 0.7},
+            {"event": "heartbeat", "ts": 2.0, "seq": 0, "final": True},
+            {"event": "span_end", "ts": 2.1, "span": "run", "id": 1,
+             "parent": None, "secs": 1.1},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    rc, out = _run_report([p, "--check"])
+    assert rc == 0, out
+    assert "appended runs" not in out and "UNCLOSED" not in out
+
+
+def test_trace_report_appended_runs_keep_their_manifest(tmp_path):
+    """When a DEAD run (unclosed spans) is rerun into the same file, the
+    second run's manifest precedes its first span; the split on span-id
+    collision must carry that manifest into the new segment."""
+    p = str(tmp_path / "dead_then_ok.jsonl")
+    dead = [
+        {"event": "manifest", "ts": 1.0, "backend": "tpu", "git_sha": "a"},
+        {"event": "span_start", "ts": 1.0, "span": "run", "id": 1,
+         "parent": None},
+    ]
+    ok = [
+        {"event": "manifest", "ts": 9.0, "backend": "tpu", "git_sha": "b"},
+        {"event": "span_start", "ts": 9.1, "span": "run", "id": 1,
+         "parent": None},
+        {"event": "span_end", "ts": 9.9, "span": "run", "id": 1,
+         "parent": None, "secs": 0.8},
+        {"event": "heartbeat", "ts": 9.9, "seq": 0, "final": True},
+    ]
+    with open(p, "w") as f:
+        for rec in dead + ok:
+            f.write(json.dumps(rec) + "\n")
+    rc, out = _run_report([p, "--json"])
+    assert rc == 0
+    t = json.loads(out)["traces"][0]
+    assert t["n_runs"] == 2 and t["manifest"]["git_sha"] == "b"
+    assert not t["unclosed"] and not t["check_failures"]
+
+
+def test_trace_report_flags_unclosed_spans(tmp_path):
+    """A killed run leaves span_starts without ends; the report must
+    say so (that is the dead-vs-slow distinction) and --check must
+    fail."""
+    p = str(tmp_path / "dead.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"event": "manifest", "ts": 10.0}) + "\n")
+        f.write(json.dumps({"event": "span_start", "ts": 10.0,
+                            "span": "build", "id": 1,
+                            "parent": None}) + "\n")
+        f.write(json.dumps({"event": "heartbeat", "ts": 55.0,
+                            "seq": 0}) + "\n")
+    rc, out = _run_report([p])
+    assert rc == 0 and "UNCLOSED" in out and "45.0" in out
+    rc, _ = _run_report([p, "--check"])
+    assert rc == 3
+
+
+def test_trace_report_orphan_end_is_malformed(tmp_path):
+    p = str(tmp_path / "bad.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"event": "span_end", "ts": 1.0, "span": "x",
+                            "id": 9, "secs": 1.0}) + "\n")
+    rc, _ = _run_report([p])
+    assert rc == 2
+
+
+def test_trace_report_tolerates_truncated_last_line(tmp_path):
+    src = open(os.path.join(GOLDEN, "trace_small.jsonl")).read()
+    p = str(tmp_path / "cut.jsonl")
+    open(p, "w").write(src + '{"event": "span_start", "ts": 99')
+    rc, out = _run_report([p, "--check"])
+    assert rc == 0 and "warning" not in out
+
+
+def _mini_trace(path, wall_s, syncs, rounds):
+    with open(path, "w") as f:
+        for rec in [
+            {"event": "manifest", "ts": 0.0},
+            {"event": "span_start", "ts": 0.0, "span": "build", "id": 1,
+             "parent": None},
+            {"event": "span_end", "ts": wall_s, "span": "build", "id": 1,
+             "parent": None, "secs": wall_s},
+            {"event": "counters", "ts": wall_s, "host_syncs": syncs,
+             "device_rounds": rounds},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_trace_report_dispatch_attribution(tmp_path):
+    """Two traces at different dispatch mixes solve the 2x2 count x
+    round-cost system exactly: A(10s, 8 syncs, 20 rounds) and
+    B(7s, 2 syncs, 20 rounds) -> 0.5 s/dispatch, 0.3 s/round."""
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _mini_trace(a, 10.0, 8, 20)
+    _mini_trace(b, 7.0, 2, 20)
+    rc, out = _run_report([a, b, "--json"])
+    assert rc == 0
+    att = json.loads(out)["attribution"]
+    assert att["per_dispatch_s"] == pytest.approx(0.5)
+    assert att["per_round_s"] == pytest.approx(0.3)
+
+
+def test_trace_report_attribution_degenerate(tmp_path):
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _mini_trace(a, 10.0, 8, 20)
+    _mini_trace(b, 5.0, 8, 20)  # same mix: nothing to attribute
+    rc, out = _run_report([a, b, "--json"])
+    assert rc == 0 and json.loads(out)["attribution"] is None
+
+
+# -- bench_regress ---------------------------------------------------------
+
+BASE = {"metric": "edges/sec partitioned (RMAT-20, k=64, tpu vs CPU)",
+        "value": 1.0e6, "unit": "edges/sec", "vs_baseline": 2.0,
+        "r_colo_est": 2.4, "host_syncs": 10, "device_rounds": 40,
+        "rtt_ms": 5.0}
+
+
+def _write(tmp_path, name, doc):
+    p = str(tmp_path / name)
+    json.dump(doc, open(p, "w"))
+    return p
+
+
+def test_bench_regress_pass(tmp_path):
+    old = _write(tmp_path, "old.json", {"n": 1, "parsed": BASE})
+    new = _write(tmp_path, "new.json",
+                 {**BASE, "value": 1.05e6, "rtt_ms": 50.0})
+    rc = bench_regress.main([new, old, "--threshold", "0.15"])
+    assert rc == 0, "faster run + environmental rtt swing is a pass"
+
+
+def test_bench_regress_detects_value_drop(tmp_path):
+    old = _write(tmp_path, "old.json", BASE)
+    new = _write(tmp_path, "new.json", {**BASE, "value": 0.7e6})
+    assert bench_regress.main([new, old, "--threshold", "0.15"]) == 2
+    # same drop passes a looser gate
+    assert bench_regress.main([new, old, "--threshold", "0.40"]) == 0
+
+
+def test_bench_regress_detects_dispatch_count_rise(tmp_path):
+    old = _write(tmp_path, "old.json", BASE)
+    new = _write(tmp_path, "new.json", {**BASE, "host_syncs": 30})
+    assert bench_regress.main([new, old]) == 2
+
+
+def test_bench_regress_rise_from_zero_is_gated(tmp_path):
+    """old host_syncs == 0 has no relative change, but 0 -> 500 is a
+    real scheduling regression and must not slip through the undefined
+    ratio (review finding)."""
+    old = _write(tmp_path, "old.json", {**BASE, "host_syncs": 0})
+    new = _write(tmp_path, "new.json", {**BASE, "host_syncs": 500})
+    assert bench_regress.main([new, old]) == 2
+    same = _write(tmp_path, "same.json", {**BASE, "host_syncs": 0})
+    assert bench_regress.main([same, old]) == 0
+
+
+def test_bench_regress_incomparable_metrics_pass(tmp_path):
+    """A cpu-jax fallback row must never false-alarm against a real
+    accelerator row — different metric strings are vacuously PASS."""
+    old = _write(tmp_path, "old.json", BASE)
+    new = _write(tmp_path, "new.json",
+                 {**BASE, "metric": "edges/sec (RMAT-18, k=64, cpu)",
+                  "value": 100.0})
+    assert bench_regress.main([new, old]) == 0
+
+
+def test_bench_regress_null_parsed_is_error(tmp_path):
+    old = _write(tmp_path, "old.json", {"n": 1, "parsed": None})
+    new = _write(tmp_path, "new.json", BASE)
+    assert bench_regress.main([new, old]) == 1
+
+
+def test_bench_regress_raw_jsonl_capture(tmp_path):
+    """bench.py stdout shape (stderr noise + one contract line) loads
+    too."""
+    p = str(tmp_path / "raw.json")
+    with open(p, "w") as f:
+        f.write("some stderr-ish noise\n")
+        f.write(json.dumps(BASE) + "\n")
+    old = _write(tmp_path, "old.json", BASE)
+    assert bench_regress.main([p, old]) == 0

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Compare two bench contract captures and flag perf regressions —
+the start of a perf-CI gate.
+
+    python tools/bench_regress.py                      # latest two BENCH_*.json
+    python tools/bench_regress.py NEW.json OLD.json    # explicit pair
+    python tools/bench_regress.py --threshold 0.10
+
+Accepts either shape on both sides: a driver-written ``BENCH_*.json``
+artifact (``{"n": ..., "parsed": {contract line}}``) or a raw bench.py
+output line/file (``{"metric": ..., "value": ...}``). Gated fields,
+each compared only when present in BOTH captures:
+
+    value, vs_baseline, r_colo_est    higher is better (relative drop
+                                      beyond --threshold regresses)
+    host_syncs, device_rounds         lower is better (relative rise
+                                      beyond --threshold regresses —
+                                      dispatch counts are deterministic,
+                                      so a rise is a real scheduling
+                                      change, not noise)
+
+Link-state fields (rtt_ms, h2d_mbs, d2h_mbs) are environmental and
+reported but never gated. Two captures whose ``metric`` strings differ
+(different RMAT scale or platform — e.g. a cpu-jax fallback row vs a
+real-chip row) are NOT comparable: the tool says so and exits 0 unless
+``--force``, because a false regression alarm that fires on every
+tunnel outage would get the gate deleted within a week.
+
+Exit codes: 0 pass (or not comparable), 1 usage/IO error,
+2 regression detected.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
+LOWER_BETTER = ("host_syncs", "device_rounds")
+INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch")
+
+
+def load_capture(path: str):
+    """Contract-line dict from either artifact shape, or None with a
+    reason string."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return None, f"cannot read {path}: {e}"
+    line = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # bench.py stdout style: JSONL, contract line last
+        for raw in reversed(text.splitlines()):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                cand = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(cand, dict) and "value" in cand:
+                line = cand
+                break
+        if line is None:
+            return None, f"{path}: no parseable JSON contract line"
+        return line, None
+    if isinstance(doc, dict) and "parsed" in doc:
+        line = doc["parsed"]
+        if not isinstance(line, dict):
+            return None, f"{path}: driver artifact has parsed=null " \
+                         f"(the bench run produced no contract line)"
+        return line, None
+    if isinstance(doc, dict) and "value" in doc:
+        return doc, None
+    return None, f"{path}: unrecognized capture shape"
+
+
+def compare(new: dict, old: dict, threshold: float) -> dict:
+    """{"comparable": bool, "rows": [...], "regressions": [...]}."""
+    out = {"comparable": True, "reason": None, "rows": [],
+           "regressions": []}
+    nm, om = new.get("metric"), old.get("metric")
+    if nm != om:
+        out["comparable"] = False
+        out["reason"] = (f"metric mismatch: new={nm!r} vs old={om!r} "
+                         f"(different scale/platform — no fair compare)")
+        return out
+    if not new.get("value") or not old.get("value"):
+        out["comparable"] = False
+        out["reason"] = "one capture has value 0/null (a failed run)"
+        return out
+    for field in HIGHER_BETTER + LOWER_BETTER + INFO_ONLY:
+        a, b = new.get(field), old.get(field)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        # old == 0: no relative change exists, but ANY movement off zero
+        # is gated absolutely — host_syncs 0 -> 500 must not pass just
+        # because the ratio is undefined
+        rel = (a - b) / abs(b) if b else None
+        worse = (a < b) if field in HIGHER_BETTER else (a > b)
+        row = {"field": field, "old": b, "new": a,
+               "rel_change": round(rel, 4) if rel is not None else None,
+               "gated": field not in INFO_ONLY}
+        regressed = worse if rel is None else (
+            rel < -threshold if field in HIGHER_BETTER
+            else rel > threshold)
+        if field in INFO_ONLY:
+            row["verdict"] = "info"
+        elif regressed:
+            row["verdict"] = "REGRESSION"
+            out["regressions"].append(row)
+        else:
+            row["verdict"] = "ok"
+        out["rows"].append(row)
+    return out
+
+
+def find_latest_pair(pattern: str):
+    files = sorted(glob.glob(pattern))
+    if len(files) < 2:
+        return None
+    return files[-1], files[-2]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Flag bench-contract regressions between two "
+                    "captures (perf-CI gate).")
+    ap.add_argument("new", nargs="?", default=None,
+                    help="newer capture (default: latest BENCH_*.json)")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="older capture (default: second-latest)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative change tolerated before a gated "
+                         "field regresses (default 0.15)")
+    ap.add_argument("--glob", default=None,
+                    help="artifact pattern for auto-discovery "
+                         "(default: BENCH_*.json next to this repo)")
+    ap.add_argument("--force", action="store_true",
+                    help="gate even when the metric strings differ")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if (args.new is None) != (args.old is None):
+        ap.error("pass both NEW and OLD, or neither (auto-discovery)")
+    if args.new is None:
+        pattern = args.glob or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_*.json")
+        pair = find_latest_pair(pattern)
+        if pair is None:
+            print(f"error: need >= 2 artifacts matching {pattern}",
+                  file=sys.stderr)
+            return 1
+        args.new, args.old = pair
+
+    new, err = load_capture(args.new)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    old, err = load_capture(args.old)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+
+    res = compare(new, old, args.threshold)
+    if args.force and not res["comparable"]:
+        forced_reason = res["reason"]
+        new2 = dict(new)
+        old2 = dict(old)
+        new2["metric"] = old2["metric"] = "(forced)"
+        new2["value"] = new2.get("value") or 1e-12
+        old2["value"] = old2.get("value") or 1e-12
+        res = compare(new2, old2, args.threshold)
+        res["reason"] = f"forced compare despite: {forced_reason}"
+
+    if args.json:
+        json.dump({"new": args.new, "old": args.old,
+                   "threshold": args.threshold, **res},
+                  sys.stdout, indent=1)
+        print()
+    else:
+        print(f"new: {args.new}")
+        print(f"old: {args.old}")
+        if not res["comparable"]:
+            print(f"not comparable: {res['reason']}")
+            print("verdict: PASS (vacuous — nothing gated)")
+            return 0
+        if res.get("reason"):
+            print(f"note: {res['reason']}")
+        print(f"{'field':<16}{'old':>14}{'new':>14}{'change':>10}  verdict")
+        for row in res["rows"]:
+            change = (f"{100 * row['rel_change']:>9.1f}%"
+                      if row["rel_change"] is not None else f"{'n/a':>10}")
+            print(f"{row['field']:<16}{row['old']:>14,.3f}"
+                  f"{row['new']:>14,.3f}{change}"
+                  f"  {row['verdict']}")
+        if res["regressions"]:
+            names = ", ".join(r["field"] for r in res["regressions"])
+            print(f"verdict: REGRESSION beyond {args.threshold:.0%} "
+                  f"in: {names}")
+        else:
+            print(f"verdict: PASS (no gated field moved beyond "
+                  f"{args.threshold:.0%})")
+    if not res["comparable"]:
+        return 0
+    return 2 if res["regressions"] else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # |head et al. closing stdout is not an error
+        sys.exit(0)

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# obs smoke: the observability acceptance gate, small enough for tier-1.
+#
+# Runs a tiny RMAT build through the real CLI with tracing + heartbeat
+# on, then asserts (via trace_report --check) that the trace parses and
+# contains a manifest, a COMPLETE span tree (every start has its end,
+# parents intact), and >= 1 heartbeat. Wired as a fast tier-1 test by
+# tests/test_obs_smoke.py.
+#
+# Usage: tools/obs_smoke.sh [OUT_DIR]   (default: a fresh mktemp dir)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+OUT="${1:-$(mktemp -d /tmp/sheep_obs_smoke.XXXXXX)}"
+mkdir -p "$OUT"
+TRACE="$OUT/trace.jsonl"
+rm -f "$TRACE"
+
+# pure backend: no device warm-up, runs in seconds on any host; the
+# heartbeat's final flush guarantees >= 1 record even this fast
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input rmat:10:8:1 --k 4 --backend pure \
+    --trace "$TRACE" --heartbeat-secs 0.2 --json \
+    > "$OUT/result.json"
+
+# the gate: parseable + manifest + complete span tree + >= 1 heartbeat
+python tools/trace_report.py "$TRACE" --check > "$OUT/report.txt"
+
+# and the render is non-trivial: the tree shows the partition phases
+grep -q "partition" "$OUT/report.txt"
+grep -q "heartbeats:" "$OUT/report.txt"
+
+echo "obs smoke OK: $TRACE"

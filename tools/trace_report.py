@@ -1,0 +1,421 @@
+#!/usr/bin/env python
+"""Render an obs trace (JSONL from --trace / obs.tracing) as a
+per-span self/total-time tree with counter deltas.
+
+    python tools/trace_report.py out.jsonl [--check] [--json]
+    python tools/trace_report.py run_a.jsonl run_b.jsonl   # + attribution
+
+One trace: manifest summary, the span tree (spans with the same name
+under the same parent aggregate into one row with a count), per-row
+total seconds / self seconds (total minus children), per-row counter
+deltas net of children, heartbeat summary, final counters, scores.
+Spans that STARTED but never ENDED are flagged ``UNCLOSED`` — the
+signature of a run that died mid-flight (the round-5 s30 soak's
+failure mode), with the elapsed time from span start to the last
+record in the file as the lower-bound duration.
+
+Two traces: additionally solves the count x round-cost dispatch
+attribution (sheep_tpu.utils.metrics.solve_dispatch_attribution) from
+each trace's build wall + host_syncs/device_rounds counters — two runs
+of the same build at different --dispatch-batch yield the per-dispatch
+overhead vs per-round device cost split.
+
+``--check`` exits non-zero unless the trace is well-formed AND
+complete: parses, has a manifest, every span end matches a start,
+no span is left unclosed, and >= 1 heartbeat exists (the obs_smoke
+gate).
+
+Exit codes: 0 ok; 1 usage/IO; 2 malformed trace (an end without a
+start, unparseable beyond stray truncation); 3 --check unsatisfied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_trace(path: str) -> dict:
+    """Parse one trace file into {events, spans, roots, errors...}.
+
+    --trace appends, so one file may hold SEVERAL runs; each run's span
+    ids restart at 1. The stream is segmented into runs (a new manifest
+    after spans were seen, or a span_start whose id already exists in
+    the current segment, starts the next one) and the LAST run is
+    reported, with ``n_runs`` recording how many the file holds —
+    merging them would attach run 2's children to run 1's ids and
+    silently corrupt every number in the report.
+
+    A truncated LAST line (the process died mid-write) is tolerated
+    silently; any other unparseable line is reported. span_end without
+    a matching span_start marks the trace malformed."""
+    all_events = []
+    bad_lines = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            all_events.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                continue  # mid-write kill; everything before it counts
+            bad_lines.append(i + 1)
+
+    # Run boundaries: a span_start whose id already exists in the
+    # current segment (ids restart at 1 per Tracer) OR a manifest
+    # arriving when every current span is closed. The open-span
+    # condition matters: multi-host traces legitimately emit the
+    # manifest AFTER the root span opened (deferred until
+    # jax.distributed.initialize) — splitting there would orphan the
+    # root's span_end and mis-report a valid trace as malformed.
+    segments: list = [[]]
+    seen_ids: set = set()
+    open_ids: set = set()
+    for e in all_events:
+        ev = e.get("event")
+        new_run = (ev == "span_start" and e.get("id") in seen_ids) or \
+            (ev == "manifest" and seen_ids and not open_ids)
+        if new_run and segments[-1]:
+            if ev == "span_start":
+                # the new run's manifest (and trailing records) came
+                # before its first span — carry them over; span events
+                # themselves anchor segments, so only the tail past the
+                # previous segment's last span event can move
+                seg = segments[-1]
+                last_span = max((i for i, x in enumerate(seg)
+                                 if x.get("event") in ("span_start",
+                                                       "span_end")),
+                                default=-1)
+                mans = [i for i, x in enumerate(seg)
+                        if x.get("event") == "manifest" and i > last_span]
+                carried: list = []
+                if mans:
+                    carried = seg[mans[0]:]
+                    del seg[mans[0]:]
+                segments.append(carried)
+            else:
+                segments.append([])
+            seen_ids = set()
+            open_ids = set()
+        if ev == "span_start":
+            seen_ids.add(e.get("id"))
+            open_ids.add(e.get("id"))
+        elif ev == "span_end":
+            open_ids.discard(e.get("id"))
+        segments[-1].append(e)
+    events = segments[-1]
+
+    spans: dict = {}  # id -> node
+    orphan_ends = []
+    last_ts = max((e.get("ts", 0) for e in events), default=0)
+    for e in events:
+        ev = e.get("event")
+        if ev == "span_start":
+            spans[e["id"]] = {
+                "id": e["id"], "name": e.get("span", "?"),
+                "parent": e.get("parent"), "ts": e.get("ts", 0),
+                "attrs": {k: v for k, v in e.items()
+                          if k not in ("event", "ts", "span", "id",
+                                       "parent")},
+                "secs": None, "counters": {}, "children": []}
+        elif ev == "span_end":
+            node = spans.get(e.get("id"))
+            if node is None:
+                orphan_ends.append(e.get("id"))
+                continue
+            node["secs"] = e.get("secs", 0.0)
+            node["counters"] = e.get("counters", {})
+    roots = []
+    for node in spans.values():
+        parent = spans.get(node["parent"])
+        if parent is not None:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    unclosed = [n for n in spans.values() if n["secs"] is None]
+    for n in unclosed:
+        # lower bound: span start to the last record the run managed
+        n["secs"] = max(0.0, round(last_ts - n["ts"], 3))
+        n["unclosed"] = True
+    return {
+        "events": events, "spans": spans, "roots": roots,
+        "n_runs": len(segments),
+        "unclosed": unclosed, "orphan_ends": orphan_ends,
+        "bad_lines": bad_lines,
+        "manifest": next((e for e in events
+                          if e.get("event") == "manifest"), None),
+        "backend_resolved": next(
+            (e for e in events if e.get("event") == "backend_resolved"),
+            None),
+        "heartbeats": [e for e in events if e.get("event") == "heartbeat"],
+        "scores": [e for e in events if e.get("event") == "scores"],
+        "counters": next((e for e in reversed(events)
+                          if e.get("event") == "counters"), None),
+    }
+
+
+def _num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def aggregate(nodes: list) -> list:
+    """Group sibling spans by name into display rows: count, total
+    secs, self secs (total - children), counter deltas NET of children
+    (the same self/total decomposition, applied to counters), then
+    recurse. Rows keep first-seen order."""
+    rows: dict = {}
+    for n in nodes:
+        row = rows.setdefault(n["name"], {
+            "name": n["name"], "count": 0, "total_s": 0.0, "self_s": 0.0,
+            "counters": {}, "unclosed": 0, "children_nodes": []})
+        row["count"] += 1
+        row["total_s"] += n["secs"] or 0.0
+        child_s = sum(c["secs"] or 0.0 for c in n["children"])
+        row["self_s"] += max(0.0, (n["secs"] or 0.0) - child_s)
+        row["unclosed"] += 1 if n.get("unclosed") else 0
+        row["children_nodes"].extend(n["children"])
+        # counter self-delta: this span's delta minus its children's
+        child_counts: dict = {}
+        for c in n["children"]:
+            for k, v in c["counters"].items():
+                if _num(v):
+                    child_counts[k] = child_counts.get(k, 0) + v
+        for k, v in n["counters"].items():
+            if _num(v):
+                d = v - child_counts.get(k, 0)
+                if abs(d) > 1e-6:  # float residue is not a real delta
+                    row["counters"][k] = row["counters"].get(k, 0) + d
+            elif v != child_counts.get(k):
+                row["counters"][k] = v
+    out = []
+    for row in rows.values():
+        row["children"] = aggregate(row.pop("children_nodes"))
+        out.append(row)
+    return out
+
+
+def _fmt_counters(c: dict) -> str:
+    if not c:
+        return ""
+    parts = []
+    for k, v in sorted(c.items()):
+        if _num(v):
+            parts.append(f"{k}=+{round(v, 3):g}" if v >= 0
+                         else f"{k}={round(v, 3):g}")
+        else:
+            parts.append(f"{k}={v}")
+    return "  " + " ".join(parts)
+
+
+def render_tree(rows: list, out, depth: int = 0) -> None:
+    for row in rows:
+        name = row["name"] + (f" x{row['count']}" if row["count"] > 1
+                              else "")
+        flag = "  UNCLOSED (run died here?)" if row["unclosed"] else ""
+        out.write(f"  {'  ' * depth}{name:<{max(1, 28 - 2 * depth)}}"
+                  f"{row['total_s']:>9.3f}s {row['self_s']:>9.3f}s self"
+                  f"{_fmt_counters(row['counters'])}{flag}\n")
+        render_tree(row["children"], out, depth + 1)
+
+
+def _build_wall(rows: list) -> float:
+    """Total seconds of the build-phase rows (build / build+merge),
+    searched depth-first — the wall the dispatch attribution prices."""
+    for row in rows:
+        if row["name"] in ("build", "build+merge"):
+            return row["total_s"]
+        w = _build_wall(row["children"])
+        if w:
+            return w
+    return 0.0
+
+
+def attribution_inputs(parsed: dict, rows: list):
+    cnt = parsed["counters"] or {}
+    if not cnt:
+        # fall back to the last heartbeat's registry snapshot (a killed
+        # run never writes the final counters event)
+        hbs = parsed["heartbeats"]
+        cnt = hbs[-1].get("counters", {}) if hbs else {}
+    syncs, rounds = cnt.get("host_syncs"), cnt.get("device_rounds")
+    wall = _build_wall(rows)
+    if syncs is None or rounds is None or not wall:
+        return None
+    return {"wall_s": wall, "syncs": syncs, "rounds": rounds}
+
+
+def report_one(path: str, args) -> tuple:
+    """Returns (report dict, list of --check failures)."""
+    parsed = parse_trace(path)
+    rows = aggregate(parsed["roots"])
+    problems = []
+    if parsed["orphan_ends"]:
+        problems.append(f"span_end without span_start: "
+                        f"ids {parsed['orphan_ends'][:8]}")
+    if parsed["bad_lines"]:
+        problems.append(f"unparseable lines: {parsed['bad_lines'][:8]}")
+    check_fail = list(problems)
+    if parsed["manifest"] is None:
+        check_fail.append("no manifest event")
+    if parsed["unclosed"]:
+        check_fail.append(
+            f"unclosed spans: "
+            f"{[n['name'] for n in parsed['unclosed']][:8]}")
+    if not parsed["heartbeats"]:
+        check_fail.append("no heartbeat events")
+    if not parsed["spans"]:
+        check_fail.append("no spans at all")
+    return {"path": path, "parsed": parsed, "rows": rows,
+            "problems": problems}, check_fail
+
+
+def print_report(rep: dict, out) -> None:
+    parsed = rep["parsed"]
+    out.write(f"trace: {rep['path']}\n")
+    if parsed["n_runs"] > 1:
+        out.write(f"note: file holds {parsed['n_runs']} appended runs; "
+                  f"reporting the last\n")
+    m = parsed["manifest"]
+    if m is not None:
+        bits = [f"{k}={m[k]}" for k in ("backend", "platform",
+                                        "device_count", "process_count",
+                                        "jax_version", "git_sha")
+                if m.get(k) is not None]
+        resolved = parsed["backend_resolved"]
+        if m.get("backend") is None and resolved is not None:
+            bits.insert(0, f"backend={resolved.get('backend')} (auto)")
+        cfg = m.get("config") or {}
+        for k in ("input", "k", "dispatch_batch", "chunk_edges"):
+            if cfg.get(k) is not None:
+                bits.append(f"{k}={cfg[k]}")
+        out.write(f"manifest: {' '.join(bits)}\n")
+    else:
+        out.write("manifest: MISSING\n")
+    out.write("span tree (total / self seconds, counter deltas net of "
+              "children):\n")
+    if rep["rows"]:
+        render_tree(rep["rows"], out)
+    else:
+        out.write("  (no spans)\n")
+    for n in parsed["unclosed"]:
+        out.write(f"  !! UNCLOSED span {n['name']!r} (id {n['id']}) — "
+                  f"started, never ended; >= {n['secs']}s elapsed at "
+                  f"last record. A killed/hung run, not a finished "
+                  f"one.\n")
+    hbs = parsed["heartbeats"]
+    if hbs:
+        last = hbs[-1]
+        bits = [f"{k}={last[k]}" for k in ("phase", "chunks_done",
+                                           "chunks_total", "edges_per_sec",
+                                           "eta_s") if last.get(k)
+                is not None]
+        out.write(f"heartbeats: {len(hbs)}  last: {' '.join(bits)}\n")
+    cnt = parsed["counters"]
+    if cnt:
+        cs = {k: v for k, v in cnt.items() if k not in ("event", "ts")}
+        out.write(f"counters (final): "
+                  f"{_fmt_counters(cs).strip() or '(none)'}\n")
+    for s in parsed["scores"]:
+        bits = [f"{k}={s[k]}" for k in ("k", "edge_cut", "cut_ratio",
+                                        "balance", "comm_volume")
+                if s.get(k) is not None]
+        out.write(f"scores: {' '.join(bits)}\n")
+    for p in rep["problems"]:
+        out.write(f"warning: {p}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render obs trace JSONL as a span tree; two traces "
+                    "add the dispatch-cost attribution solve.")
+    ap.add_argument("trace", help="trace JSONL (from --trace)")
+    ap.add_argument("trace_b", nargs="?", default=None,
+                    help="second trace: solve per-dispatch vs per-round "
+                         "cost from the two runs' dispatch counts")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 3 unless well-formed + manifest + "
+                         "complete span tree + >= 1 heartbeat")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    reports = []
+    checks = []
+    for path in [args.trace] + ([args.trace_b] if args.trace_b else []):
+        if not os.path.exists(path):
+            print(f"error: no such trace: {path}", file=sys.stderr)
+            return 1
+        rep, check_fail = report_one(path, args)
+        reports.append(rep)
+        checks.append(check_fail)
+
+    attribution = None
+    if len(reports) == 2:
+        ins = [attribution_inputs(r["parsed"], r["rows"])
+               for r in reports]
+        if all(ins):
+            from sheep_tpu.utils.metrics import solve_dispatch_attribution
+
+            attribution = solve_dispatch_attribution(ins[0], ins[1])
+            if attribution is not None:
+                attribution = {"inputs": ins, **attribution}
+
+    if args.json:
+        out = []
+        for rep, cf in zip(reports, checks):
+            out.append({
+                "path": rep["path"], "spans": rep["rows"],
+                "n_runs": rep["parsed"]["n_runs"],
+                "manifest": rep["parsed"]["manifest"],
+                "heartbeats": len(rep["parsed"]["heartbeats"]),
+                "unclosed": [n["name"] for n in rep["parsed"]["unclosed"]],
+                "counters": rep["parsed"]["counters"],
+                "check_failures": cf,
+            })
+        doc = {"traces": out}
+        if len(reports) == 2:
+            doc["attribution"] = attribution
+        json.dump(doc, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        for i, rep in enumerate(reports):
+            if i:
+                print()
+            print_report(rep, sys.stdout)
+        if len(reports) == 2:
+            print()
+            if attribution is not None:
+                a = attribution
+                print("dispatch attribution (wall = syncs*per_dispatch + "
+                      "rounds*per_round):")
+                print(f"  inputs A: {a['inputs'][0]}")
+                print(f"  inputs B: {a['inputs'][1]}")
+                print(f"  per_dispatch_s = {a['per_dispatch_s']:.6f}   "
+                      f"per_round_s = {a['per_round_s']:.6f}")
+            else:
+                print("dispatch attribution: not solvable (need "
+                      "host_syncs/device_rounds + a build span in both "
+                      "traces, with different sync/round mixes)")
+
+    if any(r["parsed"]["orphan_ends"] for r in reports):
+        return 2
+    if args.check and any(checks):
+        for rep, cf in zip(reports, checks):
+            for c in cf:
+                print(f"check failed [{rep['path']}]: {c}",
+                      file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # |head et al. closing stdout is not an error
+        sys.exit(0)
