@@ -91,10 +91,13 @@ def _upload_chunks(stream, cs: int, n: int, start_chunk: int):
         for i in range(start_chunk, stream.num_device_chunks(cs)):
             yield dev(i, cs, n)
         return
-    for padded in prefetch(pad_chunk(c, cs, n)
-                           for c in stream.chunks(cs,
-                                                  start_chunk=start_chunk)):
-        yield jnp.asarray(padded)
+    pf = prefetch(pad_chunk(c, cs, n)
+                  for c in stream.chunks(cs, start_chunk=start_chunk))
+    try:
+        for padded in pf:
+            yield jnp.asarray(padded)
+    finally:
+        pf.close()
 
 
 def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
@@ -157,7 +160,8 @@ def _device_hbm_bytes(purpose: str = "the chunk cache") -> int:
 
 
 def _chunk_cache_budget(n: int, chunk_edges: int,
-                        dispatch_batch: int = 1) -> int:
+                        dispatch_batch: int = 1, inflight: int = 1,
+                        donate: bool = False) -> int:
     """Bytes of HBM safely spendable on cached chunks: the device limit
     minus the build phase's modeled peak (including the batched
     dispatch's [N, C] staging blocks) and a safety margin.
@@ -175,18 +179,22 @@ def _chunk_cache_budget(n: int, chunk_edges: int,
         return max(0, int(env))
     hbm = _device_hbm_bytes()
     reserve = build_phase_bytes(
-        n, chunk_edges,
-        dispatch_batch=dispatch_batch)["total_bytes"] + (1 << 30)
+        n, chunk_edges, dispatch_batch=dispatch_batch,
+        inflight=inflight, donate=donate)["total_bytes"] + (1 << 30)
     return max(0, int(0.9 * hbm) - reserve)
 
 
-def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int) -> int:
+def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int,
+                           inflight: int = 1,
+                           donate: bool = False) -> int:
     """The one auto-sizing rule for ``dispatch_batch`` (shared by the
     single-device and sharded backends): explicit N passes through,
     0 (auto) resolves to per-segment on cpu-jax — host dispatch is
     cheap there and the adaptive driver's compaction/host-tail schedule
     wins — and otherwise to the largest N whose O(N*C) staging fits the
-    HBM model (utils/membudget.dispatch_batch_for)."""
+    HBM model (utils/membudget.dispatch_batch_for). ``inflight`` and
+    ``donate`` thread the in-flight pipeline's D-deep staging and the
+    donation credit into that model."""
     if dispatch_batch != 0:
         return max(1, int(dispatch_batch))
     if jax.default_backend() == "cpu":
@@ -196,7 +204,20 @@ def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int) -> int:
         return 1
     from sheep_tpu.utils.membudget import dispatch_batch_for
 
-    return dispatch_batch_for(int(0.9 * hbm), n, cs)
+    return dispatch_batch_for(int(0.9 * hbm), n, cs, inflight=inflight,
+                              donate=donate)
+
+
+def resolve_inflight(inflight: int) -> int:
+    """Auto-sizing rule for the dispatch pipeline depth (shared by the
+    single-device and sharded backends): explicit D >= 1 passes
+    through; 0 (auto) resolves to 2 (double-buffered — one execution
+    materializing while the previous one's stats word is pulled) on
+    accelerators and 1 (synchronous) on cpu-jax, where "device" work
+    shares the host's cores and there is no link RTT to hide."""
+    if inflight != 0:
+        return max(1, int(inflight))
+    return 1 if jax.default_backend() == "cpu" else 2
 
 
 def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
@@ -216,11 +237,18 @@ def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
             yield [d]
         return
     if cache is None and getattr(stream, "device_chunk", None) is None:
-        for host_group in prefetch_batched(
-                (pad_chunk(c, cs, n)
-                 for c in stream.chunks(cs, start_chunk=start_chunk)),
-                batch):
-            yield [jnp.asarray(p) for p in host_group]
+        pf = prefetch_batched(
+            (pad_chunk(c, cs, n)
+             for c in stream.chunks(cs, start_chunk=start_chunk)),
+            batch)
+        try:
+            for host_group in pf:
+                yield [jnp.asarray(p) for p in host_group]
+        finally:
+            # deterministic worker cancel on abandonment (the in-flight
+            # pipeline's discard/backstop paths close this generator
+            # mid-stream): drain + join instead of waiting for the GC
+            pf.close()
         return
     group: list = []
     for d in _device_chunks(stream, cs, n, cache, start_chunk):
@@ -244,7 +272,9 @@ class TpuBackend(Partitioner):
                  carry_tail: Optional[bool] = None,
                  tail_overlap: Optional[bool] = None,
                  stale_reuse: int = 1,
-                 dispatch_batch: int = 0):
+                 dispatch_batch: int = 0,
+                 inflight: int = 0,
+                 donate_buffers: Optional[bool] = None):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -303,19 +333,48 @@ class TpuBackend(Partitioner):
         if dispatch_batch < 0:
             raise ValueError("dispatch_batch must be >= 0 (0 = auto)")
         self.dispatch_batch = dispatch_batch
+        # asynchronous dispatch pipeline depth (ops/elim.py
+        # fold_segments_pipelined): keep up to D issued batched
+        # executions whose stats words are unread futures, converting
+        # each to host ints one-behind so the device never waits for a
+        # host read/orient/pad and the host never waits for a device
+        # program. 0 = auto (2 on accelerators, 1 = synchronous on
+        # cpu-jax); any D yields the bit-identical forest (fixpoint
+        # uniqueness — tests/test_inflight.py).
+        if inflight < 0:
+            raise ValueError("inflight must be >= 0 (0 = auto)")
+        self.inflight = inflight
+        # donate the carried table + staging blocks into each batched
+        # execution so XLA reuses their buffers for the outputs instead
+        # of double-buffering across executions (None = auto: on
+        # whenever the batched/pipelined dispatch runs; results are
+        # identical either way — donation is pure buffer aliasing)
+        self.donate_buffers = donate_buffers
         if dispatch_batch > 1 and (carry_tail or tail_overlap):
             raise ValueError("dispatch_batch > 1 folds whole segments on "
                              "device; it excludes the per-chunk tail "
+                             "strategies (carry_tail / tail_overlap)")
+        if inflight > 1 and (carry_tail or tail_overlap):
+            raise ValueError("inflight > 1 pipelines whole batched "
+                             "executions; it excludes the per-chunk tail "
                              "strategies (carry_tail / tail_overlap)")
         if carry_tail and tail_overlap:
             raise ValueError("carry_tail and tail_overlap are mutually "
                              "exclusive tail strategies")
 
-    def _resolve_dispatch_batch(self, n: int, cs: int) -> int:
+    def _resolve_inflight(self) -> int:
+        if self.inflight == 0 and (self.carry_tail or self.tail_overlap):
+            return 1  # auto defers to an explicit per-chunk tail strategy
+        return resolve_inflight(self.inflight)
+
+    def _resolve_dispatch_batch(self, n: int, cs: int,
+                                inflight: int = 1,
+                                donate: bool = False) -> int:
         if self.dispatch_batch == 0 and (self.carry_tail or
                                          self.tail_overlap):
             return 1  # auto defers to an explicit per-chunk tail strategy
-        return resolve_dispatch_batch(self.dispatch_batch, n, cs)
+        return resolve_dispatch_batch(self.dispatch_batch, n, cs,
+                                      inflight=inflight, donate=donate)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -352,8 +411,19 @@ class TpuBackend(Partitioner):
             deg_host = state.arrays["deg"].copy()
         else:
             deg_host = np.zeros(n, dtype=np.int64)
-        batch_n = self._resolve_dispatch_batch(n, cs)
-        cache_budget = _chunk_cache_budget(n, cs, dispatch_batch=batch_n) \
+        inflight_n = self._resolve_inflight()
+        donate = True if self.donate_buffers is None else self.donate_buffers
+        batch_n = self._resolve_dispatch_batch(n, cs, inflight=inflight_n,
+                                               donate=donate)
+        # the donating fold only runs on the pipelined/batched branch
+        # (batch_n == 1 == inflight_n selects the adaptive per-segment
+        # driver below); crediting donation to the HBM model on a path
+        # that never donates would under-reserve by a full minp table
+        if batch_n == 1 and inflight_n == 1:
+            donate = False
+        cache_budget = _chunk_cache_budget(n, cs, dispatch_batch=batch_n,
+                                           inflight=inflight_n,
+                                           donate=donate) \
             if self.cache_chunks else 0
         cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
         sp = obs.begin("degrees")
@@ -455,49 +525,102 @@ class TpuBackend(Partitioner):
                             pos_host=pos_host_cache, stats=build_stats)
                         total_rounds += int(r)
 
-                if batch_n > 1 and not carry_mode and not overlap:
-                    # batched segment dispatch: stage batch_n chunks as
-                    # one oriented [N, C] block and fold them in bounded
-                    # multi-segment device programs — one packed stats
-                    # sync per execution instead of per segment
-                    # (ops/elim.py fold_segments_batch). Warm schedule /
-                    # compaction / host tail are per-segment host
-                    # decisions and do not apply here; the forest is the
-                    # same unique fixpoint either way.
+                if (batch_n > 1 or inflight_n > 1) and not carry_mode \
+                        and not overlap:
+                    # batched segment dispatch, pipelined (ops/elim.py
+                    # fold_segments_pipelined): stage batch_n chunks as
+                    # one oriented [N, C] block, fold groups in bounded
+                    # multi-segment device programs with up to
+                    # inflight_n executions in flight, and pull one
+                    # packed stats word per execution ONE-BEHIND — the
+                    # host's read/orient/pad overlaps the device
+                    # fixpoint instead of alternating with it, and
+                    # donation reuses the table/staging buffers across
+                    # the chain. Warm schedule / compaction / host tail
+                    # are per-segment host decisions and do not apply
+                    # here; the forest is the same unique fixpoint
+                    # either way.
                     build_stats["dispatch_batch"] = batch_n
-                    sentinel_chunk = None
-                    for group in _device_chunk_groups(
-                            stream, cs, n, cache, start, batch_n):
-                        gl = len(group)
-                        if gl < batch_n:
-                            if sentinel_chunk is None:
-                                sentinel_chunk = jnp.full((cs, 2), n,
-                                                          jnp.int32)
-                            group = group + [sentinel_chunk] * \
-                                (batch_n - gl)
-                        dsp = obs.begin("dispatch", i=idx, chunks=gl)
-                        loB, hiB = elim_ops.orient_chunks_batch_pos(
-                            jnp.stack(group), pos, n)
-                        P, rounds = elim_ops.fold_segments_batch(
-                            P, loB, hiB, n,
-                            lift_levels=self.lift_levels,
-                            segment_rounds=self.segment_rounds,
-                            stats=build_stats)
-                        total_rounds += int(rounds)
+                    build_stats["inflight_depth"] = inflight_n
+                    groups = _device_chunk_groups(stream, cs, n, cache,
+                                                  start, batch_n)
+
+                    def staged_groups():
+                        sentinel_chunk = None
+                        for group in groups:
+                            gl = len(group)
+                            if gl < batch_n:
+                                if sentinel_chunk is None:
+                                    sentinel_chunk = jnp.full(
+                                        (cs, 2), n, jnp.int32)
+                                group = group + [sentinel_chunk] * \
+                                    (batch_n - gl)
+                            loB, hiB = elim_ops.orient_chunks_batch_pos(
+                                jnp.stack(group), pos, n)
+                            yield loB, hiB, gl
+
+                    # rolling dispatch spans tile the pipelined build:
+                    # each one covers confirm-to-confirm (the counter
+                    # deltas carry the overlap story — host_blocked_ms /
+                    # device_gap_ms); issue/confirm interleave across
+                    # groups, so per-group spans would no longer nest
+                    dsp = obs.begin("dispatch", i=idx)
+
+                    def confirmed(gl, rounds, tipP):
+                        # returns True to request a flush barrier when a
+                        # checkpoint is due: mid-pipeline the tip table
+                        # can UNDER-represent a confirmed group whose
+                        # budget-exhausted leftovers are still queued,
+                        # so the save itself happens in flushed(), after
+                        # the driver drains everything issued
+                        nonlocal idx, dsp
                         stats_acc.absorb(build_stats)
                         dsp.end(rounds=int(rounds))
-                        prev = idx
-                        idx += gl
-                        obs.chunk_progress(idx, cs, m_cheap)
-                        for i in range(prev + 1, idx + 1):
-                            maybe_fail("build", i - start)
-                        if checkpointer is not None and \
+                        due = False
+                        if gl is not None:
+                            prev = idx
+                            idx += gl
+                            obs.chunk_progress(idx, cs, m_cheap)
+                            for i in range(prev + 1, idx + 1):
+                                maybe_fail("build", i - start)
+                            due = checkpointer is not None and \
                                 checkpointer.due_span(prev - start,
-                                                      idx - start):
-                            checkpointer.save(
-                                "build", idx,
-                                {"deg": deg_host,
-                                 "minp": np.asarray(P[pos])}, meta)
+                                                      idx - start)
+                        dsp = obs.begin("dispatch", i=idx)
+                        return due
+
+                    def flushed(tipP):
+                        # pipeline fully drained: idx (advanced through
+                        # every group confirmed during the drain) and
+                        # the table now agree exactly
+                        checkpointer.save(
+                            "build", idx,
+                            {"deg": deg_host,
+                             "minp": np.asarray(tipP[pos])}, meta)
+
+                    staged = staged_groups()
+                    try:
+                        P, rounds = elim_ops.fold_segments_pipelined(
+                            P, staged, n,
+                            inflight=inflight_n,
+                            lift_levels=self.lift_levels,
+                            segment_rounds=self.segment_rounds,
+                            donate=donate,
+                            stats=build_stats,
+                            on_confirm=confirmed,
+                            on_flush=flushed)
+                        total_rounds += int(rounds)
+                    finally:
+                        # the discard/backstop paths abandon the staged
+                        # stream mid-iteration: close BOTH generators —
+                        # a for-loop does not close the iterator it
+                        # consumes, so staged.close() alone would leave
+                        # _device_chunk_groups (and the prefetch worker
+                        # its finally cancels) open until GC
+                        staged.close()
+                        groups.close()
+                        dsp.end()
+                    stats_acc.absorb(build_stats)
                 else:
                     for padded in _device_chunks(stream, cs, n, cache,
                                                  start):
@@ -621,11 +744,13 @@ class TpuBackend(Partitioner):
             assignment=assign_host, k=k, edge_cut=cut, total_edges=total,
             cut_ratio=cut / max(total, 1), balance=balance, comm_volume=cv,
             phase_times=t, backend=self.name,
-            # t_* walls accumulate unrounded (elim.py t_add) and are
-            # rounded HERE, at read time, so sum(t_*) never drifts past
-            # the measured wall by per-add rounding quanta
+            # t_* walls and *_ms counters accumulate unrounded (elim.py
+            # t_add/_t_ms) and are rounded HERE, at read time, so their
+            # sums never drift past the measured wall by per-add
+            # rounding quanta
             diagnostics={"fixpoint_rounds": float(total_rounds),
-                         **{k: (round(float(v), 3) if k.startswith("t_")
+                         **{k: (round(float(v), 3)
+                                if k.startswith("t_") or k.endswith("_ms")
                                 else float(v))
                             for k, v in build_stats.items()}},
             tree={"parent": np.asarray(parent), "pos": pos_host,

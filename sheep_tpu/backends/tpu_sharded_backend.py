@@ -26,7 +26,8 @@ class TpuShardedBackend(Partitioner):
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0, n_devices: int | None = None,
                  segment_rounds: int = 32, warm_schedule=((1, 8),),
-                 dispatch_batch: int = 0):
+                 dispatch_batch: int = 0, inflight: int = 0,
+                 donate_buffers: bool | None = None):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -39,6 +40,15 @@ class TpuShardedBackend(Partitioner):
         if dispatch_batch < 0:
             raise ValueError("dispatch_batch must be >= 0 (0 = auto)")
         self.dispatch_batch = dispatch_batch
+        # asynchronous dispatch pipeline depth for the batched path
+        # (see ShardedPipeline.build_step_batch): 0 = auto (2 on
+        # accelerators, 1 = synchronous on cpu-jax)
+        if inflight < 0:
+            raise ValueError("inflight must be >= 0 (0 = auto)")
+        self.inflight = inflight
+        # donate per-device tables + staging blocks into the batched
+        # executions (None = auto: on for the batched/pipelined path)
+        self.donate_buffers = donate_buffers
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -54,13 +64,18 @@ class TpuShardedBackend(Partitioner):
         # chunk sizing (and checkpoint fingerprints) cannot diverge
         cs = stream.clamp_chunk_edges(self.chunk_edges,
                                       parts=mesh.devices.size)
-        from sheep_tpu.backends.tpu_backend import resolve_dispatch_batch
+        from sheep_tpu.backends.tpu_backend import resolve_dispatch_batch, \
+            resolve_inflight
 
-        nb = resolve_dispatch_batch(self.dispatch_batch, n, cs)
+        inflight = resolve_inflight(self.inflight)
+        donate = True if self.donate_buffers is None else self.donate_buffers
+        nb = resolve_dispatch_batch(self.dispatch_batch, n, cs,
+                                    inflight=inflight, donate=donate)
         pipe = ShardedPipeline(n, cs, mesh, lift_levels=self.lift_levels,
                                segment_rounds=self.segment_rounds,
                                warm_schedule=self.warm_schedule,
-                               dispatch_batch=nb)
+                               dispatch_batch=nb, inflight=inflight,
+                               donate=donate)
 
         timings: dict = {}
         out = pipe.run(stream, k, alpha=self.alpha, weights=weights,
@@ -72,10 +87,13 @@ class TpuShardedBackend(Partitioner):
             cut_ratio=out["edge_cut"] / max(out["total_edges"], 1),
             balance=out["balance"], comm_volume=out["comm_volume"],
             phase_times=timings, backend=self.name,
-            # t_* walls accumulate unrounded (elim.py t_add convention)
-            # and are rounded here at read time, matching the tpu
-            # backend and bench.py so artifacts stay diffable
-            diagnostics={k_: (round(v, 3) if k_.startswith("t_")
+            # t_* walls and *_ms counters accumulate unrounded (elim.py
+            # t_add/_t_ms convention) and are rounded here at read
+            # time, matching the tpu backend and bench.py so artifacts
+            # stay diffable
+            diagnostics={k_: (round(v, 3)
+                              if (k_.startswith("t_")
+                                  or k_.endswith("_ms"))
                               and isinstance(v, float)
                               else v if isinstance(v, (int, float))
                               else str(v))

@@ -127,6 +127,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "sized N on accelerators; 1 = per-segment "
                         "dispatch; the forest is bit-identical either "
                         "way). Excludes --carry-tail/--tail-overlap")
+    p.add_argument("--inflight", type=int, default=None, metavar="D",
+                   help="tpu/tpu-sharded: depth of the asynchronous "
+                        "dispatch pipeline — keep up to D batched device "
+                        "executions in flight with their packed stats "
+                        "words read one-behind, so host staging, H2D "
+                        "transfer and the device fixpoint overlap "
+                        "instead of alternating (0 = auto: 2 on "
+                        "accelerators, 1 on cpu-jax; 1 = synchronous "
+                        "dispatch; the forest is bit-identical at every "
+                        "depth). Excludes --carry-tail/--tail-overlap")
     p.add_argument("--lift-levels", type=int, default=None,
                    help="binary-lifting depth of the fixpoint climb "
                         "(0 = auto; tpu and tpu-bigv backends)")
@@ -384,6 +394,7 @@ def _run(parser, args) -> int:
             ("--tail-overlap", args.tail_overlap),
             ("--stale-reuse", args.stale_reuse),
             ("--dispatch-batch", args.dispatch_batch),
+            ("--inflight", args.inflight),
             ("--lift-levels", args.lift_levels),
             ("--jumps", args.jumps),
             ("--hoist-bytes", args.hoist_bytes),
@@ -580,6 +591,15 @@ def _run(parser, args) -> int:
                              "on device; it excludes --carry-tail/"
                              "--tail-overlap")
             ctor["dispatch_batch"] = args.dispatch_batch
+        if args.inflight is not None:
+            if args.inflight < 0:
+                parser.error("--inflight must be >= 0 (0 = auto)")
+            if args.inflight > 1 and (args.carry_tail or
+                                      args.tail_overlap):
+                parser.error("--inflight > 1 pipelines whole batched "
+                             "executions; it excludes --carry-tail/"
+                             "--tail-overlap")
+            ctor["inflight"] = args.inflight
         if args.lift_levels is not None:
             if args.lift_levels < 0:
                 parser.error("--lift-levels must be >= 0")

@@ -509,6 +509,30 @@ def fold_segments_batch_pos(
                                   batch_rounds=batch_rounds)
 
 
+@partial(jax.jit, static_argnames=("n", "lift_levels", "descent",
+                                   "batch_rounds"), donate_argnums=(0, 1, 2))
+def fold_segments_batch_pos_donated(
+    P: jax.Array,
+    loB: jax.Array,
+    hiB: jax.Array,
+    n: int,
+    lift_levels: int = 0,
+    descent: str = "auto",
+    batch_rounds: int = 0,
+):
+    """:func:`fold_segments_batch_pos` with the carried table and the
+    [N, C] staging blocks DONATED: XLA reuses their HBM buffers for the
+    execution's outputs instead of allocating a second copy of each,
+    so a chain of executions holds one table + one staging block per
+    in-flight execution rather than two (ISSUE 4 tentpole;
+    utils/membudget.build_phase_bytes models the credit). Inputs are
+    INVALIDATED by the call — only for callers that rebind, like the
+    re-dispatch loops here."""
+    return batch_segment_fixpoint(P, loB, hiB, n, lift_levels=lift_levels,
+                                  descent=descent,
+                                  batch_rounds=batch_rounds)
+
+
 @partial(jax.jit, static_argnames=("n",))
 def orient_chunks_batch_pos(chunks: jax.Array, pos: jax.Array, n: int):
     """(N, C, 2) stacked padded chunks -> oriented POSITION blocks
@@ -517,6 +541,37 @@ def orient_chunks_batch_pos(chunks: jax.Array, pos: jax.Array, n: int):
     per-chunk padding tail) orient to the inert (n, n), which is the
     per-segment live mask: a fully-inert row converges in one round."""
     return jax.vmap(lambda c: orient_edges_pos(c, pos, n))(chunks)
+
+
+
+def _resolve_batch_rounds(batch_rounds: int, segment_rounds: int,
+                          N: int) -> int:
+    """Per-execution round budget of the batched dispatch: default
+    ``segment_rounds * N`` (the allowance the per-segment driver would
+    spread over N syncs). Every execution restarts the segment cursor
+    at 0, and each already-converged segment still costs one
+    confirmation round: a per-execution budget below N can stall the
+    cursor at the same prefix forever and silently return an
+    unconverged forest at the max_rounds backstop — clamp so one
+    execution can always cross the whole block."""
+    if batch_rounds <= 0:
+        batch_rounds = max(1, segment_rounds) * max(N, 1)
+    return max(batch_rounds, max(N, 1))
+
+
+def _t_ms(stats: dict, key: str, dt_s: float) -> None:
+    """Accumulate a millisecond counter UNROUNDED (same rule as t_add:
+    consumers round at read time so sums never drift past the wall)."""
+    stats[key] = stats.get(key, 0.0) + dt_s * 1e3
+
+
+def _seed_ms_counters(stats: dict) -> None:
+    """Pre-seed the overlap counters so every driver run emits both —
+    a fold that converges before its second execution would otherwise
+    never touch ``device_gap_ms``, and the bench contract / regression
+    gate treat a missing field as incomparable rather than zero."""
+    stats.setdefault("host_blocked_ms", 0.0)
+    stats.setdefault("device_gap_ms", 0.0)
 
 
 def fold_segments_batch(
@@ -530,49 +585,260 @@ def fold_segments_batch(
     batch_rounds: int = 0,
     max_rounds: int = 1 << 20,
     stats=None,
+    donate: bool = False,
 ):
-    """Host driver of the batched dispatch: loop bounded
-    :func:`fold_segments_batch_pos` executions until every staged
-    segment reports done — ONE packed-stats pull per EXECUTION instead
-    of per segment. The default per-execution round budget is
-    ``segment_rounds * N`` (the same round allowance the per-segment
-    driver would spread over N syncs), so the host sync count drops by
-    ~N while no single device execution runs longer than N bounded
-    segments back to back (the watchdog envelope scales with the staged
-    batch, not with the stream). Returns ``(P, total_rounds)``."""
-    N = int(loB.shape[0])
-    if batch_rounds <= 0:
-        batch_rounds = max(1, segment_rounds) * max(N, 1)
-    # every execution restarts the segment cursor at 0, and each
-    # already-converged segment still costs one confirmation round: a
-    # per-execution budget below N can stall the cursor at the same
-    # prefix forever and silently return an unconverged forest at the
-    # max_rounds backstop — clamp so one execution can always cross the
-    # whole block
-    batch_rounds = max(batch_rounds, max(N, 1))
+    """SYNCHRONOUS host driver of the batched dispatch over ONE staged
+    block: loop bounded :func:`fold_segments_batch_pos` executions
+    until every staged segment reports done — ONE packed-stats pull
+    per EXECUTION instead of per segment. The default per-execution
+    round budget is ``segment_rounds * N`` (see
+    :func:`_resolve_batch_rounds`), so the host sync count drops by ~N
+    while no single device execution runs longer than N bounded
+    segments back to back (the watchdog envelope scales with the
+    staged batch, not with the stream). Returns ``(P, total_rounds)``.
+
+    ``donate`` runs the donated program
+    (:func:`fold_segments_batch_pos_donated`): the caller's P/loB/hiB
+    are INVALIDATED.
+
+    Implemented as :func:`fold_segments_pipelined` at depth 1 over the
+    single block — the pipelined driver's documented degenerate mode
+    (same executions in the same order, pinned by
+    tests/test_inflight.py) — so there is exactly one dispatch loop to
+    maintain. ``host_blocked_ms``/``device_gap_ms`` quantify the
+    alternation tax deeper pipelines remove; on the max_rounds
+    backstop, ``batch_incomplete_segments`` flags the undrained block
+    (key presence is the contract)."""
+    return fold_segments_pipelined(
+        P, iter([(loB, hiB)]), n, inflight=1, lift_levels=lift_levels,
+        segment_rounds=segment_rounds, descent=descent,
+        batch_rounds=batch_rounds, max_rounds=max_rounds, donate=donate,
+        stats=stats)
+
+
+# ---------------------------------------------------------------------------
+# asynchronous in-flight dispatch pipeline (ISSUE 4 tentpole). The batched
+# driver above is still a synchronous lockstep: stage -> execute ->
+# BLOCKING packed-stats pull -> decide -> stage next, so the device idles
+# through every host read/orient/pad and the host idles through every
+# device program. JAX arrays are futures, so the pull is the only forced
+# sync — this driver keeps a bounded FIFO (depth D) of issued executions
+# whose stats words stay un-pulled, chains each new execution on the
+# previous one's (async) output table, and converts sv to host ints
+# one-behind. Buffers are donated along the chain, so the staged blocks
+# and the carried table are REUSED across executions instead of doubling
+# peak HBM (fold_segments_batch_pos_donated).
+#
+# Speculation + bit-identity: a new staged group is issued assuming the
+# executions ahead of it drain their blocks (the common case — the
+# per-execution round budget covers the whole block). When a pulled sv
+# reveals an execution did NOT drain (budget exhaustion), its leftover
+# blocks are re-queued and re-dispatched on the CURRENT chain table;
+# that re-orders constraint resolution but cannot change the result,
+# because the elimination fixpoint is the unique forest of the inserted
+# constraint multiset, independent of fold order (the PR-1 argument, now
+# applied across groups instead of within one). At stream end the driver
+# speculates the other way — "the last blocks have NOT converged" — and
+# issues their re-dispatch before pulling; if the pull says converged,
+# the speculative executions are DISCARDED: their svs are never read
+# (zero extra syncs) and their output table is the bit-identical
+# re-confirmation of the converged one (drained blocks are all-sentinel;
+# re-entry re-confirms each row in one round and leaves the table
+# untouched), so adopting it IS resuming from the last confirmed carry.
+# ---------------------------------------------------------------------------
+
+def fold_segments_pipelined(
+    P: jax.Array,
+    staged,
+    n: int,
+    inflight: int = 2,
+    lift_levels: int = 0,
+    segment_rounds: int = 2,
+    descent: str = "auto",
+    batch_rounds: int = 0,
+    max_rounds: int = 1 << 20,
+    donate: bool = True,
+    stats=None,
+    on_confirm=None,
+    on_flush=None,
+):
+    """Fold a stream of staged [N, C] oriented position blocks with up
+    to ``inflight`` device executions in flight (see the block comment
+    above for the speculation/discard model).
+
+    ``staged`` yields ``(loB, hiB)`` or ``(loB, hiB, tag)`` blocks
+    (:func:`orient_chunks_batch_pos`); blocks are consumed (donated when
+    ``donate``). ``on_confirm(tag, rounds, P)`` fires after each stats
+    pull — ``tag`` is the staged group's tag for the first execution of
+    a group and None for re-dispatches — with the CURRENT chain-tip
+    table (an async jax array valid until the next execution is issued;
+    read it immediately, do not store it). A truthy return from
+    ``on_confirm`` requests a FLUSH BARRIER: the driver stops consuming
+    new groups, drains everything already issued (including leftover
+    re-dispatches) to completion, then calls ``on_flush(P)`` with a
+    table that provably contains the full constraint multiset of every
+    confirmed group — the only place a checkpoint cut is sound, because
+    mid-pipeline the tip table can UNDER-represent a confirmed group
+    whose budget-exhausted leftovers are still queued host-side.
+    Returns ``(P, total_rounds)``; ``inflight=1`` degenerates to the
+    synchronous execute/pull/decide loop (same executions in the same
+    order as :func:`fold_segments_batch` over the group sequence).
+
+    Counters (all absorbed by the obs tracer at span boundaries and
+    emitted as bench contract fields): ``host_blocked_ms`` = wall spent
+    inside blocking sv pulls; ``device_gap_ms`` = wall from a pull that
+    EMPTIED the in-flight queue to the next execution's dispatch (the
+    device provably idles through exactly those windows; with D >= 2
+    the queue rarely empties and the counter collapses toward 0);
+    ``inflight_discards`` = speculative executions whose sv was never
+    read. ``max_rounds`` is a backstop, not an exact cap: in-flight
+    executions are drained and counted when it trips, and
+    ``batch_incomplete_segments`` then reports the staged BLOCKS known
+    undrained — a LOWER BOUND: the unconsumed remainder of the stream
+    is never staged (counting it would force its H2D uploads), so the
+    flag's presence, not its magnitude, is the incompleteness
+    contract (as in :func:`fold_segments_batch`)."""
+    from collections import deque
+
+    if inflight < 1:
+        raise ValueError("inflight must be >= 1")
     if stats is None:
         stats = {}
+    _seed_ms_counters(stats)
+    stats.setdefault("inflight_discards", 0)
+    fold = fold_segments_batch_pos_donated if donate \
+        else fold_segments_batch_pos
+    fifo: deque = deque()       # issued, un-pulled executions, FIFO
+    leftovers: deque = deque()  # blocks of partially-drained executions
+    it = iter(staged)
+    t_start = time.perf_counter()
+
+    def pull_group():
+        try:
+            return next(it)
+        except StopIteration:
+            return None
+
+    state = {"tipP": P.astype(jnp.int32), "tip": None, "idle_since": None,
+             "flushing": False}
+    nxt = pull_group()
     total = 0
-    while True:
-        t0 = time.perf_counter()
-        loB, hiB, P, sv = fold_segments_batch_pos(
-            P, loB, hiB, n, lift_levels=lift_levels, descent=descent,
-            batch_rounds=batch_rounds)
-        done, r, live, retired = (int(x) for x in np.asarray(sv))
+
+    def issue(loB, hiB, kind, tag):
+        now = time.perf_counter()
+        if state["idle_since"] is not None:
+            _t_ms(stats, "device_gap_ms", now - state["idle_since"])
+            state["idle_since"] = None
+        N = int(loB.shape[0])
+        lo2, hi2, P2, sv = fold(
+            state["tipP"], loB, hiB, n, lift_levels=lift_levels,
+            descent=descent,
+            batch_rounds=_resolve_batch_rounds(batch_rounds,
+                                               segment_rounds, N))
+        state["tipP"] = P2
+        rec = {"lo": lo2, "hi": hi2, "sv": sv, "kind": kind, "tag": tag,
+               "N": N}
+        state["tip"] = rec
+        fifo.append(rec)
+
+    def confirm(rec):
+        """Blocking pull of one execution's stats word; returns done."""
+        nonlocal total
+        t_pull = time.perf_counter()
+        done, r, live, retired = (int(x) for x in np.asarray(rec["sv"]))
+        now = time.perf_counter()
+        _t_ms(stats, "host_blocked_ms", now - t_pull)
         stats["host_syncs"] = stats.get("host_syncs", 0) + 1
         stats["batch_execs"] = stats.get("batch_execs", 0) + 1
         stats["batch_retired"] = stats.get("batch_retired", 0) + retired
         stats["device_rounds"] = stats.get("device_rounds", 0) + r
-        stats["t_batch_s"] = stats.get("t_batch_s", 0.0) + \
-            (time.perf_counter() - t0)
         total += r
-        if done >= N:
-            return P, total
+        if not fifo:
+            # nothing left in flight: the device finished this execution
+            # no later than the pull completed and idles until the next
+            # dispatch
+            state["idle_since"] = now
+        drained = done >= rec["N"]
+        if drained:
+            # any speculative re-dispatches of these (now known-drained)
+            # blocks are bit-identical re-confirmations: discard them —
+            # never read their svs — and let the chain tip (their
+            # output) stand in for the confirmed carry
+            while fifo and fifo[0]["kind"] == "spec":
+                fifo.popleft()
+                stats["inflight_discards"] = \
+                    stats.get("inflight_discards", 0) + 1
+            if not fifo:
+                state["idle_since"] = time.perf_counter()
+        elif not (fifo and fifo[0]["kind"] == "spec"):
+            # budget exhausted and no speculative continuation already
+            # in flight: the leftover constraints live in this
+            # execution's output blocks — re-queue them (re-dispatching
+            # on the current chain table is sound: the fixpoint is
+            # order-independent in the constraint multiset)
+            leftovers.append((rec["lo"], rec["hi"]))
+        if on_confirm is not None:
+            if on_confirm(rec["tag"] if rec["kind"] == "group" else None,
+                          r, state["tipP"]):
+                state["flushing"] = True
+        return drained
+
+    while True:
+        while len(fifo) < inflight:
+            if leftovers:
+                lo, hi = leftovers.popleft()
+                issue(lo, hi, "left", None)
+            elif state["flushing"]:
+                # flush barrier: no new groups, no speculation — only
+                # drain what is already in flight
+                break
+            elif nxt is not None:
+                lo, hi = nxt[0], nxt[1]
+                tag = nxt[2] if len(nxt) > 2 else None
+                # dispatch the staged group BEFORE pulling the next one:
+                # pull_group() can block on the producer's read/pad
+                # (prefetch queue empty on IO-bound streams), and the
+                # device should be folding through that wall, not
+                # waiting behind it
+                issue(lo, hi, "group", tag)
+                nxt = pull_group()
+            elif fifo:
+                # stream drained, queue not full: speculate the newest
+                # execution does NOT finish its blocks and issue its
+                # re-dispatch now (discarded unread if it did)
+                tip = state["tip"]
+                issue(tip["lo"], tip["hi"], "spec", None)
+            else:
+                break
+        if not fifo:
+            if state["flushing"]:
+                # fully drained (the fill loop always re-issues
+                # leftovers before this point): every confirmed group's
+                # constraints are in the tip table — the sound cut
+                state["flushing"] = False
+                if on_flush is not None:
+                    on_flush(state["tipP"])
+                if nxt is not None:
+                    continue
+            break
+        confirm(fifo.popleft())
         if total >= max_rounds:
-            # never exit silently with unfolded segments: the caller's
-            # diagnostics must distinguish this from convergence
-            stats["batch_incomplete_segments"] = N - done
-            return P, total
+            # backstop: drain what is already in flight (those rounds
+            # ran — counting them keeps the stats honest), then report
+            # the undrained remainder instead of exiting silently. A
+            # flush barrier requested during this drain is deliberately
+            # DROPPED: with leftovers pending there is no sound cut to
+            # save, and the run is returning incomplete (and flagged)
+            # anyway — resume simply redoes from the previous barrier
+            while fifo:
+                confirm(fifo.popleft())
+            pending = len(leftovers) + (1 if nxt is not None else 0)
+            if pending:
+                stats["batch_incomplete_segments"] = pending
+            break
+    stats["t_batch_s"] = stats.get("t_batch_s", 0.0) + \
+        (time.perf_counter() - t_start)
+    return state["tipP"], total
 
 
 # ---------------------------------------------------------------------------
@@ -983,6 +1249,7 @@ def _fold_adaptive_pos_impl(
     use_host_tail = host_tail and native.available() and pos_host is not None
     if stats is None:
         stats = {}
+    _seed_ms_counters(stats)
     total = 0
     size = int(loP.shape[0])
     if host_tail_threshold <= 0:
@@ -1006,8 +1273,17 @@ def _fold_adaptive_pos_impl(
         # measured wall on fast machines
         stats[key] = stats.get(key, 0.0) + dt
 
+    prev_ready = None  # when the previous segment's sv pull completed
     while True:
         t0 = time.perf_counter()
+        if prev_ready is not None:
+            # host decision window between a stats pull and the next
+            # fixpoint dispatch — an upper bound on device idle (the
+            # rare dedup compactions dispatch device work inside it).
+            # This driver is synchronous by design (its host decisions
+            # need the stats); the in-flight batched pipeline is what
+            # removes the window
+            _t_ms(stats, "device_gap_ms", t0 - prev_ready)
         if warm and size > small_size:
             wrounds, wlevels = warm.pop(0)
             seg = min(wrounds, max_rounds - total)
@@ -1058,7 +1334,10 @@ def _fold_adaptive_pos_impl(
         # run rarely — a per-segment distinct count would cost a
         # full-buffer two-key sort every segment (measured: seconds at
         # C=2^24 on the v5e, swamping the rounds it saved)
+        t_pull = time.perf_counter()
         changed, r, live = (int(x) for x in np.asarray(sv))
+        prev_ready = time.perf_counter()
+        _t_ms(stats, "host_blocked_ms", prev_ready - t_pull)
         # dispatch-count attribution: one host->device SYNC per segment
         # is this driver's cost shape (each sv pull is a full link
         # round-trip); the batched dispatch (fold_segments_batch) exists

@@ -700,22 +700,29 @@ class BigVPipeline:
             start = state.chunk_idx if state else 0
             deg_sh = self.deg_zeros()
             since = nb = 0
-            for batch in batches(start):
-                deg_sh = self.deg_step(deg_sh, self._put(
-                    self.batch_sharding, batch))
-                since += 1
-                nb += 1
-                maybe_fail("degrees", nb)
-                obs.chunk_progress(nb * d, cs, m_cheap)
-                at_ckpt = (checkpointer is not None and
-                           checkpointer.due_span((nb - 1) * d, nb * d))
-                if since >= flush_every or at_ckpt:
-                    deg_local += self._local_block(deg_sh).astype(deg_local.dtype)
-                    deg_sh = self.deg_zeros()
-                    since = 0
-                if at_ckpt:
-                    checkpointer.save("degrees", start + nb * d,
-                                      {"deg_local": deg_local}, meta)
+            pf = batches(start)
+            try:
+                for batch in pf:
+                    deg_sh = self.deg_step(deg_sh, self._put(
+                        self.batch_sharding, batch))
+                    since += 1
+                    nb += 1
+                    maybe_fail("degrees", nb)
+                    obs.chunk_progress(nb * d, cs, m_cheap)
+                    at_ckpt = (checkpointer is not None and
+                               checkpointer.due_span((nb - 1) * d, nb * d))
+                    if since >= flush_every or at_ckpt:
+                        deg_local += self._local_block(deg_sh).astype(
+                            deg_local.dtype)
+                        deg_sh = self.deg_zeros()
+                        since = 0
+                    if at_ckpt:
+                        checkpointer.save("degrees", start + nb * d,
+                                          {"deg_local": deg_local}, meta)
+            finally:
+                # deterministic prefetch-worker cancel on exception
+                # unwind (utils/prefetch.py close contract)
+                pf.close()
             deg_local += self._local_block(deg_sh).astype(deg_local.dtype)
             deg_sh = None  # free the block-sharded device accumulator
         deg_host = self._allgather_table(deg_local)[:n]
@@ -753,23 +760,29 @@ class BigVPipeline:
                 P_sh = self._shard_table(np.full(n + 1, n, np.int32))
                 start = 0
             nb = 0
-            for batch in batches(start):
-                seg_sp = obs.begin("segment", i=nb)
-                P_sh, rounds = self.build_step(
-                    P_sh, pos_sh, self._put(self.batch_sharding, batch),
-                    stats=build_stats)
-                total_rounds += rounds
-                nb += 1
-                stats_acc.absorb(build_stats)
-                seg_sp.end(rounds=int(rounds))
-                obs.chunk_progress(nb * d, cs, m_cheap)
-                maybe_fail("build", nb)
-                if checkpointer is not None and \
-                        checkpointer.due_span((nb - 1) * d, nb * d):
-                    checkpointer.save(
-                        "build", start + nb * d,
-                        {"deg_local": deg_local,
-                         "ptable_local": self._local_block(P_sh)}, meta)
+            pf = batches(start)
+            try:
+                for batch in pf:
+                    seg_sp = obs.begin("segment", i=nb)
+                    P_sh, rounds = self.build_step(
+                        P_sh, pos_sh,
+                        self._put(self.batch_sharding, batch),
+                        stats=build_stats)
+                    total_rounds += rounds
+                    nb += 1
+                    stats_acc.absorb(build_stats)
+                    seg_sp.end(rounds=int(rounds))
+                    obs.chunk_progress(nb * d, cs, m_cheap)
+                    maybe_fail("build", nb)
+                    if checkpointer is not None and \
+                            checkpointer.due_span((nb - 1) * d, nb * d):
+                        checkpointer.save(
+                            "build", start + nb * d,
+                            {"deg_local": deg_local,
+                             "ptable_local": self._local_block(P_sh)},
+                            meta)
+            finally:
+                pf.close()
         P_host = self._allgather_table(
             self._local_block(P_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
@@ -810,25 +823,31 @@ class BigVPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         nb = 0
-        for batch in batches(start):
-            c, tt = np.asarray(self.score_step(
-                self._put(self.batch_sharding, batch), assign_sh))
-            cut += int(c)
-            total += int(tt)
-            if comm_volume:
-                score_ops.accumulate_cv_keys(
-                    cv_chunks,
-                    score_ops.cut_pair_keys_host(batch, assign_np, n, k))
-            nb += 1
-            maybe_fail("score", nb)
-            obs.chunk_progress(nb * d, cs, m_cheap)
-            if checkpointer is not None and \
-                    checkpointer.due_span((nb - 1) * d, nb * d):
-                cv_chunks = ckpt.save_score_state(
-                    checkpointer, start + nb * d, cut, total, cv_chunks,
-                    {"deg_local": deg_local,
-                     "ptable_local": self._local_block(P_sh)}, meta,
-                    comm_volume)
+        pf = batches(start)
+        try:
+            for batch in pf:
+                c, tt = np.asarray(self.score_step(
+                    self._put(self.batch_sharding, batch), assign_sh))
+                cut += int(c)
+                total += int(tt)
+                if comm_volume:
+                    score_ops.accumulate_cv_keys(
+                        cv_chunks,
+                        score_ops.cut_pair_keys_host(batch, assign_np,
+                                                     n, k))
+                nb += 1
+                maybe_fail("score", nb)
+                obs.chunk_progress(nb * d, cs, m_cheap)
+                if checkpointer is not None and \
+                        checkpointer.due_span((nb - 1) * d, nb * d):
+                    cv_chunks = ckpt.save_score_state(
+                        checkpointer, start + nb * d, cut, total,
+                        cv_chunks,
+                        {"deg_local": deg_local,
+                         "ptable_local": self._local_block(P_sh)}, meta,
+                        comm_volume)
+        finally:
+            pf.close()
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

@@ -126,7 +126,8 @@ class ShardedPipeline:
 
     def __init__(self, n: int, chunk_edges: int, mesh, lift_levels: int = 0,
                  segment_rounds: int = 32, warm_schedule=((1, 8),),
-                 dispatch_batch: int = 1):
+                 dispatch_batch: int = 1, inflight: int = 1,
+                 donate: bool = False):
         self.n = n
         self.cs = chunk_edges
         self.mesh = mesh
@@ -139,6 +140,24 @@ class ShardedPipeline:
         # adaptive _fold_actives loop); the merged forest is the same
         # unique fixpoint either way.
         self.dispatch_batch = max(1, int(dispatch_batch))
+        # asynchronous dispatch pipeline depth for the batched path
+        # (ISSUE 4): keep up to D issued fold_batch_step executions in
+        # flight, speculatively re-dispatching the staged blocks before
+        # the replicated stats word is pulled, and read the words
+        # one-behind — every process runs the same deterministic driver
+        # on the same replicated stats, so the collective schedules
+        # stay in lockstep (speculative executions are collectives too,
+        # issued identically everywhere). Unneeded speculations are
+        # discarded unread; their output is the bit-identical
+        # re-confirmation of the drained blocks.
+        if inflight < 1:
+            raise ValueError("inflight must be >= 1 here (backends "
+                             "resolve 0 = auto before constructing)")
+        self.inflight = int(inflight)
+        # donate the per-device tables + staging blocks into each
+        # batched execution (ops/elim.py donation rationale); pure
+        # buffer aliasing, identical results
+        self.donate = bool(donate)
         # fixpoint rounds per device execution in the build phase; the
         # host loops bounded segments so no single accelerator call runs
         # unboundedly long (the TPU worker watchdog kills those)
@@ -400,7 +419,7 @@ class ShardedPipeline:
         self.score_step = score_step
 
         nb = self.dispatch_batch
-        if nb > 1:
+        if nb > 1 or self.inflight > 1:
             self.block_sharding = NamedSharding(
                 mesh, P(SHARD_AXIS, None, None))
             self.block_edges_sharding = NamedSharding(
@@ -427,15 +446,7 @@ class ShardedPipeline:
             # per-segment loop would spread over nb segment syncs
             br = max(1, seg_) * nb
 
-            @partial(jax.jit,
-                     in_shardings=(self.state_sharding,
-                                   self.block_sharding,
-                                   self.block_sharding),
-                     out_shardings=(self.state_sharding,
-                                    self.block_sharding,
-                                    self.block_sharding,
-                                    self.repl_sharding))
-            def fold_batch_step(P_all, loB_all, hiB_all):
+            def _fold_batch(P_all, loB_all, hiB_all):
                 def f(P_local, loB_local, hiB_local):
                     loB2, hiB2, Pn, sv = elim_ops.batch_segment_fixpoint(
                         P_local[0], loB_local[0], hiB_local[0], n_,
@@ -461,8 +472,18 @@ class ShardedPipeline:
                                P(SHARD_AXIS, None, None), P()))(
                         P_all, loB_all, hiB_all)
 
+            _shardings = dict(
+                in_shardings=(self.state_sharding, self.block_sharding,
+                              self.block_sharding),
+                out_shardings=(self.state_sharding, self.block_sharding,
+                               self.block_sharding, self.repl_sharding))
+
             self.orient_batch_step = orient_batch_step
-            self.fold_batch_step = fold_batch_step
+            self.fold_batch_step = jax.jit(_fold_batch, **_shardings)
+            # donated twin: per-device tables + staging blocks alias
+            # into the outputs (callers rebind, like the chain driver)
+            self.fold_batch_step_donated = jax.jit(
+                _fold_batch, donate_argnums=(0, 1, 2), **_shardings)
 
     SMALL_SIZE = 1 << 14
 
@@ -470,12 +491,65 @@ class ShardedPipeline:
         """Fold ``dispatch_batch`` staged sharded batches — a
         (D, N, C, 2) edge block — into the per-device forests with ONE
         replicated stats pull per bounded batched execution (vs one
-        ``changed`` pull per segment step in :meth:`build_step`)."""
+        ``changed`` pull per segment step in :meth:`build_step`).
+
+        With ``inflight`` > 1, up to that many executions run in flight:
+        each speculatively re-dispatches the previous one's output
+        blocks before its stats word is pulled (the not-yet-converged
+        assumption), and the words are read one-behind. When a pull
+        reveals the blocks had drained, the unread speculations are
+        discarded — their output is the bit-identical re-confirmation
+        of the drained state (all-sentinel rows re-confirm in one round
+        each and leave the tables untouched), so adopting the chain tip
+        IS resuming from the confirmed carry. Deterministic on the
+        replicated word, so every process issues and discards the same
+        executions and the collective schedules never skew.
+
+        Scope note: the speculation here is per-GROUP (this method
+        still drains before returning), so a group that converges in
+        its first execution pays one discarded re-confirm program — a
+        deliberate trade: the discard is N cheap all-sentinel rounds,
+        the hidden cost is the replicated sv pull's full link RTT (the
+        dominant per-group tax on the tunneled chips this targets).
+        Cross-group chaining as in the single-device
+        fold_segments_pipelined would need the lockstep run() loop
+        restructured around a shared chain — left for a future PR."""
+        import time
+
+        from collections import deque
+
+        from sheep_tpu.ops.elim import _seed_ms_counters, _t_ms
+
         loB, hiB = self.orient_batch_step(blocks_dev, pos)
+        fold = self.fold_batch_step_donated if self.donate \
+            else self.fold_batch_step
+        if stats is not None:
+            _seed_ms_counters(stats)
+        tip = (P_all, loB, hiB)
+        fifo: deque = deque()
+        idle_since = None
+
+        def issue():
+            nonlocal tip, idle_since
+            if idle_since is not None and stats is not None:
+                _t_ms(stats, "device_gap_ms",
+                      time.perf_counter() - idle_since)
+            idle_since = None
+            P2, lo2, hi2, sv = fold(*tip)
+            tip = (P2, lo2, hi2)
+            fifo.append(sv)
+
         while True:
-            P_all, loB, hiB, sv = self.fold_batch_step(P_all, loB, hiB)
+            while len(fifo) < self.inflight:
+                issue()
+            sv = fifo.popleft()
+            t_pull = time.perf_counter()
             done, r, live, ret = (int(x) for x in np.asarray(sv))
+            now = time.perf_counter()
+            if not fifo:
+                idle_since = now
             if stats is not None:
+                _t_ms(stats, "host_blocked_ms", now - t_pull)
                 stats["host_syncs"] = stats.get("host_syncs", 0) + 1
                 stats["batch_execs"] = stats.get("batch_execs", 0) + 1
                 stats["batch_retired"] = stats.get("batch_retired", 0) + ret
@@ -483,7 +557,11 @@ class ShardedPipeline:
                 stats["device_rounds"] = \
                     stats.get("device_rounds", 0) + r
             if done >= self.dispatch_batch:
-                return P_all
+                if fifo and stats is not None:
+                    stats["inflight_discards"] = \
+                        stats.get("inflight_discards", 0) + len(fifo)
+                fifo.clear()
+                return tip[0]
 
     def _fold_actives(self, P_all, lo_all, hi_all, skip_warm: bool = False):
         """Adaptive host-driven fold of (D, W) active-constraint buffers
@@ -669,7 +747,8 @@ class ShardedPipeline:
 
         root_sp = obs.begin("partition", backend="tpu-sharded", k=int(k),
                             n=int(n), devices=int(d),
-                            dispatch_batch=int(self.dispatch_batch))
+                            dispatch_batch=int(self.dispatch_batch),
+                            inflight=int(self.inflight))
         stats_acc = obs.stats_accumulator()
         merge_acc = obs.stats_accumulator()
         m_cheap = stream.num_edges_cheap
@@ -689,24 +768,32 @@ class ShardedPipeline:
             start = state.chunk_idx if state else 0
             deg_all = self.init_degrees()
             since = batches = 0
-            for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
-                deg_all = self.deg_step(deg_all, self.put_batch(batch))
-                since += 1
-                batches += 1
-                maybe_fail("degrees", batches)
-                obs.chunk_progress(batches * d, cs, m_cheap)
-                # cadence is in *chunks* (one batch = d chunks), matching
-                # the single-device backends and the --checkpoint-every doc
-                at_ckpt = (checkpointer is not None and
-                           checkpointer.due_span((batches - 1) * d, batches * d))
-                if since >= flush_every or at_ckpt:
-                    deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
-                                           dtype=np.int64)
-                    deg_all = self.init_degrees()
-                    since = 0
-                if at_ckpt:
-                    checkpointer.save("degrees", start + batches * d,
-                                      {"deg": deg_host}, meta)
+            pf = prefetch(self.iter_batches(stream, start_chunk=start))
+            try:
+                for batch in pf:
+                    deg_all = self.deg_step(deg_all, self.put_batch(batch))
+                    since += 1
+                    batches += 1
+                    maybe_fail("degrees", batches)
+                    obs.chunk_progress(batches * d, cs, m_cheap)
+                    # cadence is in *chunks* (one batch = d chunks),
+                    # matching the single-device backends and the
+                    # --checkpoint-every doc
+                    at_ckpt = (checkpointer is not None and
+                               checkpointer.due_span((batches - 1) * d,
+                                                     batches * d))
+                    if since >= flush_every or at_ckpt:
+                        deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
+                                               dtype=np.int64)
+                        deg_all = self.init_degrees()
+                        since = 0
+                    if at_ckpt:
+                        checkpointer.save("degrees", start + batches * d,
+                                          {"deg": deg_host}, meta)
+            finally:
+                # deterministic worker cancel on exception unwind, as in
+                # _device_chunk_groups (fault injection, checkpoint IO)
+                pf.close()
             deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
         # positions are ordinal: rank-compress if totals exceed int32
         if deg_host.size and deg_host.max() >= 2**31:
@@ -754,7 +841,7 @@ class ShardedPipeline:
                 P_all = self.init_forest()
                 start = 0
             batches = 0
-            if self.dispatch_batch > 1:
+            if self.dispatch_batch > 1 or self.inflight > 1:
                 # batched segment dispatch: stage dispatch_batch sharded
                 # batches as one (rows, N, C, 2) block per process —
                 # the prefetch worker groups the lockstep batch stream,
@@ -762,55 +849,68 @@ class ShardedPipeline:
                 # pmin'd stats keep the collective schedules aligned
                 nb = self.dispatch_batch
                 build_stats["dispatch_batch"] = nb
+                build_stats["inflight_depth"] = self.inflight
                 empty = None
-                for group in prefetch_batched(
-                        self.iter_batches(stream, start_chunk=start), nb):
-                    gl = len(group)
-                    if gl < nb:
-                        if empty is None:
-                            empty = np.full((self.n_local, cs, 2), n,
-                                            np.int32)
-                        group = group + [empty] * (nb - gl)
-                    blocks = np.stack(group, axis=1)
-                    before = batches
-                    dsp = obs.begin("dispatch", i=before, batches=gl)
-                    P_all = self.build_step_batch(
-                        P_all,
-                        self._put(self.block_edges_sharding, blocks),
-                        pos, stats=build_stats)
-                    batches += gl
-                    stats_acc.absorb(build_stats)
-                    dsp.end()
-                    obs.chunk_progress(batches * d, cs, m_cheap)
-                    for b in range(before + 1, batches + 1):
-                        maybe_fail("build", b)
-                    if checkpointer is not None and \
-                            checkpointer.due_span(before * d, batches * d):
-                        partial = np.asarray(self.to_minp(
-                            self.merge(P_all, stats=merge_stats), pos))
-                        checkpointer.save(
-                            "build", start + batches * d,
-                            {"deg": deg_host, "merged_partial": partial},
-                            meta)
+                # deterministic worker cancel on an exception unwind
+                # (fault injection, checkpoint IO): close instead of
+                # waiting for the GC backstop, as in _device_chunk_groups
+                pf = prefetch_batched(
+                    self.iter_batches(stream, start_chunk=start), nb)
+                try:
+                    for group in pf:
+                        gl = len(group)
+                        if gl < nb:
+                            if empty is None:
+                                empty = np.full((self.n_local, cs, 2), n,
+                                                np.int32)
+                            group = group + [empty] * (nb - gl)
+                        blocks = np.stack(group, axis=1)
+                        before = batches
+                        dsp = obs.begin("dispatch", i=before, batches=gl)
+                        P_all = self.build_step_batch(
+                            P_all,
+                            self._put(self.block_edges_sharding, blocks),
+                            pos, stats=build_stats)
+                        batches += gl
+                        stats_acc.absorb(build_stats)
+                        dsp.end()
+                        obs.chunk_progress(batches * d, cs, m_cheap)
+                        for b in range(before + 1, batches + 1):
+                            maybe_fail("build", b)
+                        if checkpointer is not None and \
+                                checkpointer.due_span(before * d, batches * d):
+                            partial = np.asarray(self.to_minp(
+                                self.merge(P_all, stats=merge_stats), pos))
+                            checkpointer.save(
+                                "build", start + batches * d,
+                                {"deg": deg_host, "merged_partial": partial},
+                                meta)
+                finally:
+                    pf.close()
             else:
-                for batch in prefetch(self.iter_batches(stream,
-                                                        start_chunk=start)):
-                    seg_sp = obs.begin("segment", i=batches)
-                    P_all = self.build_step(P_all, self.put_batch(batch),
-                                            pos)
-                    batches += 1
-                    seg_sp.end()
-                    obs.chunk_progress(batches * d, cs, m_cheap)
-                    maybe_fail("build", batches)
-                    if checkpointer is not None and \
-                            checkpointer.due_span((batches - 1) * d,
-                                                  batches * d):
-                        partial = np.asarray(self.to_minp(
-                            self.merge(P_all, stats=merge_stats), pos))
-                        checkpointer.save(
-                            "build", start + batches * d,
-                            {"deg": deg_host, "merged_partial": partial},
-                            meta)
+                pf = prefetch(self.iter_batches(stream,
+                                                start_chunk=start))
+                try:
+                    for batch in pf:
+                        seg_sp = obs.begin("segment", i=batches)
+                        P_all = self.build_step(P_all,
+                                                self.put_batch(batch), pos)
+                        batches += 1
+                        seg_sp.end()
+                        obs.chunk_progress(batches * d, cs, m_cheap)
+                        maybe_fail("build", batches)
+                        if checkpointer is not None and \
+                                checkpointer.due_span((batches - 1) * d,
+                                                      batches * d):
+                            partial = np.asarray(self.to_minp(
+                                self.merge(P_all, stats=merge_stats), pos))
+                            checkpointer.save(
+                                "build", start + batches * d,
+                                {"deg": deg_host,
+                                 "merged_partial": partial},
+                                meta)
+                finally:
+                    pf.close()
             msp = obs.begin("merge", devices=int(d))
             merged_minp = self.to_minp(
                 self.merge(P_all, stats=merge_stats), pos)
@@ -847,24 +947,31 @@ class ShardedPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         batches = 0
-        for batch in prefetch(self.iter_batches(stream, start_chunk=start)):
-            dev_batch = self.put_batch(batch)
-            c, tt = np.asarray(self.score_step(dev_batch, assign))
-            cut += int(c)
-            total += int(tt)
-            if comm_volume:
-                score_ops.accumulate_cv_keys(
-                    cv_chunks,
-                    score_ops.cut_pair_keys_host(batch, assign, n, k))
-            batches += 1
-            maybe_fail("score", batches)
-            obs.chunk_progress(batches * d, cs, m_cheap)
-            if checkpointer is not None and \
-                    checkpointer.due_span((batches - 1) * d, batches * d):
-                cv_chunks = ckpt.save_score_state(
-                    checkpointer, start + batches * d, cut, total, cv_chunks,
-                    {"deg": deg_host, "merged": np.asarray(merged_minp)}, meta,
-                    comm_volume)
+        pf = prefetch(self.iter_batches(stream, start_chunk=start))
+        try:
+            for batch in pf:
+                dev_batch = self.put_batch(batch)
+                c, tt = np.asarray(self.score_step(dev_batch, assign))
+                cut += int(c)
+                total += int(tt)
+                if comm_volume:
+                    score_ops.accumulate_cv_keys(
+                        cv_chunks,
+                        score_ops.cut_pair_keys_host(batch, assign, n, k))
+                batches += 1
+                maybe_fail("score", batches)
+                obs.chunk_progress(batches * d, cs, m_cheap)
+                if checkpointer is not None and \
+                        checkpointer.due_span((batches - 1) * d,
+                                              batches * d):
+                    cv_chunks = ckpt.save_score_state(
+                        checkpointer, start + batches * d, cut, total,
+                        cv_chunks,
+                        {"deg": deg_host,
+                         "merged": np.asarray(merged_minp)}, meta,
+                        comm_volume)
+        finally:
+            pf.close()
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

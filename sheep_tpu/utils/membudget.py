@@ -16,7 +16,8 @@ from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
 
 
 def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
-                      descent: str = "auto", dispatch_batch: int = 1) -> dict:
+                      descent: str = "auto", dispatch_batch: int = 1,
+                      inflight: int = 1, donate: bool = False) -> dict:
     """Estimated peak device bytes for one build_chunk_step.
 
     The displacement fixpoint (ops/elim.py fold_edges) keeps the carried
@@ -33,6 +34,14 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     device at once: the raw (N, C, 2) chunk stack plus the oriented
     [N, C] lo/hi blocks — the O(C) transient invariant becomes O(N*C),
     which is exactly what :func:`dispatch_batch_for` sizes N against.
+
+    ``inflight`` > 1 (the asynchronous dispatch pipeline,
+    ops/elim.py fold_segments_pipelined) keeps D issued executions'
+    staging blocks live at once — staging multiplies by D. ``donate``
+    (fold_segments_batch_pos_donated) lets XLA reuse the carried
+    table's and each staging block's buffers for the execution outputs
+    instead of double-buffering them across the call boundary — it
+    credits back one minp table and one staging block's oriented half.
     """
     if lift_levels <= 0:
         lift_levels = max(1, int(n).bit_length())
@@ -43,9 +52,24 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     lift_bytes = min(stack, EXACT_TABLE_BYTES) if descent == "exact" else table
     persistent = 4 * table  # pos, order, minp x2 (loop carry)
     transient = 6 * 4 * chunk_edges
-    # chunk stack (2C words/row) + oriented lo/hi blocks (2C words/row)
-    staging = 4 * 4 * chunk_edges * dispatch_batch if dispatch_batch > 1 \
-        else 0
+    # chunk stack (2C words/row) + oriented lo/hi blocks (2C words/row),
+    # held once per in-flight execution. The synchronous per-segment
+    # driver (dispatch_batch == 1, inflight == 1) stages nothing beyond
+    # the counted transients; the pipelined driver stages its [N, C]
+    # blocks even at N == 1 (inflight > 1 selects it)
+    staging_unit = 4 * 4 * chunk_edges * max(1, dispatch_batch) \
+        if dispatch_batch > 1 or inflight > 1 else 0
+    staging = staging_unit * max(1, inflight)
+    if donate and staging_unit:
+        # donated executions alias input buffers into outputs: one minp
+        # table (the cross-execution carry copy) and one oriented lo/hi
+        # block pair (half a staging unit) come back. Guarded on
+        # staging_unit: the synchronous per-segment configuration never
+        # runs a donating program, so crediting it there would
+        # under-reserve a full table no matter what flag a caller
+        # threads through
+        persistent -= table
+        staging -= staging_unit // 2
     total = persistent + transient + staging + lift_bytes
     return {
         "persistent_bytes": persistent,
@@ -58,16 +82,21 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
 
 
 def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
-                       cap: int = 16) -> int:
+                       cap: int = 16, inflight: int = 1,
+                       donate: bool = False) -> int:
     """Largest power-of-two dispatch batch N in [1, cap] whose staged
     build phase fits ``hbm_bytes`` — the ``--dispatch-batch 0`` (auto)
     sizing rule. Power-of-two N keeps the set of compiled batch-program
-    shapes logarithmic, like every other buffer-sizing rule here."""
+    shapes logarithmic, like every other buffer-sizing rule here.
+    ``inflight``/``donate`` thread the in-flight pipeline's staging
+    multiplier and the donation credit into the model, so a deeper
+    pipeline auto-sizes to a proportionally smaller N."""
     best = 1
     nb = 2
     while nb <= cap:
-        if build_phase_bytes(n, chunk_edges,
-                             dispatch_batch=nb)["total_bytes"] > hbm_bytes:
+        if build_phase_bytes(n, chunk_edges, dispatch_batch=nb,
+                             inflight=inflight,
+                             donate=donate)["total_bytes"] > hbm_bytes:
             break
         best = nb
         nb *= 2

@@ -42,6 +42,11 @@ def test_measure_multichip_leg_on_virtual_mesh(monkeypatch):
     for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est"):
         assert out[f] > 0, f
     assert out["host_syncs"] >= 0 and out["device_rounds"] > 0
+    # dispatch-overlap contract pair (ISSUE 4): on every measured row,
+    # so --inflight A/Bs and the bench_regress host_blocked_ms gate
+    # have their inputs even on cpu-jax windows
+    assert out["host_blocked_ms"] >= 0
+    assert out["device_gap_ms"] >= 0
     # the sharded path partitions the same counter-hash graph: its cut
     # must be in the same regime as the baselines (not degenerate)
     assert 0.0 < out["sharded_cut_ratio"] <= 1.0
@@ -65,3 +70,36 @@ def test_fallback_emits_null_vs_baseline():
     # normalizable after the fact
     for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est"):
         assert line[f] > 0, f
+    # the overlap counters ride the emitted line too (ISSUE 4)
+    for f in ("host_blocked_ms", "device_gap_ms"):
+        assert line[f] >= 0, f
+
+
+def test_skip_probe_short_circuits():
+    """SHEEP_SKIP_PROBE=1 must skip the (2 x 180 s on dead-tunnel
+    hosts) subprocess probe entirely and return the cpu fallback."""
+    import importlib
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        importlib.reload(bench)
+        calls = []
+        orig = bench._probe_accelerator_uncached
+        bench._probe_accelerator_uncached = \
+            lambda tries, timeout: calls.append(1) or "tpu"
+        try:
+            os.environ["SHEEP_SKIP_PROBE"] = "1"
+            assert bench.probe_accelerator() is None
+            assert calls == []
+            os.environ.pop("SHEEP_SKIP_PROBE")
+            # and without the skip, the verdict is cached per process
+            assert bench.probe_accelerator() == "tpu"
+            assert bench.probe_accelerator() == "tpu"
+            assert len(calls) == 1
+        finally:
+            bench._probe_accelerator_uncached = orig
+            bench._PROBE_CACHE.clear()
+            os.environ.pop("SHEEP_SKIP_PROBE", None)
+    finally:
+        sys.path.remove(REPO)

@@ -29,3 +29,37 @@ def test_single_chip_ceiling_is_2_29():
 def test_model_monotone_in_v_and_chunk():
     f = lambda v, c: build_phase_bytes(v, c)["total_bytes"]
     assert f(1 << 20, 1 << 16) < f(1 << 24, 1 << 16) < f(1 << 24, 1 << 20)
+
+
+def test_inflight_multiplies_staging_and_donation_credits_state():
+    """ISSUE 4 sizing: D in-flight executions hold D staging blocks;
+    donation aliases one minp table and one oriented block pair back."""
+    from sheep_tpu.utils.membudget import dispatch_batch_for
+
+    n, cs = 1 << 20, 1 << 16
+    one = build_phase_bytes(n, cs, dispatch_batch=4)
+    two = build_phase_bytes(n, cs, dispatch_batch=4, inflight=2)
+    three = build_phase_bytes(n, cs, dispatch_batch=4, inflight=3)
+    assert two["staging_bytes"] == 2 * one["staging_bytes"]
+    assert three["staging_bytes"] == 3 * one["staging_bytes"]
+    # the pipelined driver stages its blocks even at N == 1 (inflight
+    # alone selects it); only the fully synchronous path is staging-free
+    assert build_phase_bytes(n, cs, inflight=3)["staging_bytes"] == \
+        3 * 4 * 4 * cs
+    assert build_phase_bytes(n, cs)["staging_bytes"] == 0
+
+    table = 4 * (n + 1)
+    unit = one["staging_bytes"]
+    don = build_phase_bytes(n, cs, dispatch_batch=4, inflight=2,
+                            donate=True)
+    assert don["persistent_bytes"] == two["persistent_bytes"] - table
+    assert don["staging_bytes"] == two["staging_bytes"] - unit // 2
+    assert don["total_bytes"] < two["total_bytes"]
+
+    # auto-sizing: a deeper pipeline fits a smaller N in the same HBM,
+    # and donation buys some of it back
+    hbm = build_phase_bytes(n, cs, dispatch_batch=8)["total_bytes"]
+    assert dispatch_batch_for(hbm, n, cs) == 8
+    assert dispatch_batch_for(hbm, n, cs, inflight=2) < 8
+    assert dispatch_batch_for(hbm, n, cs, inflight=2, donate=True) >= \
+        dispatch_batch_for(hbm, n, cs, inflight=2)

@@ -1,6 +1,7 @@
 """Prefetch wrapper invariants (VERDICT r1 item 6) and the batched
 staging form used by the batched segment dispatch."""
 
+import threading
 import time
 
 import numpy as np
@@ -90,6 +91,68 @@ def test_batched_exception_propagates():
 def test_batched_validates_batch():
     with pytest.raises(ValueError):
         prefetch_batched(iter([1]), 0)
+
+
+def test_close_cancels_blocked_worker():
+    """A worker blocked on the full bounded queue must wake and exit on
+    close() — the in-flight pipeline's discard path abandons the stream
+    mid-iteration, and a forever-blocked worker thread would pin the
+    producer's file handle (ISSUE 4 satellite)."""
+    started = threading.Event()
+
+    def gen():
+        for i in range(10_000):
+            started.set()
+            yield i
+
+    pf = prefetch(gen(), depth=2)
+    started.wait(timeout=5)
+    assert next(pf) == 0
+    pf.close()
+    assert pf.closed
+    assert not pf._thread.is_alive(), "worker not joined by close()"
+
+
+def test_close_is_idempotent_and_ends_iteration():
+    pf = prefetch(iter(range(100)))
+    assert next(pf) == 0
+    pf.close()
+    pf.close()
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert list(pf) == []
+
+
+def test_close_after_exhaustion_is_clean():
+    pf = prefetch(iter(range(3)))
+    assert list(pf) == [0, 1, 2]
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_context_manager_closes():
+    with prefetch(iter(range(1000))) as pf:
+        assert next(pf) == 0
+    assert pf.closed
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_batched_close_cancels_worker():
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    pf = prefetch_batched(gen(), 4)
+    assert next(pf) == [0, 1, 2, 3]
+    pf.close()
+    assert not pf._thread.is_alive()
+    n_after_close = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n_after_close, "worker kept producing"
 
 
 def test_batched_overlap_stages_full_group():

@@ -250,6 +250,24 @@ def test_bench_regress_detects_dispatch_count_rise(tmp_path):
     assert bench_regress.main([new, old]) == 2
 
 
+def test_bench_regress_gates_host_blocked_ms(tmp_path):
+    """The dispatch-overlap contract field (ISSUE 4): host_blocked_ms
+    is gated higher-is-worse like host_syncs; device_gap_ms is
+    environmental (link-quality-coupled) and never gates."""
+    old = _write(tmp_path, "old.json",
+                 {**BASE, "host_blocked_ms": 100.0, "device_gap_ms": 10.0})
+    new = _write(tmp_path, "new.json",
+                 {**BASE, "host_blocked_ms": 200.0, "device_gap_ms": 10.0})
+    assert bench_regress.main([new, old]) == 2
+    drop = _write(tmp_path, "drop.json",
+                  {**BASE, "host_blocked_ms": 40.0, "device_gap_ms": 10.0})
+    assert bench_regress.main([drop, old]) == 0
+    gap = _write(tmp_path, "gap.json",
+                 {**BASE, "host_blocked_ms": 100.0,
+                  "device_gap_ms": 900.0})
+    assert bench_regress.main([gap, old]) == 0
+
+
 def test_bench_regress_rise_from_zero_is_gated(tmp_path):
     """old host_syncs == 0 has no relative change, but 0 -> 500 is a
     real scheduling regression and must not slip through the undefined

@@ -13,14 +13,19 @@ each compared only when present in BOTH captures:
 
     value, vs_baseline, r_colo_est    higher is better (relative drop
                                       beyond --threshold regresses)
-    host_syncs, device_rounds         lower is better (relative rise
-                                      beyond --threshold regresses —
+    host_syncs, device_rounds,        lower is better (relative rise
+    host_blocked_ms                   beyond --threshold regresses —
                                       dispatch counts are deterministic,
                                       so a rise is a real scheduling
-                                      change, not noise)
+                                      change, not noise; host_blocked_ms
+                                      is the dispatch pipeline's
+                                      host-stall wall, the quantity the
+                                      in-flight overlap exists to
+                                      shrink)
 
-Link-state fields (rtt_ms, h2d_mbs, d2h_mbs) are environmental and
-reported but never gated. Two captures whose ``metric`` strings differ
+Link-state fields (rtt_ms, h2d_mbs, d2h_mbs) and device_gap_ms (device
+idle between executions — collapses with pipelining but swings with
+link quality) are environmental and reported but never gated. Two captures whose ``metric`` strings differ
 (different RMAT scale or platform — e.g. a cpu-jax fallback row vs a
 real-chip row) are NOT comparable: the tool says so and exits 0 unless
 ``--force``, because a false regression alarm that fires on every
@@ -39,8 +44,13 @@ import os
 import sys
 
 HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
-LOWER_BETTER = ("host_syncs", "device_rounds")
-INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch")
+# host_blocked_ms is wall-derived (like value) and so can swing with
+# link quality within one platform — gated anyway per the contract: a
+# sustained rise is the dispatch pipeline regressing, and same-metric
+# comparison plus the threshold absorb ordinary swings
+LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms")
+INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
+             "inflight_depth", "inflight_discards", "device_gap_ms")
 
 
 def load_capture(path: str):

@@ -30,4 +30,20 @@ python tools/trace_report.py "$TRACE" --check > "$OUT/report.txt"
 grep -q "partition" "$OUT/report.txt"
 grep -q "heartbeats:" "$OUT/report.txt"
 
-echo "obs smoke OK: $TRACE"
+# second leg: the in-flight dispatch pipeline (ISSUE 4) through the tpu
+# backend on cpu-jax — the traced smoke must show a complete span tree
+# with the pipelined dispatch spans AND the overlap counters
+# (host_blocked_ms / device_gap_ms) flowing into the trace
+TRACE2="$OUT/trace_inflight.jsonl"
+rm -f "$TRACE2"
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input rmat:10:8:1 --k 4 --backend tpu \
+    --dispatch-batch 2 --inflight 2 --chunk-edges 1024 \
+    --trace "$TRACE2" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_inflight.json"
+python tools/trace_report.py "$TRACE2" --check > "$OUT/report_inflight.txt"
+grep -q "dispatch" "$OUT/report_inflight.txt"
+grep -q "host_blocked_ms" "$TRACE2"
+grep -q "inflight_depth" "$TRACE2"
+
+echo "obs smoke OK: $TRACE $TRACE2"
