@@ -1,0 +1,28 @@
+"""sheep_tpu.analysis — sheeplint: the JAX-hazard static analyzer and
+runtime sanitizer gate (ISSUE 6 tentpole).
+
+PRs 1-3 made the dispatch pipeline fast by leaning on invariants that
+nothing enforced: stats words must stay unread futures (one stray
+``int(x)`` on a device array reverts the in-flight pipeline to
+lockstep), donated tables must never be read after the call, the
+elimination fixpoint's order-independence argument requires fold
+kernels free of host-visible side effects, prefetch workers and spans
+must be released on every abandonment path, and thread-shared sinks
+must be written under their lock. This package turns those invariants
+into machine checks:
+
+- **static**: :func:`sheep_tpu.analysis.runner.lint_paths` runs five
+  AST rule classes (sync / donate / jit / resource / lock — see
+  ``rules.py``) over the package, with per-line pragma suppression
+  (``# sheeplint: <rule>-ok``) and a reviewed ratchet baseline
+  (``sheeplint_baseline.json``). CLI: ``tools/sheeplint.py`` /
+  the ``sheeplint`` console script.
+- **runtime**: :mod:`sheep_tpu.analysis.sanitize` arms (under
+  ``SHEEP_SANITIZE=1``) implicit device->host conversion traps +
+  ``jax.transfer_guard`` around the fold/dispatch paths, donation
+  poisoning checks, and tracer span-balance assertions at close.
+"""
+
+from sheep_tpu.analysis.core import (Finding, RULES,  # noqa: F401
+                                     load_baseline, write_baseline)
+from sheep_tpu.analysis.runner import lint_paths, lint_source  # noqa: F401

@@ -1,0 +1,107 @@
+"""sheeplint CLI (console script ``sheeplint``; also exposed as
+``tools/sheeplint.py``).
+
+Exit codes: 0 = no non-baselined findings, 1 = errors present,
+2 = warnings only, 3 = usage/internal error. ``--check`` is the gate
+spelling used by tier-1 (identical behavior, explicit intent)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from sheep_tpu.analysis.core import (RULES, SEVERITY_RANK, load_baseline,
+                                     write_baseline)
+from sheep_tpu.analysis.runner import lint_paths
+
+DEFAULT_BASELINE = "sheeplint_baseline.json"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sheeplint",
+        description="JAX-hazard static analyzer for the sheep-tpu "
+                    "dispatch pipeline's invariants (rules: "
+                    + ", ".join(sorted(RULES)) + ")")
+    p.add_argument("paths", nargs="*", default=["sheep_tpu", "tools"],
+                   help="files/directories to lint (default: "
+                        "sheep_tpu tools)")
+    p.add_argument("--check", action="store_true",
+                   help="gate mode: same lint, nonzero exit on any "
+                        "non-baselined finding (tier-1 spelling)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as a JSON array")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        "next to the current directory when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file (show everything)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline "
+                        "file and exit 0 (the ratchet reset; review "
+                        "the diff)")
+    p.add_argument("--rules", default=None, metavar="R1,R2",
+                   help="restrict to a comma-separated rule subset")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}: {RULES[rid]}")
+        return 0
+
+    # a mistyped/renamed path must fail loudly, not lint nothing and
+    # report the gate green
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"sheeplint: no such path: {p}", file=sys.stderr)
+            return 3
+        if os.path.isfile(p) and not p.endswith(".py"):
+            print(f"sheeplint: not a Python file: {p}", file=sys.stderr)
+            return 3
+
+    bl_path = args.baseline or DEFAULT_BASELINE
+    baseline = set() if (args.no_baseline or args.write_baseline) \
+        else load_baseline(bl_path)
+
+    try:
+        findings, baselined, parse_errors = lint_paths(args.paths, baseline)
+    except OSError as e:
+        print(f"sheeplint: {e}", file=sys.stderr)
+        return 3
+
+    if args.rules:
+        keep = {r.strip() for r in args.rules.split(",")}
+        # parse errors always survive the filter: an unparseable file
+        # is unchecked by EVERY rule, not clean under one
+        findings = [f for f in findings
+                    if f.rule in keep or f.rule == "parse"]
+
+    if args.write_baseline:
+        write_baseline(bl_path, findings)
+        print(f"sheeplint: wrote {len(findings)} finding(s) to {bl_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        note = f" ({baselined} baselined)" if baselined else ""
+        files = args.paths if isinstance(args.paths, list) else [args.paths]
+        print(f"sheeplint: {n_err} error(s), {n_warn} warning(s)"
+              f"{note} in {' '.join(files)}")
+
+    if not findings:
+        return 0
+    worst = max(SEVERITY_RANK.get(f.severity, 2) for f in findings)
+    return 1 if worst >= SEVERITY_RANK["error"] else 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""sheeplint core: findings, pragma suppression, baseline ratchet.
+
+A finding is (rule, severity, path, line, message). Suppression is
+two-tier, mirroring the clang-tidy/NOLINT workflow:
+
+- **pragma** — ``# sheeplint: <rule>-ok`` (or the blanket
+  ``# sheeplint: ok``) anywhere on the physical lines a flagged node
+  spans. Pragmas are the *reviewed whitelist*: at a legitimate sync
+  point the same annotation that silences the static rule documents
+  the design decision in place, and the runtime sanitizer's
+  ``sanitize.sync_ok()`` is its executable twin.
+- **baseline** — ``sheeplint_baseline.json``, a reviewed list of
+  known findings keyed by (rule, path, line). The gate passes at zero
+  *non-baselined* findings, so the check lands green on day one and
+  only ever ratchets: new violations fail, fixed ones are removed
+  from the file (``--write-baseline`` regenerates it).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import asdict, dataclass
+
+#: rule id -> one-line description (the catalog the CLI prints)
+RULES = {
+    "sync": "implicit device->host sync on a value flowing from a "
+            "jit'd call (int/float/bool/.item()/np.asarray/branch) "
+            "outside an annotated sync point",
+    "donate": "read of a buffer after it was passed at a donated "
+              "argument position (use-after-donate)",
+    "jit": "jit hygiene: jit construction inside a loop, non-tuple "
+           "static_argnums/static_argnames, Python branching on "
+           "traced values inside a jit'd function",
+    "resource": "resource balance: Prefetcher without a guaranteed "
+                "close, span begun without an end, counters mutated "
+                "outside a CounterRegistry",
+    "lock": "thread-shared attribute written outside the owning lock",
+}
+
+SEVERITY_RANK = {"error": 2, "warning": 1}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    def baseline_key(self) -> tuple:
+        return (self.rule, self.path, self.line)
+
+
+_PRAGMA_RE = re.compile(r"#\s*sheeplint:\s*([a-z-]+(?:\s*,\s*[a-z-]+)*)")
+
+
+def pragma_lines(source: str) -> dict:
+    """line number -> set of suppressed rule ids ("*" = all rules).
+
+    ``# sheeplint: sync-ok`` suppresses the sync rule on that line;
+    ``# sheeplint: ok`` suppresses every rule. Several rules may be
+    listed comma-separated (``# sheeplint: sync-ok, donate-ok``)."""
+    out: dict = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rules = set()
+        for tok in m.group(1).split(","):
+            tok = tok.strip()
+            if tok == "ok":
+                rules.add("*")
+            elif tok.endswith("-ok"):
+                rules.add(tok[:-3])
+        if rules:
+            out[i] = rules
+    return out
+
+
+def suppressed(finding: Finding, pragmas: dict, span: tuple) -> bool:
+    """True when any physical line of the flagged node (``span`` =
+    (lineno, end_lineno)) carries a pragma for this rule."""
+    lo, hi = span
+    for ln in range(lo, (hi or lo) + 1):
+        rules = pragmas.get(ln)
+        if rules and ("*" in rules or finding.rule in rules):
+            return True
+    return False
+
+
+def load_baseline(path: str) -> set:
+    """Baseline file -> set of (rule, path, line) keys. A missing file
+    is an empty baseline (the gate starts strict)."""
+    try:
+        with open(path) as fh:
+            entries = json.load(fh)
+    except FileNotFoundError:
+        return set()
+    return {(e["rule"], e["path"], int(e["line"])) for e in entries}
+
+
+def write_baseline(path: str, findings) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "line": f.line,
+          "message": f.message} for f in findings),
+        key=lambda e: (e["path"], e["line"], e["rule"]))
+    with open(path, "w") as fh:
+        json.dump(entries, fh, indent=1)
+        fh.write("\n")

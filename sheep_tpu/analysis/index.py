@@ -1,0 +1,129 @@
+"""Cross-file pre-pass: which names are jit'd, and which donate.
+
+The sync and donate rules need to know, at a call site, whether the
+callee (a) returns device arrays (its results are unread futures the
+host must not implicitly sync on) and (b) donates argument buffers
+(its inputs are poisoned by the call). Both facts live at the callee's
+DEFINITION — usually in another file — so the linter runs one indexing
+pass over every file first and shares the result with all rules.
+
+Indexed forms:
+
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` / ``@jit`` decorated
+  functions -> jit set (by bare name; call sites match on the terminal
+  attribute, so ``elim_ops.fold_segment_pos`` resolves).
+- ``name = jax.jit(f, ...)`` / ``self.attr = jax.jit(f, ...)``
+  assignments -> jit set (by target's terminal name).
+- any of the above carrying ``donate_argnums=(...)`` -> donating map
+  name -> tuple of donated positions. A callee whose name ends in
+  ``_donated`` is treated as donating even when its definition was not
+  seen (the package's naming convention for donating twins); unknown
+  positions poison every positional argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+DONATED_SUFFIX = "_donated"
+
+
+@dataclass
+class PackageIndex:
+    jit_names: set = field(default_factory=set)
+    donating: dict = field(default_factory=dict)  # name -> positions|None
+
+    def is_jit(self, name: str) -> bool:
+        return name in self.jit_names or self.is_donating(name)
+
+    def is_donating(self, name: str) -> bool:
+        return name in self.donating or name.endswith(DONATED_SUFFIX)
+
+    def donated_positions(self, name: str):
+        """Donated positional indices, or None for "all positionals"."""
+        return self.donating.get(name)
+
+
+def _terminal_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _jit_call_info(call: ast.Call):
+    """(is_jit_construction, donate_positions|None|()) for a Call node.
+
+    Recognizes ``jax.jit(...)``, bare ``jit(...)`` and
+    ``partial(jax.jit, ...)``; donate positions come from a literal
+    ``donate_argnums`` tuple/int when present (() = none seen)."""
+    fn = call.func
+    name = _terminal_name(fn)
+    is_jit = name == "jit"
+    if name == "partial" and call.args:
+        inner = _terminal_name(call.args[0])
+        if inner == "jit":
+            is_jit = True
+    if not is_jit:
+        return False, ()
+    donate = ()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            donate = _literal_positions(kw.value)
+    return True, donate
+
+
+def _literal_positions(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return None  # dynamic expression: positions unknown
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, index: PackageIndex):
+        self.index = index
+
+    def visit_FunctionDef(self, node):
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                is_jit, donate = _jit_call_info(dec)
+            elif _terminal_name(dec) == "jit":
+                is_jit, donate = True, ()
+            else:
+                continue
+            if is_jit:
+                self.index.jit_names.add(node.name)
+                if donate is None or donate:
+                    self.index.donating[node.name] = donate
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        if isinstance(node.value, ast.Call):
+            is_jit, donate = _jit_call_info(node.value)
+            if is_jit:
+                for tgt in node.targets:
+                    name = _terminal_name(tgt)
+                    if name:
+                        self.index.jit_names.add(name)
+                        if donate is None or donate:
+                            self.index.donating[name] = donate
+        self.generic_visit(node)
+
+
+def build_index(trees) -> PackageIndex:
+    """``trees`` = iterable of parsed ``ast.Module`` objects."""
+    index = PackageIndex()
+    v = _Indexer(index)
+    for tree in trees:
+        v.visit(tree)
+    return index

@@ -1,0 +1,72 @@
+"""sheeplint orchestration: collect files, build the cross-file index,
+run every rule, apply the baseline."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from sheep_tpu.analysis.core import Finding
+from sheep_tpu.analysis.index import build_index
+from sheep_tpu.analysis.rules import check_file
+
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+def collect_files(paths) -> list:
+    """Expand files/directories into a sorted list of .py paths."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths, baseline: set = frozenset()):
+    """Lint every .py file under ``paths``.
+
+    Returns ``(findings, baselined_count, parse_errors)``; findings
+    whose (rule, path, line) key is in ``baseline`` are filtered out
+    and counted separately. Paths in findings are kept as given (the
+    baseline is stable only when the tool runs from the repo root with
+    relative paths — which is how the gate invokes it)."""
+    files = collect_files(paths)
+    sources, trees, parse_errors = {}, {}, []
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            trees[path] = ast.parse(src, filename=path)
+            sources[path] = src
+        except SyntaxError as e:
+            parse_errors.append(Finding(
+                rule="parse", severity="error", path=path,
+                line=e.lineno or 0, message=f"syntax error: {e.msg}"))
+    index = build_index(trees.values())
+    findings, baselined = [], 0
+    for path in files:
+        if path not in trees:
+            continue
+        for f in check_file(path, sources[path], trees[path], index):
+            if f.baseline_key() in baseline:
+                baselined += 1
+            else:
+                findings.append(f)
+    return findings + parse_errors, baselined, parse_errors
+
+
+def lint_source(source: str, path: str = "<memory>",
+                extra_sources=()) -> list:
+    """Lint one in-memory module (the test-fixture entry point).
+    ``extra_sources`` are additional modules whose jit/donate
+    definitions should be visible to the index (cross-file flows)."""
+    tree = ast.parse(source, filename=path)
+    trees = [tree] + [ast.parse(s) for s in extra_sources]
+    index = build_index(trees)
+    return check_file(path, source, tree, index)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from sheep_tpu import obs
+from sheep_tpu.analysis import sanitize
 from sheep_tpu.backends.base import Partitioner, register
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
@@ -91,13 +92,12 @@ def _upload_chunks(stream, cs: int, n: int, start_chunk: int):
         for i in range(start_chunk, stream.num_device_chunks(cs)):
             yield dev(i, cs, n)
         return
-    pf = prefetch(pad_chunk(c, cs, n)
-                  for c in stream.chunks(cs, start_chunk=start_chunk))
-    try:
+    with prefetch(pad_chunk(c, cs, n)
+                  for c in stream.chunks(cs, start_chunk=start_chunk)) as pf:
+        # with-scope = the structural close the resource rule checks:
+        # a consumer abandoning this generator closes pf deterministically
         for padded in pf:
             yield jnp.asarray(padded)
-    finally:
-        pf.close()
 
 
 def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
@@ -237,18 +237,16 @@ def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
             yield [d]
         return
     if cache is None and getattr(stream, "device_chunk", None) is None:
-        pf = prefetch_batched(
-            (pad_chunk(c, cs, n)
-             for c in stream.chunks(cs, start_chunk=start_chunk)),
-            batch)
-        try:
+        # with-exit is the deterministic worker cancel on abandonment
+        # (the in-flight pipeline's discard/backstop paths close this
+        # generator mid-stream): drain + join instead of waiting for
+        # the GC
+        with prefetch_batched(
+                (pad_chunk(c, cs, n)
+                 for c in stream.chunks(cs, start_chunk=start_chunk)),
+                batch) as pf:
             for host_group in pf:
                 yield [jnp.asarray(p) for p in host_group]
-        finally:
-            # deterministic worker cancel on abandonment (the in-flight
-            # pipeline's discard/backstop paths close this generator
-            # mid-stream): drain + join instead of waiting for the GC
-            pf.close()
         return
     group: list = []
     for d in _device_chunks(stream, cs, n, cache, start_chunk):
@@ -442,28 +440,35 @@ class TpuBackend(Partitioner):
                 obs.chunk_progress(idx, cs, m_cheap)
                 at_ckpt = checkpointer is not None and checkpointer.due(idx - start)
                 if since_flush >= flush_every or at_ckpt:
-                    deg_host += np.asarray(deg[:n], dtype=np.int64)
+                    # designed flush sync: int32 device accumulator ->
+                    # int64 host totals
+                    deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
+                                           dtype=np.int64)
                     deg = degrees_ops.init_degrees(n)
                     since_flush = 0
                 if at_ckpt:
                     checkpointer.save("degrees", idx, {"deg": deg_host}, meta)
-            deg_host += np.asarray(deg[:n], dtype=np.int64)
+            deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
+                                   dtype=np.int64)
         t["degrees"] = time.perf_counter() - t0
         sp.end()
 
         t0 = time.perf_counter()
-        sp = obs.begin("sort")
-        # positions are int32 ranks; degree values only matter ordinally, so
-        # clip the int64 totals into int32 for the device sort via rankdata
-        deg_rank = deg_host if deg_host.size == 0 or deg_host.max() < 2**31 \
-            else np.argsort(np.argsort(deg_host, kind="stable"), kind="stable")
-        deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
-        pos, order = order_ops.elimination_order(deg_dev, n)
-        # tiny host pull as the completion barrier: block_until_ready is
-        # not a real barrier on a tunneled device (BASELINE.md fact 3)
-        np.asarray(pos[:1])
-        t["sort"] = time.perf_counter() - t0
-        sp.end()
+        with obs.span("sort"):
+            # positions are int32 ranks; degree values only matter
+            # ordinally, so clip the int64 totals into int32 for the
+            # device sort via rankdata
+            deg_rank = deg_host \
+                if deg_host.size == 0 or deg_host.max() < 2**31 \
+                else np.argsort(np.argsort(deg_host, kind="stable"),
+                                kind="stable")
+            deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
+            pos, order = order_ops.elimination_order(deg_dev, n)
+            # tiny host pull as the completion barrier: block_until_ready
+            # is not a real barrier on a tunneled device (BASELINE.md
+            # fact 3)
+            np.asarray(pos[:1])  # sheeplint: sync-ok
+            t["sort"] = time.perf_counter() - t0
         pos_host_cache = None
 
         t0 = time.perf_counter()
@@ -492,7 +497,7 @@ class TpuBackend(Partitioner):
                 P = jnp.full(n + 1, n, dtype=jnp.int32)
                 start = 0
             idx = start
-            pos_host_cache = np.asarray(pos[:n])  # host tail reuses it
+            pos_host_cache = np.asarray(pos[:n])  # sheeplint: sync-ok
             tail_at = self.host_tail_threshold
             if tail_at < 0:
                 tail_at = cs // 2 if jax.default_backend() != "cpu" else 0
@@ -593,10 +598,12 @@ class TpuBackend(Partitioner):
                         # pipeline fully drained: idx (advanced through
                         # every group confirmed during the drain) and
                         # the table now agree exactly
-                        checkpointer.save(
-                            "build", idx,
-                            {"deg": deg_host,
-                             "minp": np.asarray(tipP[pos])}, meta)
+                        with sanitize.sync_ok("flush-checkpoint"):
+                            checkpointer.save(
+                                "build", idx,
+                                {"deg": deg_host,
+                                 "minp": np.asarray(tipP[pos])},  # sheeplint: sync-ok
+                                meta)
 
                     staged = staged_groups()
                     try:
@@ -681,24 +688,25 @@ class TpuBackend(Partitioner):
                     pos_host=pos_host_cache, stats=build_stats)
                 total_rounds += int(rounds)
             minp = P[pos]
-            np.asarray(minp[:1])  # real completion barrier (see above)
+            # real completion barrier (see above)
+            np.asarray(minp[:1])  # sheeplint: sync-ok
         t["build"] = time.perf_counter() - t0
         stats_acc.absorb(build_stats)
         sp.end(fixpoint_rounds=int(total_rounds))
 
         t0 = time.perf_counter()
-        sp = obs.begin("split")
-        parent = elim_ops.minp_to_parent(minp, order, n)
-        pos_host = pos_host_cache if pos_host_cache is not None \
-            else np.asarray(pos[:n])
-        w = deg_host.astype(np.float64) if weights == "degree" else None
-        assign_host = split_ops.tree_split_host(parent, pos_host, k, weights=w,
-                                                alpha=self.alpha)
-        assign = jnp.concatenate(
-            [jnp.asarray(assign_host, dtype=jnp.int32),
-             jnp.zeros(1, dtype=jnp.int32)])
-        t["split"] = time.perf_counter() - t0
-        sp.end()
+        with obs.span("split"):
+            parent = elim_ops.minp_to_parent(minp, order, n)
+            pos_host = pos_host_cache if pos_host_cache is not None \
+                else np.asarray(pos[:n])  # sheeplint: sync-ok
+            w = deg_host.astype(np.float64) if weights == "degree" else None
+            assign_host = split_ops.tree_split_host(parent, pos_host, k,
+                                                    weights=w,
+                                                    alpha=self.alpha)
+            assign = jnp.concatenate(
+                [jnp.asarray(assign_host, dtype=jnp.int32),
+                 jnp.zeros(1, dtype=jnp.int32)])
+            t["split"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         sp = obs.begin("score")
@@ -715,8 +723,9 @@ class TpuBackend(Partitioner):
         idx = start
         for padded in _device_chunks(stream, cs, n, cache, start):
             c, tt = score_ops.score_chunk(padded, assign, n)
-            cut += int(c)
-            total += int(tt)
+            # designed per-chunk score pull (two scalars, one chunk)
+            cut += int(c)  # sheeplint: sync-ok
+            total += int(tt)  # sheeplint: sync-ok
             if comm_volume:
                 score_ops.accumulate_cv_keys(
                     cv_chunks,

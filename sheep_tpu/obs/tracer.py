@@ -143,6 +143,8 @@ class Span:
         self.parent = tr._current_id()
         self.id = tr._next_id()
         self._snap = tr.counters.snapshot()
+        with tr._balance_lock:  # spans may start on worker threads
+            tr._open_spans += 1
         tr._push(self.id)
         tr.emit("span_start", span=self.name, id=self.id,
                 parent=self.parent, **self.attrs)
@@ -155,6 +157,8 @@ class Span:
         self._done = True
         tr = self._tracer
         secs = time.perf_counter() - self._t0
+        with tr._balance_lock:
+            tr._open_spans -= 1
         tr._pop(self.id)
         fields = dict(span=self.name, id=self.id, parent=self.parent,
                       secs=round(secs, 6), **self.attrs)
@@ -213,6 +217,8 @@ class Tracer:
         self._local = threading.local()
         self._ids = itertools.count(1)
         self._closed = False
+        self._open_spans = 0  # begun minus ended, across all threads
+        self._balance_lock = threading.Lock()
 
     # -- events ------------------------------------------------------------
     def emit(self, event: str, **fields) -> None:
@@ -254,12 +260,27 @@ class Tracer:
     def close(self) -> None:
         """Flush the final counter totals (one ``counters`` event — the
         queryable end-state tools read without re-deriving span deltas)
-        and close the sink."""
+        and close the sink.
+
+        Under ``SHEEP_SANITIZE=1`` a nonzero open-span count here
+        raises: an unbalanced span at a CLEAN close is a leaked handle
+        (the deliberate unbalanced-on-death case never reaches close,
+        so the forensic value of unclosed spans is untouched)."""
         if self._closed:
             return
         self._closed = True
         if self.counters:
             self.emit("counters", **self.counters.snapshot())
+        open_spans = self._open_spans
+        if open_spans:
+            from sheep_tpu.analysis import sanitize
+
+            if sanitize.enabled():
+                self._mw.close()
+                raise sanitize.SanitizeError(
+                    f"tracer closed with {open_spans} span(s) begun "
+                    f"but never ended — a leaked span handle (run "
+                    f"tools/trace_report.py on the trace to see which)")
         self._mw.close()
 
     def __enter__(self) -> "Tracer":

@@ -82,6 +82,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from sheep_tpu.analysis import sanitize
+
 NO_PARENT = -1
 
 
@@ -730,11 +732,20 @@ def fold_segments_pipelined(
             _t_ms(stats, "device_gap_ms", now - state["idle_since"])
             state["idle_since"] = None
         N = int(loB.shape[0])
+        prevP = state["tipP"]
         lo2, hi2, P2, sv = fold(
-            state["tipP"], loB, hiB, n, lift_levels=lift_levels,
+            prevP, loB, hiB, n, lift_levels=lift_levels,
             descent=descent,
             batch_rounds=_resolve_batch_rounds(batch_rounds,
                                                segment_rounds, N))
+        if donate:
+            # SHEEP_SANITIZE: the chained inputs must really be
+            # poisoned — a silently ignored donation doubles HBM and
+            # leaves use-after-donate bugs latent. Touches only
+            # is_deleted metadata, never the dead buffers' contents:
+            sanitize.check_donated(
+                prevP, loB, hiB,  # sheeplint: donate-ok
+                origin="fold_segments_batch_pos_donated")
         state["tipP"] = P2
         rec = {"lo": lo2, "hi": hi2, "sv": sv, "kind": kind, "tag": tag,
                "N": N}
@@ -745,7 +756,11 @@ def fold_segments_pipelined(
         """Blocking pull of one execution's stats word; returns done."""
         nonlocal total
         t_pull = time.perf_counter()
-        done, r, live, retired = (int(x) for x in np.asarray(rec["sv"]))
+        # the ONE designed sync of the pipeline: the one-behind packed
+        # stats pull (everything else stays an unread future)
+        with sanitize.sync_ok("pipelined-sv-pull"):
+            done, r, live, retired = \
+                (int(x) for x in np.asarray(rec["sv"]))  # sheeplint: sync-ok
         now = time.perf_counter()
         _t_ms(stats, "host_blocked_ms", now - t_pull)
         stats["host_syncs"] = stats.get("host_syncs", 0) + 1
@@ -783,59 +798,67 @@ def fold_segments_pipelined(
                 state["flushing"] = True
         return drained
 
-    while True:
-        while len(fifo) < inflight:
-            if leftovers:
-                lo, hi = leftovers.popleft()
-                issue(lo, hi, "left", None)
-            elif state["flushing"]:
-                # flush barrier: no new groups, no speculation — only
-                # drain what is already in flight
+    # SHEEP_SANITIZE: arm the stray-sync traps for the whole dispatch
+    # chain — between the annotated pulls, every device value must
+    # stay an unread future (one stray int()/bool() here silently
+    # reverts the pipeline to lockstep; the sanitizer makes it raise)
+    with sanitize.guard("dispatch"):
+        while True:
+            while len(fifo) < inflight:
+                if leftovers:
+                    lo, hi = leftovers.popleft()
+                    issue(lo, hi, "left", None)
+                elif state["flushing"]:
+                    # flush barrier: no new groups, no speculation —
+                    # only drain what is already in flight
+                    break
+                elif nxt is not None:
+                    lo, hi = nxt[0], nxt[1]
+                    tag = nxt[2] if len(nxt) > 2 else None
+                    # dispatch the staged group BEFORE pulling the next
+                    # one: pull_group() can block on the producer's
+                    # read/pad (prefetch queue empty on IO-bound
+                    # streams), and the device should be folding
+                    # through that wall, not waiting behind it
+                    issue(lo, hi, "group", tag)
+                    nxt = pull_group()
+                elif fifo:
+                    # stream drained, queue not full: speculate the
+                    # newest execution does NOT finish its blocks and
+                    # issue its re-dispatch now (discarded unread if
+                    # it did)
+                    tip = state["tip"]
+                    issue(tip["lo"], tip["hi"], "spec", None)
+                else:
+                    break
+            if not fifo:
+                if state["flushing"]:
+                    # fully drained (the fill loop always re-issues
+                    # leftovers before this point): every confirmed
+                    # group's constraints are in the tip table — the
+                    # sound cut
+                    state["flushing"] = False
+                    if on_flush is not None:
+                        on_flush(state["tipP"])
+                    if nxt is not None:
+                        continue
                 break
-            elif nxt is not None:
-                lo, hi = nxt[0], nxt[1]
-                tag = nxt[2] if len(nxt) > 2 else None
-                # dispatch the staged group BEFORE pulling the next one:
-                # pull_group() can block on the producer's read/pad
-                # (prefetch queue empty on IO-bound streams), and the
-                # device should be folding through that wall, not
-                # waiting behind it
-                issue(lo, hi, "group", tag)
-                nxt = pull_group()
-            elif fifo:
-                # stream drained, queue not full: speculate the newest
-                # execution does NOT finish its blocks and issue its
-                # re-dispatch now (discarded unread if it did)
-                tip = state["tip"]
-                issue(tip["lo"], tip["hi"], "spec", None)
-            else:
+            confirm(fifo.popleft())
+            if total >= max_rounds:
+                # backstop: drain what is already in flight (those
+                # rounds ran — counting them keeps the stats honest),
+                # then report the undrained remainder instead of
+                # exiting silently. A flush barrier requested during
+                # this drain is deliberately DROPPED: with leftovers
+                # pending there is no sound cut to save, and the run
+                # is returning incomplete (and flagged) anyway —
+                # resume simply redoes from the previous barrier
+                while fifo:
+                    confirm(fifo.popleft())
+                pending = len(leftovers) + (1 if nxt is not None else 0)
+                if pending:
+                    stats["batch_incomplete_segments"] = pending
                 break
-        if not fifo:
-            if state["flushing"]:
-                # fully drained (the fill loop always re-issues
-                # leftovers before this point): every confirmed group's
-                # constraints are in the tip table — the sound cut
-                state["flushing"] = False
-                if on_flush is not None:
-                    on_flush(state["tipP"])
-                if nxt is not None:
-                    continue
-            break
-        confirm(fifo.popleft())
-        if total >= max_rounds:
-            # backstop: drain what is already in flight (those rounds
-            # ran — counting them keeps the stats honest), then report
-            # the undrained remainder instead of exiting silently. A
-            # flush barrier requested during this drain is deliberately
-            # DROPPED: with leftovers pending there is no sound cut to
-            # save, and the run is returning incomplete (and flagged)
-            # anyway — resume simply redoes from the previous barrier
-            while fifo:
-                confirm(fifo.popleft())
-            pending = len(leftovers) + (1 if nxt is not None else 0)
-            if pending:
-                stats["batch_incomplete_segments"] = pending
-            break
     stats["t_batch_s"] = stats.get("t_batch_s", 0.0) + \
         (time.perf_counter() - t_start)
     return state["tipP"], total
@@ -1081,8 +1104,11 @@ def _host_tail_finish_pos(P, loP, hiP, n: int, size: int, pos_host):
     from sheep_tpu.core import native
 
     clo, chi = compact_actives(loP, hiP, n, size, dedup=True)
-    lo_np = np.asarray(clo)
-    hi_np = np.asarray(chi)
+    # designed host-tail handoff: the compacted live constraints and
+    # the O(V) table cross to the host exactly once per tail
+    with sanitize.sync_ok("host-tail-pull"):
+        lo_np = np.asarray(clo)  # sheeplint: sync-ok
+        hi_np = np.asarray(chi)  # sheeplint: sync-ok
     mask = lo_np != n
     pos_host = np.asarray(pos_host)
     order_host = _order_host(pos_host, n)
@@ -1202,7 +1228,16 @@ class TailOverlap:
         return pad_actives_pow2(dlo, dhi, self.n)
 
 
-def _fold_adaptive_pos_impl(
+def _fold_adaptive_pos_impl(*args, **kwargs):
+    """:func:`_fold_adaptive_pos_impl_body` under the SHEEP_SANITIZE
+    stray-sync guard: the adaptive driver's only designed host reads
+    are the per-segment packed sv pull and the host-tail handoff —
+    any other implicit device->host conversion in the loop raises."""
+    with sanitize.guard("adaptive-fold"):
+        return _fold_adaptive_pos_impl_body(*args, **kwargs)
+
+
+def _fold_adaptive_pos_impl_body(
     P: jax.Array,
     loP: jax.Array,
     hiP: jax.Array,
@@ -1335,7 +1370,9 @@ def _fold_adaptive_pos_impl(
         # full-buffer two-key sort every segment (measured: seconds at
         # C=2^24 on the v5e, swamping the rounds it saved)
         t_pull = time.perf_counter()
-        changed, r, live = (int(x) for x in np.asarray(sv))
+        with sanitize.sync_ok("adaptive-sv-pull"):
+            changed, r, live = \
+                (int(x) for x in np.asarray(sv))  # sheeplint: sync-ok
         prev_ready = time.perf_counter()
         _t_ms(stats, "host_blocked_ms", prev_ready - t_pull)
         # dispatch-count attribution: one host->device SYNC per segment
@@ -1506,7 +1543,7 @@ def fold_edges_adaptive(
     if host_tail and pos_host is None and native.available():
         # only pulled when a host tail can actually run — this is an
         # O(V) d2h transfer (~1 s at V=4M through the tunnel)
-        pos_host = np.asarray(pos[:n])
+        pos_host = np.asarray(pos[:n])  # sheeplint: sync-ok
     P, total = fold_edges_adaptive_pos(
         minp[order], pos[lo], pos[hi], n, lift_levels=lift_levels,
         segment_rounds=segment_rounds, descent=descent,
@@ -1535,19 +1572,24 @@ def fold_edges_segmented(
     per ``segment_rounds`` rounds. ``on_segment(total_rounds)`` is called
     after each segment (progress/diagnostics hook)."""
     total = 0
-    while True:
-        # never run past max_rounds: the tail segment shrinks to the
-        # remaining budget so the result matches fold_edges(max_rounds=...)
-        # exactly (one extra compile at most, for the tail size)
-        seg = min(segment_rounds, max_rounds - total)
-        lo, hi, minp, changed, r = fold_edges_segment(
-            minp, lo, hi, pos, order, n, lift_levels=lift_levels,
-            segment_rounds=seg, descent=descent)
-        total += int(r)
-        if on_segment is not None:
-            on_segment(total)
-        if not bool(changed) or total >= max_rounds:
-            return minp, total
+    with sanitize.guard("segmented-fold"):
+        while True:
+            # never run past max_rounds: the tail segment shrinks to
+            # the remaining budget so the result matches
+            # fold_edges(max_rounds=...) exactly (one extra compile at
+            # most, for the tail size)
+            seg = min(segment_rounds, max_rounds - total)
+            lo, hi, minp, changed, r = fold_edges_segment(
+                minp, lo, hi, pos, order, n, lift_levels=lift_levels,
+                segment_rounds=seg, descent=descent)
+            # the designed per-segment control pull of this driver
+            with sanitize.sync_ok("segmented-pull"):
+                total += int(r)  # sheeplint: sync-ok
+                done = not bool(changed)  # sheeplint: sync-ok
+            if on_segment is not None:
+                on_segment(total)
+            if done or total >= max_rounds:
+                return minp, total
 
 
 def elim_fixpoint(

@@ -362,8 +362,11 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
                 rows = jnp.concatenate([rows, jnp.zeros(pad, rows.dtype)])
             b, bv, cur = hist_stats(hist[:vb], rows)
             span = min(vb, n + 1 - base)
-            best_h[base:base + span] = np.asarray(b)[:span]
-            gain_h[base:base + span] = np.asarray(bv - cur)[:span]
+            # designed per-block gain pull of the host-planned refine
+            best_h[base:base + span] = \
+                np.asarray(b)[:span]  # sheeplint: sync-ok
+            gain_h[base:base + span] = \
+                np.asarray(bv - cur)[:span]  # sheeplint: sync-ok
         return jnp.asarray(best_h), jnp.asarray(gain_h), None
 
     def plan(b, g, a_try, parity):
@@ -425,4 +428,4 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
         b, g, _ = gains(a_try)
         a_try = plan(b, g, a_try, 1)
     stats["refine_cut_after"] = best_cut
-    return np.asarray(best[:n]), stats
+    return np.asarray(best[:n]), stats  # sheeplint: sync-ok

@@ -118,12 +118,13 @@ def cut_pair_keys_host(chunk, assign, n: int, k: int):
         cap = _compact_cap(2 * c.shape[0])
         if cap < 2 * c.shape[0]:
             compact, count = cut_pair_rows_compact(c, assign, n, cap)
-            if int(count) <= cap:
-                rows = np.asarray(compact)
+            # designed pulls: this helper IS the host accumulation step
+            if int(count) <= cap:  # sheeplint: sync-ok
+                rows = np.asarray(compact)  # sheeplint: sync-ok
                 rows = rows[rows[:, 0] < n]
                 rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
                 continue
-        rows = np.asarray(cut_pairs(c, assign, n))
+        rows = np.asarray(cut_pairs(c, assign, n))  # sheeplint: sync-ok
         rows = rows[rows[:, 0] < n]
         rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
     return np.concatenate(rows_all) if rows_all else np.zeros(0, np.int64)

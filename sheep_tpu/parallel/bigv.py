@@ -72,6 +72,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheep_tpu import obs
+from sheep_tpu.analysis import sanitize
 from sheep_tpu.ops.elim import pow2_at_least
 from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
@@ -540,47 +541,53 @@ class BigVPipeline:
         stats["collective_bytes"] = stats.get("collective_bytes", 0) \
             + 4 * 4 * self.n_devices * size
         total = 0
-        while True:
-            # bulk: stream-descent lifting (few rounds, +V squaring
-            # words/round); tail: many-jump rounds (no V-term at all)
-            lift = size > self.TAIL_Q
-            if lift:
-                key = (self.lift_levels, self.hoist_levels)
-                fold = self._fold_lift_cache.get(key)
-                if fold is None:
-                    fold = self._fold_lift_cache[key] = \
-                        self._make_fold_lift_hoisted(
-                            self.lift_levels, self.hoist_levels) \
-                        if self.hoist_levels else \
-                        self._make_fold_lift(self.lift_levels)
-                jumps = 0
-            else:
-                jumps = self.jumps
-                fold = self._fold_seg_cache.get(jumps)
-                if fold is None:
-                    fold = self._fold_seg_cache[jumps] = \
-                        self._make_fold_seg(jumps)
-            P_sh, lo_a, hi_a, live, r, max_live = fold(P_sh, lo_a, hi_a)
-            r = int(r)
-            total += r
-            ops, byts = self._round_cost(size, jumps, lift)
-            seg_ops, seg_bytes = self._segment_cost(lift)
-            stats["collective_ops"] += ops * r + seg_ops
-            stats["collective_bytes"] += byts * r + seg_bytes
-            stats["q_rounds"] = stats.get("q_rounds", 0) + size * r
-            if int(live) == 0 or total >= self.max_rounds:
-                return P_sh, total
-            ml = int(max_live)
-            if size > self.MIN_Q and ml <= size // 2:
-                new_size = pow2_at_least(2 * ml, floor=self.MIN_Q)
-                if new_size < size:
-                    fn = self._compact_cache.get(new_size)
-                    if fn is None:
-                        fn = self._compact_cache[new_size] = \
-                            self._make_compact(new_size)
-                    lo_a, hi_a = fn(lo_a, hi_a)
-                    size = new_size
-                    stats["compactions"] = stats.get("compactions", 0) + 1
+        # SHEEP_SANITIZE: stray-sync traps around the routed fold loop
+        # (the designed pulls below are the only host reads)
+        with sanitize.guard("bigv-fold"):
+            while True:
+                # bulk: stream-descent lifting (few rounds, +V squaring
+                # words/round); tail: many-jump rounds (no V-term at all)
+                lift = size > self.TAIL_Q
+                if lift:
+                    key = (self.lift_levels, self.hoist_levels)
+                    fold = self._fold_lift_cache.get(key)
+                    if fold is None:
+                        fold = self._fold_lift_cache[key] = \
+                            self._make_fold_lift_hoisted(
+                                self.lift_levels, self.hoist_levels) \
+                            if self.hoist_levels else \
+                            self._make_fold_lift(self.lift_levels)
+                    jumps = 0
+                else:
+                    jumps = self.jumps
+                    fold = self._fold_seg_cache.get(jumps)
+                    if fold is None:
+                        fold = self._fold_seg_cache[jumps] = \
+                            self._make_fold_seg(jumps)
+                P_sh, lo_a, hi_a, live, r, max_live = fold(P_sh, lo_a, hi_a)
+                # the designed per-segment replicated pull of this driver
+                with sanitize.sync_ok("bigv-segment-pull"):
+                    r = int(r)  # sheeplint: sync-ok
+                    live_i = int(live)  # sheeplint: sync-ok
+                    ml = int(max_live)  # sheeplint: sync-ok
+                total += r
+                ops, byts = self._round_cost(size, jumps, lift)
+                seg_ops, seg_bytes = self._segment_cost(lift)
+                stats["collective_ops"] += ops * r + seg_ops
+                stats["collective_bytes"] += byts * r + seg_bytes
+                stats["q_rounds"] = stats.get("q_rounds", 0) + size * r
+                if live_i == 0 or total >= self.max_rounds:
+                    return P_sh, total
+                if size > self.MIN_Q and ml <= size // 2:
+                    new_size = pow2_at_least(2 * ml, floor=self.MIN_Q)
+                    if new_size < size:
+                        fn = self._compact_cache.get(new_size)
+                        if fn is None:
+                            fn = self._compact_cache[new_size] = \
+                                self._make_compact(new_size)
+                        lo_a, hi_a = fn(lo_a, hi_a)
+                        size = new_size
+                        stats["compactions"] = stats.get("compactions", 0) + 1
 
     # ---- host-side helpers ----------------------------------------------
     def _put(self, sharding, arr: np.ndarray):
@@ -700,8 +707,9 @@ class BigVPipeline:
             start = state.chunk_idx if state else 0
             deg_sh = self.deg_zeros()
             since = nb = 0
-            pf = batches(start)
-            try:
+            # with-exit = deterministic prefetch-worker cancel on
+            # exception unwind (utils/prefetch.py close contract)
+            with batches(start) as pf:
                 for batch in pf:
                     deg_sh = self.deg_step(deg_sh, self._put(
                         self.batch_sharding, batch))
@@ -719,10 +727,6 @@ class BigVPipeline:
                     if at_ckpt:
                         checkpointer.save("degrees", start + nb * d,
                                           {"deg_local": deg_local}, meta)
-            finally:
-                # deterministic prefetch-worker cancel on exception
-                # unwind (utils/prefetch.py close contract)
-                pf.close()
             deg_local += self._local_block(deg_sh).astype(deg_local.dtype)
             deg_sh = None  # free the block-sharded device accumulator
         deg_host = self._allgather_table(deg_local)[:n]
@@ -760,8 +764,7 @@ class BigVPipeline:
                 P_sh = self._shard_table(np.full(n + 1, n, np.int32))
                 start = 0
             nb = 0
-            pf = batches(start)
-            try:
+            with batches(start) as pf:
                 for batch in pf:
                     seg_sp = obs.begin("segment", i=nb)
                     P_sh, rounds = self.build_step(
@@ -781,8 +784,6 @@ class BigVPipeline:
                             {"deg_local": deg_local,
                              "ptable_local": self._local_block(P_sh)},
                             meta)
-            finally:
-                pf.close()
         P_host = self._allgather_table(
             self._local_block(P_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
@@ -823,10 +824,10 @@ class BigVPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         nb = 0
-        pf = batches(start)
-        try:
+        with batches(start) as pf:
             for batch in pf:
-                c, tt = np.asarray(self.score_step(
+                # designed per-batch score pull (two scalars)
+                c, tt = np.asarray(self.score_step(  # sheeplint: sync-ok
                     self._put(self.batch_sharding, batch), assign_sh))
                 cut += int(c)
                 total += int(tt)
@@ -846,8 +847,6 @@ class BigVPipeline:
                         {"deg_local": deg_local,
                          "ptable_local": self._local_block(P_sh)}, meta,
                         comm_volume)
-        finally:
-            pf.close()
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

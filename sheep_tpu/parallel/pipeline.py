@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheep_tpu import obs
+from sheep_tpu.analysis import sanitize
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
@@ -535,33 +536,50 @@ class ShardedPipeline:
                 _t_ms(stats, "device_gap_ms",
                       time.perf_counter() - idle_since)
             idle_since = None
-            P2, lo2, hi2, sv = fold(*tip)
+            prev = tip
+            P2, lo2, hi2, sv = fold(*prev)
+            if self.donate:
+                # SHEEP_SANITIZE: the chained per-device tables and
+                # staging blocks must really be poisoned (metadata-only
+                # is_deleted probe, never the dead buffers' contents)
+                sanitize.check_donated(
+                    *prev,  # sheeplint: donate-ok
+                    origin="fold_batch_step_donated")
             tip = (P2, lo2, hi2)
             fifo.append(sv)
 
-        while True:
-            while len(fifo) < self.inflight:
-                issue()
-            sv = fifo.popleft()
-            t_pull = time.perf_counter()
-            done, r, live, ret = (int(x) for x in np.asarray(sv))
-            now = time.perf_counter()
-            if not fifo:
-                idle_since = now
-            if stats is not None:
-                _t_ms(stats, "host_blocked_ms", now - t_pull)
-                stats["host_syncs"] = stats.get("host_syncs", 0) + 1
-                stats["batch_execs"] = stats.get("batch_execs", 0) + 1
-                stats["batch_retired"] = stats.get("batch_retired", 0) + ret
-                # max over devices: the lockstep wall is the slowest one
-                stats["device_rounds"] = \
-                    stats.get("device_rounds", 0) + r
-            if done >= self.dispatch_batch:
-                if fifo and stats is not None:
-                    stats["inflight_discards"] = \
-                        stats.get("inflight_discards", 0) + len(fifo)
-                fifo.clear()
-                return tip[0]
+        # SHEEP_SANITIZE: between the one-behind replicated word pulls
+        # every device value must stay an unread future — a stray sync
+        # here would also skew the multi-process collective schedules
+        with sanitize.guard("sharded-dispatch"):
+            while True:
+                while len(fifo) < self.inflight:
+                    issue()
+                sv = fifo.popleft()
+                t_pull = time.perf_counter()
+                with sanitize.sync_ok("sharded-sv-pull"):
+                    done, r, live, ret = \
+                        (int(x) for x in np.asarray(sv))  # sheeplint: sync-ok
+                now = time.perf_counter()
+                if not fifo:
+                    idle_since = now
+                if stats is not None:
+                    _t_ms(stats, "host_blocked_ms", now - t_pull)
+                    stats["host_syncs"] = stats.get("host_syncs", 0) + 1
+                    stats["batch_execs"] = \
+                        stats.get("batch_execs", 0) + 1
+                    stats["batch_retired"] = \
+                        stats.get("batch_retired", 0) + ret
+                    # max over devices: the lockstep wall is the
+                    # slowest one
+                    stats["device_rounds"] = \
+                        stats.get("device_rounds", 0) + r
+                if done >= self.dispatch_batch:
+                    if fifo and stats is not None:
+                        stats["inflight_discards"] = \
+                            stats.get("inflight_discards", 0) + len(fifo)
+                    fifo.clear()
+                    return tip[0]
 
     def _fold_actives(self, P_all, lo_all, hi_all, skip_warm: bool = False):
         """Adaptive host-driven fold of (D, W) active-constraint buffers
@@ -577,21 +595,26 @@ class ShardedPipeline:
         by the caller, go straight to the resolved schedule."""
         size = int(lo_all.shape[-1])
         warm = [] if skip_warm else list(self._fold_warm)
-        while True:
-            if warm and size > self.SMALL_SIZE:
-                step = warm.pop(0)
-            elif size <= self.SMALL_SIZE:
-                step = self._fold_small
-            else:
-                step = self._fold_full
-            P_all, lo_all, hi_all, changed, max_live = step(
-                P_all, lo_all, hi_all)
-            if not int(changed):
-                return P_all
-            live = int(max_live)
-            if size > self.SMALL_SIZE and live <= size // 4:
-                lo_all, hi_all, size = self._compact_to(lo_all, hi_all,
-                                                        live, size)
+        with sanitize.guard("sharded-fold"):
+            while True:
+                if warm and size > self.SMALL_SIZE:
+                    step = warm.pop(0)
+                elif size <= self.SMALL_SIZE:
+                    step = self._fold_small
+                else:
+                    step = self._fold_full
+                P_all, lo_all, hi_all, changed, max_live = step(
+                    P_all, lo_all, hi_all)
+                # the designed per-segment lockstep pull: one
+                # replicated (changed, live) pair per bounded segment
+                with sanitize.sync_ok("sharded-segment-pull"):
+                    done = not int(changed)  # sheeplint: sync-ok
+                    live = int(max_live)  # sheeplint: sync-ok
+                if done:
+                    return P_all
+                if size > self.SMALL_SIZE and live <= size // 4:
+                    lo_all, hi_all, size = self._compact_to(
+                        lo_all, hi_all, live, size)
 
     def _compact_to(self, lo_all, hi_all, live: int, size: int):
         """Compact (D, size) buffers to the cached power-of-2 program for
@@ -644,7 +667,9 @@ class ShardedPipeline:
         """
         cap0 = 0
         if self.rounds:
-            cnt = int(self.max_occupancy(P_all))
+            # one tiny designed all-reduce pull to pick compact vs dense
+            with sanitize.sync_ok("merge-occupancy"):
+                cnt = int(self.max_occupancy(P_all))  # sheeplint: sync-ok
             c = elim_ops.pow2_at_least(cnt, floor=1024)
             if 2 * c < self.n + 1:
                 cap0 = c
@@ -660,7 +685,8 @@ class ShardedPipeline:
             # full-width round to discover the live count, and skip the
             # chunk-oriented warm schedule (warm rounds earn their keep
             # on fresh C-width chunks, not on a boundary tail)
-            live = int(self._live_count(lo_all))
+            with sanitize.sync_ok("merge-live-count"):
+                live = int(self._live_count(lo_all))  # sheeplint: sync-ok
             if live == 0:
                 continue
             lo_all, hi_all, _ = self._compact_to(
@@ -768,8 +794,10 @@ class ShardedPipeline:
             start = state.chunk_idx if state else 0
             deg_all = self.init_degrees()
             since = batches = 0
-            pf = prefetch(self.iter_batches(stream, start_chunk=start))
-            try:
+            with prefetch(self.iter_batches(stream,
+                                            start_chunk=start)) as pf:
+                # with-exit = deterministic worker cancel on exception
+                # unwind (fault injection, checkpoint IO)
                 for batch in pf:
                     deg_all = self.deg_step(deg_all, self.put_batch(batch))
                     since += 1
@@ -783,18 +811,15 @@ class ShardedPipeline:
                                checkpointer.due_span((batches - 1) * d,
                                                      batches * d))
                     if since >= flush_every or at_ckpt:
-                        deg_host += np.asarray(self.deg_reduce(deg_all)[:n],
-                                               dtype=np.int64)
+                        deg_host += np.asarray(  # sheeplint: sync-ok
+                            self.deg_reduce(deg_all)[:n], dtype=np.int64)
                         deg_all = self.init_degrees()
                         since = 0
                     if at_ckpt:
                         checkpointer.save("degrees", start + batches * d,
                                           {"deg": deg_host}, meta)
-            finally:
-                # deterministic worker cancel on exception unwind, as in
-                # _device_chunk_groups (fault injection, checkpoint IO)
-                pf.close()
-            deg_host += np.asarray(self.deg_reduce(deg_all)[:n], dtype=np.int64)
+            deg_host += np.asarray(  # sheeplint: sync-ok
+                self.deg_reduce(deg_all)[:n], dtype=np.int64)
         # positions are ordinal: rank-compress if totals exceed int32
         if deg_host.size and deg_host.max() >= 2**31:
             deg_rank = np.argsort(np.argsort(deg_host, kind="stable"),
@@ -833,8 +858,9 @@ class ShardedPipeline:
                 if self.proc == 0:
                     # vertex-space checkpoint -> position space, host-side
                     # (no device round-trip, no eager op on a global array)
-                    fa[0] = np.asarray(state.arrays["merged_partial"],
-                                       dtype=np.int32)[np.asarray(order)]
+                    fa[0] = np.asarray(  # sheeplint: sync-ok
+                        state.arrays["merged_partial"],
+                        dtype=np.int32)[np.asarray(order)]  # sheeplint: sync-ok
                 P_all = self._put(self.state_sharding, fa)
                 start = state.chunk_idx
             else:
@@ -851,12 +877,12 @@ class ShardedPipeline:
                 build_stats["dispatch_batch"] = nb
                 build_stats["inflight_depth"] = self.inflight
                 empty = None
-                # deterministic worker cancel on an exception unwind
-                # (fault injection, checkpoint IO): close instead of
-                # waiting for the GC backstop, as in _device_chunk_groups
-                pf = prefetch_batched(
-                    self.iter_batches(stream, start_chunk=start), nb)
-                try:
+                # with-exit = deterministic worker cancel on an
+                # exception unwind (fault injection, checkpoint IO),
+                # as in _device_chunk_groups
+                with prefetch_batched(
+                        self.iter_batches(stream, start_chunk=start),
+                        nb) as pf:
                     for group in pf:
                         gl = len(group)
                         if gl < nb:
@@ -879,18 +905,15 @@ class ShardedPipeline:
                             maybe_fail("build", b)
                         if checkpointer is not None and \
                                 checkpointer.due_span(before * d, batches * d):
-                            partial = np.asarray(self.to_minp(
+                            partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
                                 self.merge(P_all, stats=merge_stats), pos))
                             checkpointer.save(
                                 "build", start + batches * d,
                                 {"deg": deg_host, "merged_partial": partial},
                                 meta)
-                finally:
-                    pf.close()
             else:
-                pf = prefetch(self.iter_batches(stream,
-                                                start_chunk=start))
-                try:
+                with prefetch(self.iter_batches(
+                        stream, start_chunk=start)) as pf:
                     for batch in pf:
                         seg_sp = obs.begin("segment", i=batches)
                         P_all = self.build_step(P_all,
@@ -902,19 +925,18 @@ class ShardedPipeline:
                         if checkpointer is not None and \
                                 checkpointer.due_span((batches - 1) * d,
                                                       batches * d):
-                            partial = np.asarray(self.to_minp(
+                            partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
                                 self.merge(P_all, stats=merge_stats), pos))
                             checkpointer.save(
                                 "build", start + batches * d,
                                 {"deg": deg_host,
                                  "merged_partial": partial},
                                 meta)
-                finally:
-                    pf.close()
             msp = obs.begin("merge", devices=int(d))
             merged_minp = self.to_minp(
                 self.merge(P_all, stats=merge_stats), pos)
-            np.asarray(merged_minp[:1])  # real completion barrier
+            # real completion barrier
+            np.asarray(merged_minp[:1])  # sheeplint: sync-ok
             merge_acc.absorb(merge_stats)
             msp.end()
         t["build+merge"] = time.perf_counter() - t0
@@ -923,15 +945,16 @@ class ShardedPipeline:
 
         # split on host over O(V) state
         t0 = time.perf_counter()
-        sp = obs.begin("split")
-        parent = elim_ops.minp_to_parent(merged_minp, order, n)
-        pos_host = np.asarray(pos[:n])
-        w = deg_host.astype(np.float64) if weights == "degree" else None
-        assign_host = tree_split_host(parent, pos_host, k, weights=w, alpha=alpha)
-        assign = self.put_replicated(
-            np.concatenate([assign_host.astype(np.int32), np.zeros(1, np.int32)]))
-        t["split"] = time.perf_counter() - t0
-        sp.end()
+        with obs.span("split"):
+            parent = elim_ops.minp_to_parent(merged_minp, order, n)
+            pos_host = np.asarray(pos[:n])  # sheeplint: sync-ok
+            w = deg_host.astype(np.float64) if weights == "degree" else None
+            assign_host = tree_split_host(parent, pos_host, k, weights=w,
+                                          alpha=alpha)
+            assign = self.put_replicated(
+                np.concatenate([assign_host.astype(np.int32),
+                                np.zeros(1, np.int32)]))
+            t["split"] = time.perf_counter() - t0
 
         # pass 3: scoring (comm point 3)
         t0 = time.perf_counter()
@@ -947,11 +970,11 @@ class ShardedPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         batches = 0
-        pf = prefetch(self.iter_batches(stream, start_chunk=start))
-        try:
+        with prefetch(self.iter_batches(stream, start_chunk=start)) as pf:
             for batch in pf:
                 dev_batch = self.put_batch(batch)
-                c, tt = np.asarray(self.score_step(dev_batch, assign))
+                c, tt = np.asarray(  # sheeplint: sync-ok
+                    self.score_step(dev_batch, assign))
                 cut += int(c)
                 total += int(tt)
                 if comm_volume:
@@ -968,10 +991,8 @@ class ShardedPipeline:
                         checkpointer, start + batches * d, cut, total,
                         cv_chunks,
                         {"deg": deg_host,
-                         "merged": np.asarray(merged_minp)}, meta,
-                        comm_volume)
-        finally:
-            pf.close()
+                         "merged": np.asarray(merged_minp)},  # sheeplint: sync-ok
+                        meta, comm_volume)
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

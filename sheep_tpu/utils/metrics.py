@@ -47,8 +47,12 @@ class MetricsWriter:
             self._fh.flush()
 
     def close(self) -> None:
-        if self._owns:
-            self._fh.close()
+        # under the lock: a straggling emitter (heartbeat beat racing
+        # the owner's teardown) must never interleave with the close —
+        # the sheeplint lock rule's original true positive
+        with self._lock:
+            if self._owns:
+                self._fh.close()
 
     def __enter__(self) -> "MetricsWriter":
         return self

@@ -1,0 +1,471 @@
+"""sheeplint static rules + SHEEP_SANITIZE runtime sanitizer (ISSUE 6).
+
+One known-bad snippet per rule class (the canonical hazard each rule
+exists for), pragma and baseline suppression, a clean-file case, the
+whole-repo gate as tier-1, and sanitizer tests proving an injected
+stray sync and an injected use-after-donate are caught at runtime.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sheep_tpu.analysis import lint_source
+from sheep_tpu.analysis.core import load_baseline, write_baseline
+from sheep_tpu.analysis.runner import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: each canonical bad pattern fires
+# ---------------------------------------------------------------------------
+
+SYNC_BAD = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def fold_step(P, lo, n):
+    return P.at[lo].min(lo, mode="drop"), jnp.sum(lo != n)
+
+def driver(P, lo, n):
+    P, live = fold_step(P, lo, n)
+    if int(live) > 0:          # stray sync: reverts pipeline to lockstep
+        P, live = fold_step(P, lo, n)
+    return P
+"""
+
+
+def test_sync_rule_fires_on_stray_int():
+    findings = lint_source(SYNC_BAD)
+    assert "sync" in rules_of(findings)
+    assert any(f.severity == "error" and "int()" in f.message
+               for f in findings)
+
+
+def test_sync_rule_fires_on_branch_and_asarray():
+    src = SYNC_BAD.replace(
+        "    if int(live) > 0:          # stray sync: reverts pipeline to lockstep\n"
+        "        P, live = fold_step(P, lo, n)\n",
+        "    h = np.asarray(P)\n"
+        "    while live > 0:\n"
+        "        P, live = fold_step(P, lo, n)\n")
+    msgs = [f.message for f in lint_source(src) if f.rule == "sync"]
+    assert any("np.asarray" in m for m in msgs)
+    assert any("`while`" in m for m in msgs)
+
+
+def test_sync_pragma_suppresses():
+    src = SYNC_BAD.replace(
+        "if int(live) > 0:          # stray sync: reverts pipeline to lockstep",
+        "if int(live) > 0:  # sheeplint: sync-ok")
+    assert "sync" not in rules_of(lint_source(src))
+
+
+def test_branch_finding_not_suppressed_by_pragma_inside_body():
+    # the branch finding anchors to the TEST expression: a pragma on an
+    # unrelated line inside the body must not swallow it
+    src = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def fold_step(P, lo, n):
+    return P, jnp.sum(lo != n)
+
+def driver(P, lo, n):
+    P, live = fold_step(P, lo, n)
+    while live > 0:
+        h = np.asarray(live)  # sheeplint: sync-ok
+        P, live = fold_step(P, lo, n)
+    return P
+"""
+    msgs = [f.message for f in lint_source(src) if f.rule == "sync"]
+    assert any("`while`" in m for m in msgs)
+
+
+DONATE_BAD = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+def fold_donated(P, lo, n):
+    return P.at[lo].min(lo, mode="drop")
+
+def driver(P, lo, n):
+    out = fold_donated(P, lo, n)
+    return out + P[0]           # use-after-donate: P is dead
+"""
+
+
+def test_donate_rule_fires_on_use_after_donate():
+    findings = lint_source(DONATE_BAD)
+    assert any(f.rule == "donate" and "'P'" in f.message
+               for f in findings)
+
+
+def test_donate_rebind_is_clean():
+    src = DONATE_BAD.replace("out = fold_donated(P, lo, n)",
+                             "P = fold_donated(P, lo, n)") \
+                    .replace("return out + P[0]           "
+                             "# use-after-donate: P is dead",
+                             "return P[0]")
+    assert "donate" not in rules_of(lint_source(src))
+
+
+def test_donate_rule_tracks_donating_suffix_convention():
+    # callee defined elsewhere: the *_donated naming convention alone
+    # must poison the positional args
+    src = """
+from somewhere import fold_segments_batch_pos_donated
+
+def driver(P, loB, hiB, n):
+    out = fold_segments_batch_pos_donated(P, loB, hiB, n)
+    return loB.shape, P
+"""
+    findings = lint_source(src)
+    assert any(f.rule == "donate" and "'P'" in f.message for f in findings)
+
+
+JIT_IN_LOOP_BAD = """
+import jax
+
+def sweep(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda a: a + 1)   # fresh program every iteration
+        outs.append(f(x))
+    return outs
+"""
+
+JIT_STATIC_LIST_BAD = """
+import jax
+
+f = jax.jit(lambda a, n: a + n, static_argnums=[1])
+"""
+
+JIT_TRACED_BRANCH_BAD = """
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def bad(P, n):
+    if P[0] > 0:                 # Python branch on a traced value
+        return P + 1
+    return P
+"""
+
+
+def test_jit_rule_fires_on_construction_in_loop():
+    findings = lint_source(JIT_IN_LOOP_BAD)
+    assert any(f.rule == "jit" and "loop" in f.message for f in findings)
+
+
+def test_jit_rule_fires_on_nontuple_static():
+    findings = lint_source(JIT_STATIC_LIST_BAD)
+    assert any(f.rule == "jit" and "static_argnums" in f.message
+               for f in findings)
+
+
+def test_jit_rule_fires_on_traced_branch():
+    findings = lint_source(JIT_TRACED_BRANCH_BAD)
+    assert any(f.rule == "jit" and "`if`" in f.message for f in findings)
+    # static params are exempt: branching on n is fine
+    src = JIT_TRACED_BRANCH_BAD.replace("if P[0] > 0:", "if n > 0:")
+    assert "jit" not in rules_of(lint_source(src))
+
+
+RESOURCE_PREFETCH_BAD = """
+from sheep_tpu.utils.prefetch import prefetch
+
+def consume(stream):
+    pf = prefetch(stream)        # no close() on any path
+    for item in pf:
+        if item is None:
+            return 0             # abandons pf: worker thread leaks
+    return 1
+"""
+
+RESOURCE_SPAN_BAD = """
+from sheep_tpu import obs
+
+def build(chunks):
+    sp = obs.begin("build")      # never ended
+    for c in chunks:
+        pass
+"""
+
+RESOURCE_COUNTERS_BAD = """
+def bump(tracer):
+    tracer.counters["host_syncs"] = 99   # bypasses the registry API
+"""
+
+
+def test_resource_rule_fires_on_uncloseable_prefetcher():
+    findings = lint_source(RESOURCE_PREFETCH_BAD)
+    assert any(f.rule == "resource" and "close()" in f.message
+               for f in findings)
+
+
+def test_resource_rule_accepts_with_and_close():
+    ok_with = RESOURCE_PREFETCH_BAD.replace(
+        "    pf = prefetch(stream)        # no close() on any path\n"
+        "    for item in pf:\n"
+        "        if item is None:\n"
+        "            return 0             # abandons pf: worker thread leaks\n"
+        "    return 1\n",
+        "    with prefetch(stream) as pf:\n"
+        "        for item in pf:\n"
+        "            pass\n"
+        "    return 1\n")
+    assert "resource" not in rules_of(lint_source(ok_with))
+    ok_close = RESOURCE_PREFETCH_BAD.replace(
+        "    return 1", "    pf.close()\n    return 1")
+    assert "resource" not in rules_of(lint_source(ok_close))
+
+
+def test_resource_rule_fires_on_unended_span():
+    findings = lint_source(RESOURCE_SPAN_BAD)
+    assert any(f.rule == "resource" and "span" in f.message
+               for f in findings)
+    ok = RESOURCE_SPAN_BAD.replace("    for c in chunks:\n        pass\n",
+                                   "    sp.end()\n")
+    assert "resource" not in rules_of(lint_source(ok))
+
+
+def test_resource_rule_fires_on_counter_subscript():
+    findings = lint_source(RESOURCE_COUNTERS_BAD)
+    assert any(f.rule == "resource" and "CounterRegistry" in f.message
+               for f in findings)
+
+
+LOCK_BAD = """
+import threading
+
+class Writer:
+    def __init__(self, fh):
+        self._fh = fh
+        self._lock = threading.Lock()
+
+    def emit(self, line):
+        with self._lock:
+            self._fh.write(line)
+
+    def close(self):
+        self._fh.close()         # races a concurrent emit
+"""
+
+
+def test_lock_rule_fires_on_unlocked_write():
+    findings = lint_source(LOCK_BAD)
+    assert any(f.rule == "lock" and "_fh" in f.message for f in findings)
+    ok = LOCK_BAD.replace(
+        "    def close(self):\n        self._fh.close()         "
+        "# races a concurrent emit\n",
+        "    def close(self):\n        with self._lock:\n"
+        "            self._fh.close()\n")
+    assert "lock" not in rules_of(lint_source(ok))
+
+
+CLEAN = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def fold(P, lo, n):
+    return P.at[lo].min(lo, mode="drop"), jnp.sum(lo != n)
+
+def driver(P, lo, n, stats):
+    size = int(lo.shape[0])               # metadata: no sync
+    P, live = fold(P, lo, n)
+    live_h = int(np.asarray(live))  # sheeplint: sync-ok
+    stats["live"] = live_h
+    return P, size
+"""
+
+
+def test_clean_file_has_no_findings():
+    assert lint_source(CLEAN) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+def test_baseline_suppresses_known_findings(tmp_path):
+    bad = tmp_path / "legacy.py"
+    bad.write_text(SYNC_BAD)
+    findings, baselined, _ = lint_paths([str(bad)])
+    assert findings and baselined == 0
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings)
+    again, baselined, _ = lint_paths([str(bad)],
+                                     baseline=load_baseline(str(bl)))
+    assert again == [] and baselined == len(findings)
+    # the ratchet: a NEW violation still fails against the old baseline
+    bad.write_text(SYNC_BAD + "\n\ndef more(P, lo, n):\n"
+                   "    _, live = fold_step(P, lo, n)\n"
+                   "    return float(live)\n")
+    newf, _, _ = lint_paths([str(bad)], baseline=load_baseline(str(bl)))
+    assert any("float()" in f.message for f in newf)
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (tier-1 wiring): zero non-baselined findings
+# ---------------------------------------------------------------------------
+
+def test_repo_gate_is_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sheeplint.py"),
+         "--check", "sheep_tpu", "tools"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SYNC_BAD)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sheeplint.py"),
+         "--json", "--no-baseline", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1  # errors present
+    payload = json.loads(r.stdout)
+    assert payload and payload[0]["rule"] == "sync"
+    warn = tmp_path / "warn.py"
+    warn.write_text(JIT_IN_LOOP_BAD)
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sheeplint.py"),
+         "--no-baseline", str(warn)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 2  # warnings only
+
+
+def test_cli_missing_path_is_not_vacuously_green(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sheeplint.py"),
+         "--check", str(tmp_path / "no_such_pkg")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3
+    assert "no such path" in r.stderr
+
+
+def test_rules_filter_keeps_parse_errors(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def oops(:\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sheeplint.py"),
+         "--no-baseline", "--rules", "sync", str(broken)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "syntax error" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer (SHEEP_SANITIZE=1)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitized(monkeypatch):
+    monkeypatch.setenv("SHEEP_SANITIZE", "1")
+    from sheep_tpu.analysis import sanitize
+    return sanitize
+
+
+def test_sanitizer_catches_injected_stray_sync(sanitized):
+    import jax.numpy as jnp
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    with pytest.raises(sanitized.SanitizeError, match="implicit"):
+        with sanitized.guard("test"):
+            bool(x.sum() > 0)
+    # the annotated window allows the same read
+    with sanitized.guard("test"):
+        with sanitized.sync_ok("test"):
+            assert int(x.sum()) == 28
+    # and outside any guard, conversions behave normally
+    assert int(x.sum()) == 28
+
+
+def test_sanitizer_off_is_inert(monkeypatch):
+    monkeypatch.delenv("SHEEP_SANITIZE", raising=False)
+    import jax.numpy as jnp
+
+    from sheep_tpu.analysis import sanitize
+
+    with sanitize.guard("test"):
+        assert bool(jnp.int32(1) > 0)
+
+
+def test_sanitizer_catches_injected_use_after_donate(sanitized):
+    import jax.numpy as jnp
+
+    from sheep_tpu.ops import elim as elim_ops
+
+    n = 64
+    P = jnp.full(n + 1, n, jnp.int32)
+    loB = jnp.full((2, 32), n, jnp.int32)
+    hiB = jnp.full((2, 32), n, jnp.int32)
+    elim_ops.fold_segments_batch_pos_donated(P, loB, hiB, n)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(P)  # the donated table is poisoned
+    # and a donation silently dropped (live buffer) is itself an error
+    with pytest.raises(sanitized.SanitizeError, match="donated"):
+        sanitized.check_donated(jnp.arange(4), origin="test")
+
+
+def test_sanitized_pipelined_fold_passes_and_matches(sanitized):
+    """The real dispatch pipeline runs clean under the armed guard
+    (whitelist complete) and still produces the exact fixpoint."""
+    import jax.numpy as jnp
+
+    from sheep_tpu.ops import elim as elim_ops
+
+    rng = np.random.default_rng(7)
+    n, C = 256, 128
+    edges = rng.integers(0, n, (4, C, 2)).astype(np.int32)
+    pos = jnp.arange(n + 1, dtype=jnp.int32)
+    loB, hiB = elim_ops.orient_chunks_batch_pos(jnp.asarray(edges), pos, n)
+    P0 = jnp.full(n + 1, n, jnp.int32)
+    P_pipe, _ = elim_ops.fold_segments_pipelined(
+        P0, iter([(loB, hiB)]), n, inflight=2, segment_rounds=2,
+        donate=True)
+    # the pipelined call donated loB/hiB — orient fresh blocks for the
+    # undonated reference fold
+    loB2, hiB2 = elim_ops.orient_chunks_batch_pos(jnp.asarray(edges), pos, n)
+    P_ref, _ = elim_ops.fold_segments_batch(
+        jnp.full(n + 1, n, jnp.int32), loB2, hiB2, n, segment_rounds=2,
+        donate=False)
+    np.testing.assert_array_equal(np.asarray(P_pipe), np.asarray(P_ref))
+
+
+def test_sanitizer_span_balance_at_close(sanitized):
+    from sheep_tpu.obs.tracer import Tracer
+
+    tr = Tracer(io.StringIO())
+    tr.begin("leaked")
+    with pytest.raises(sanitized.SanitizeError, match="never ended"):
+        tr.close()
+    # balanced traces close clean
+    tr2 = Tracer(io.StringIO())
+    sp = tr2.begin("ok")
+    sp.end()
+    tr2.close()

@@ -46,4 +46,19 @@ grep -q "dispatch" "$OUT/report_inflight.txt"
 grep -q "host_blocked_ms" "$TRACE2"
 grep -q "inflight_depth" "$TRACE2"
 
-echo "obs smoke OK: $TRACE $TRACE2"
+# third leg: the same pipelined build under SHEEP_SANITIZE=1 (ISSUE 6)
+# — stray-sync traps armed around the dispatch chain, donation
+# poisoning checks live, span balance asserted at tracer close. A
+# stray int()/bool() on a device value anywhere in the fold/dispatch
+# path, a silently dropped donation, or a leaked span fails this leg.
+TRACE3="$OUT/trace_sanitized.jsonl"
+rm -f "$TRACE3"
+JAX_PLATFORMS=cpu SHEEP_SANITIZE=1 python -m sheep_tpu.cli \
+    --input rmat:10:8:1 --k 4 --backend tpu \
+    --dispatch-batch 2 --inflight 2 --chunk-edges 1024 \
+    --trace "$TRACE3" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_sanitized.json"
+python tools/trace_report.py "$TRACE3" --check > "$OUT/report_sanitized.txt"
+grep -q "dispatch" "$OUT/report_sanitized.txt"
+
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3"

@@ -94,7 +94,7 @@ def main():
     rng = np.random.default_rng(0)
     table2 = jnp.asarray(
         rng.integers(0, 1 << 30, (R, 128), dtype=np.int32))
-    tnp = np.asarray(table2)
+    tnp = np.asarray(table2)  # sheeplint: sync-ok
 
     # A: row-take
     idxA = jnp.asarray(rng.integers(0, R, (B,), dtype=np.int32))
@@ -104,7 +104,7 @@ def main():
             ..., jnp.take(t[...], i[...], axis=0, mode="clip")),
         [table2, idxA],
         jax.ShapeDtypeStruct((B, 128), jnp.int32),
-        check=lambda out: np.array_equal(out, tnp[np.asarray(idxA)]))
+        check=lambda out: np.array_equal(out, tnp[np.asarray(idxA)]))  # sheeplint: sync-ok
 
     # B: sublane gather (axis=0), idx same shape as a (8,128) tile
     idxB = jnp.asarray(rng.integers(0, R, (8, 128), dtype=np.int32))
@@ -115,7 +115,7 @@ def main():
         [table2, idxB],
         jax.ShapeDtypeStruct((8, 128), jnp.int32),
         check=lambda out: np.array_equal(
-            out, np.take_along_axis(tnp, np.asarray(idxB), axis=0)))
+            out, np.take_along_axis(tnp, np.asarray(idxB), axis=0)))  # sheeplint: sync-ok
 
     # C: lane gather (axis=1) on one (8,128) tile
     x8 = jnp.asarray(rng.integers(0, 1 << 30, (8, 128), dtype=np.int32))
@@ -127,7 +127,7 @@ def main():
         [x8, idxC],
         jax.ShapeDtypeStruct((8, 128), jnp.int32),
         check=lambda out: np.array_equal(
-            out, np.take_along_axis(np.asarray(x8), np.asarray(idxC),
+            out, np.take_along_axis(np.asarray(x8), np.asarray(idxC),  # sheeplint: sync-ok
                                     axis=1)))
 
     # D: composite arbitrary-index scalar gather, 8 per two 2D gathers.
@@ -157,7 +157,7 @@ def main():
         [table2, idxD],
         jax.ShapeDtypeStruct((S, 8), jnp.int32),
         check=lambda out: np.array_equal(
-            out, tnp.reshape(-1)[np.asarray(idxD)]))
+            out, tnp.reshape(-1)[np.asarray(idxD)]))  # sheeplint: sync-ok
 
     # E: lane-routed bulk gather. Indices PRE-ROUTED so lane j only
     # holds indices with (idx & 127) == j (the router is an XLA sort by
@@ -179,7 +179,7 @@ def main():
         [table2, idxE],
         jax.ShapeDtypeStruct((SB, 128), jnp.int32),
         check=lambda out: np.array_equal(
-            out, tnp.reshape(-1)[np.asarray(idxE)]))
+            out, tnp.reshape(-1)[np.asarray(idxE)]))  # sheeplint: sync-ok
 
     if "--perf" in sys.argv and plat == "tpu":
         perf(jax, jnp, rng)
@@ -267,18 +267,18 @@ def perf(jax, jnp, rng):
     lanes = np.arange(128, dtype=np.int32)[None, :]
     idxE = jnp.asarray(rowsE * 128 + lanes)
     outE = np.asarray(callE(table2, idxE))
-    okE = np.array_equal(outE, np.asarray(flat)[np.asarray(idxE)])
+    okE = np.array_equal(outE, np.asarray(flat)[np.asarray(idxE)])  # sheeplint: sync-ok
     s = _time(callE, table2, idxE)
     print(json.dumps({"perf": "E_kernel_only", "ok": bool(okE), "n": NI,
                       "melems": round(NI / s / 1e6, 1)}), flush=True)
     okR = np.array_equal(
         np.sort(np.asarray(routed(table2, idx1))),
-        np.sort(np.asarray(flat)[np.asarray(idx1)]))
+        np.sort(np.asarray(flat)[np.asarray(idx1)]))  # sheeplint: sync-ok
     s = _time(jax.jit(routed), table2, idx1)
     print(json.dumps({"perf": "E_with_router", "ok": bool(okR), "n": NI,
                       "melems": round(NI / s / 1e6, 1)}), flush=True)
     okU = np.array_equal(np.asarray(routed_unrouted(table2, idx1)),
-                         np.asarray(flat)[np.asarray(idx1)])
+                         np.asarray(flat)[np.asarray(idx1)])  # sheeplint: sync-ok
     s = _time(jax.jit(routed_unrouted), table2, idx1)
     print(json.dumps({"perf": "E_router_unroute", "ok": bool(okU),
                       "n": NI,

@@ -58,7 +58,7 @@ def probe_width(R):
         rec["compile_s"] = round(time.perf_counter() - t0, 2)
         out = np.asarray(compiled(x, idx))
         rec["ok"] = bool(np.array_equal(
-            out, np.take_along_axis(np.asarray(x), np.asarray(idx),
+            out, np.take_along_axis(np.asarray(x), np.asarray(idx),  # sheeplint: sync-ok
                                     axis=1)))
         n = 8 * R
         jax.block_until_ready(compiled(x, idx))

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""sheeplint: JAX-hazard static analyzer for this repo (ISSUE 6).
+
+Thin launcher for :mod:`sheep_tpu.analysis.cli` that works from a
+checkout without installation. The tier-1 gate invocation is
+
+    python tools/sheeplint.py --check sheep_tpu tools
+
+which exits 0 only at zero non-baselined findings (1 = errors,
+2 = warnings only). See README "Static analysis & sanitizers" for the
+rule catalog and the pragma/baseline workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheep_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

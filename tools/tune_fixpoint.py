@@ -109,7 +109,7 @@ def main():
             deg, jnp.asarray(pad_chunk(edges[i:i + (1 << 24)], 1 << 24, n)),
             n)
     pos, order = order_ops.elimination_order(deg[:n], n)
-    pos_host = np.asarray(pos[:n])
+    pos_host = np.asarray(pos[:n])  # sheeplint: sync-ok
 
     def run(chunk_log, warm_name, seg_rounds, lift, tail_div, stale, carry,
             overlap, reuse=1):
