@@ -95,6 +95,11 @@ class Partitioner(abc.ABC):
     # backends advertise capabilities the CLI/driver can query
     supports_streaming: bool = True
     supports_multidevice: bool = False
+    # True when partition() takes checkpointer=/resume= (the chunk-level
+    # recovery contract of utils/checkpoint); hierarchy consults this to
+    # decide whether its level 0 gets a nested chunk-checkpoint domain
+    # or level-boundary-only recovery
+    supports_checkpoint: bool = False
 
 
 def score_stream(stream, assignments, chunk_edges: int = 1 << 22,

@@ -24,6 +24,7 @@ if not native.available():  # pragma: no cover - toolchain missing
 @register
 class CpuBackend(Partitioner):
     name = "cpu"
+    supports_checkpoint = True
 
     def __init__(self, chunk_edges: int = 1 << 22, alpha: float = 1.0):
         self.chunk_edges = chunk_edges

@@ -261,6 +261,7 @@ def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
 @register
 class TpuBackend(Partitioner):
     name = "tpu"
+    supports_checkpoint = True
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,

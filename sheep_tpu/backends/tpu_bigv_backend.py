@@ -24,6 +24,7 @@ from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 class TpuBigVBackend(Partitioner):
     name = "tpu-bigv"
     supports_multidevice = True
+    supports_checkpoint = True
 
     def __init__(self, chunk_edges: int = 1 << 20, alpha: float = 1.0,
                  jumps: int = 128, n_devices: int | None = None,

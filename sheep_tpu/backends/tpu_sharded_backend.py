@@ -21,6 +21,7 @@ from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 @register
 class TpuShardedBackend(Partitioner):
     name = "tpu-sharded"
+    supports_checkpoint = True
     supports_multidevice = True
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,

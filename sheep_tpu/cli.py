@@ -45,8 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default 8 when --refine is 0). Recovers "
                         "community structure where flat k stalls below "
                         "the LP signal threshold (BASELINE.md 'SBM "
-                        "quality'); replaces --k, excludes "
-                        "--checkpoint-dir/--resume")
+                        "quality'); replaces --k. Combines with "
+                        "--checkpoint-dir/--resume (chunk-level inside "
+                        "level 0, level-boundary for the recursion) and "
+                        "with multi-host flags (level 0 is an ordinary "
+                        "flat partition)")
     p.add_argument("--final-refine", type=int, default=0, metavar="N",
                    help="with --k-levels: N warm-start LP rounds at the "
                         "FULL k after hierarchical assembly (level-1 "
@@ -287,6 +290,30 @@ def _start_trace_run(tracer, args) -> None:
             tracer, args.heartbeat_secs).start()
 
 
+def _multihost_setup(args) -> tuple:
+    """Distributed bring-up shared by the flat and --k-levels paths:
+    initialize the runtime, resolve rank, default the backend to the
+    sharded one, then start the deferred trace (the manifest's topology
+    probe is only safe after jax.distributed.initialize, and it sits
+    after the backend default so the manifest records the backend that
+    will actually run). Returns (is_main, process_id, nprocs)."""
+    from sheep_tpu.parallel.mesh import init_distributed
+
+    init_distributed(args.coordinator, args.num_processes, args.process_id)
+    import jax
+
+    process_id = jax.process_index()
+    nprocs = jax.process_count()
+    if args.backend is None:
+        args.backend = "tpu-sharded"
+    from sheep_tpu import obs
+
+    tracer = obs.get_tracer()
+    if tracer is not None:
+        _start_trace_run(tracer, args)
+    return process_id == 0, process_id, nprocs
+
+
 def _run(parser, args) -> int:
 
     def _score_only(args):
@@ -369,14 +396,8 @@ def _run(parser, args) -> int:
 
         if args.k is not None:
             parser.error("--k-levels replaces --k")
-        if args.checkpoint_dir or args.resume:
-            parser.error("--k-levels does not combine with "
-                         "--checkpoint-dir/--resume (hierarchy levels "
-                         "are not checkpointable units)")
-        if args.coordinator or args.num_processes:
-            parser.error("--k-levels is single-process (levels recurse "
-                         "into host-memory subgraphs); run multi-host "
-                         "partitions flat")
+        if args.resume and not args.checkpoint_dir:
+            parser.error("--resume requires --checkpoint-dir")
         if args.balance is not None and args.alpha != 1.0:
             parser.error("--balance sets the per-level alpha "
                          "(BETA**(1/levels) per level); do not also "
@@ -409,6 +430,26 @@ def _run(parser, args) -> int:
         if not levels or any(k < 1 for k in levels):
             parser.error(f"--k-levels must be a comma list of "
                          f"positive ints (got {args.k_levels!r})")
+
+        # multi-host: level 0 is an ordinary flat partition, so the
+        # same distributed bring-up as the flat path applies; the
+        # recursion then runs identically (and deterministically) on
+        # every process, keeping collective schedules in lockstep
+        is_main, process_id, nprocs = True, 0, 1
+        if args.coordinator or args.num_processes:
+            is_main, process_id, nprocs = _multihost_setup(args)
+
+        ckpt_kw = {}
+        if args.checkpoint_dir:
+            from sheep_tpu.utils.checkpoint import Checkpointer
+
+            ckpt_kw = {
+                "checkpointer": Checkpointer(args.checkpoint_dir,
+                                             every=args.checkpoint_every,
+                                             process=process_id),
+                "resume": args.resume,
+                "nprocs": nprocs,
+            }
         t0 = time.perf_counter()
         res = sheep_tpu.partition_hierarchical(
             args.input, levels, backend=args.backend,
@@ -419,9 +460,12 @@ def _run(parser, args) -> int:
             balance=args.balance, final_refine=args.final_refine,
             spill_dir=args.spill_dir, n_vertices=args.num_vertices,
             refine_budget_bytes=int(args.refine_budget_gb * (1 << 30)),
+            **ckpt_kw,
             **({} if args.balance is not None else
                {"alpha": args.alpha}))
         wall = time.perf_counter() - t0
+        if not is_main:
+            return 0
         if args.output:
             write_partition(args.output, res.assignment)
         summary = res.summary()
@@ -491,24 +535,7 @@ def _run(parser, args) -> int:
     is_main = True
     process_id = 0
     if args.coordinator or args.num_processes:
-        from sheep_tpu.parallel.mesh import init_distributed
-
-        init_distributed(args.coordinator, args.num_processes, args.process_id)
-        import jax
-
-        process_id = jax.process_index()
-        is_main = process_id == 0
-        if args.backend is None:
-            args.backend = "tpu-sharded"
-        from sheep_tpu import obs as _obs
-
-        tracer = _obs.get_tracer()
-        if tracer is not None:
-            # deferred trace bring-up (see main): the topology probe is
-            # safe now that the distributed runtime is initialized — and
-            # it sits after the backend default so the manifest records
-            # the backend that will actually run
-            _start_trace_run(tracer, args)
+        is_main, process_id, _ = _multihost_setup(args)
 
     backend = args.backend
     if backend is None:

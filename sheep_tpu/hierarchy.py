@@ -33,13 +33,30 @@ labels as warm start. The LP signal law objects to COLD starts at
 k >= 64 (per-part majority ~ intra_degree/k is tie-noise); a warm start
 only needs boundary repair, where the majority signal is local and
 strong.
+
+Production survival (ISSUE 8): hierarchy is a full member of the
+checkpoint contract. Pass ``checkpointer=``/``resume=`` and the run
+recovers at BOTH granularities: chunk-level inside level 0 (an ordinary
+flat partition, checkpointed by the backend into the nested ``level0/``
+domain) and level-boundary for the recursion (phase ``hier``: the
+level-0 result, the partial final assignment, and the spill-file
+manifest — each completed part advances the queue position, and the
+per-part ``.bin32`` shards persist under the checkpoint dir so a
+resumed run REUSES them instead of re-streaming the graph). A resumed
+run is bit-identical to an uninterrupted one: level-0 restart is the
+flat backends' proven mergeable-state property, and everything after
+level 0 is a deterministic function of the level-0 assignment and the
+spilled shards. Fault drills target the new granularities via
+``SHEEP_FAULT_INJECT=level0:N`` / ``level:i`` (utils/fault.py).
 """
 
 from __future__ import annotations
 
+import glob
 import os
 import shutil
 import tempfile
+from contextlib import nullcontext
 
 import numpy as np
 
@@ -99,83 +116,242 @@ def _spill_intra(stream, assign, k1, chunk_edges, tmpdir, local_id):
     return paths
 
 
+def _save_hier(checkpointer, parts_done, assign, final, spill_names,
+               spill_sizes, meta):
+    """Level-boundary checkpoint (phase ``hier``, chunk_idx = per-part
+    queue position): the level-0 result, the partial final assignment,
+    and the spill-file manifest (shard basenames + byte sizes; -1 =
+    shard consumed by a completed subtree). O(V) per save, like the
+    flat phases."""
+    checkpointer.save(
+        "hier", int(parts_done),
+        {"assign": np.asarray(assign, np.int32),
+         "final": np.asarray(final, np.int32),
+         "level": np.int64(0),
+         "spill_names": np.asarray(list(spill_names)),
+         "spill_sizes": np.asarray(spill_sizes, np.int64)}, meta)
+
+
+def _spill_manifest_problem(level_dir, names, sizes, parts_done):
+    """None when every still-pending shard named in the manifest exists
+    with its recorded byte size; else a description — the caller
+    degrades to a from-scratch level rebuild with a warning instead of
+    resuming against missing/torn spill state."""
+    for p, (name, size) in enumerate(zip(names, sizes)):
+        if p < parts_done or int(size) < 0:
+            continue
+        shard = os.path.join(level_dir, str(name))
+        try:
+            got = os.path.getsize(shard)
+        except OSError:
+            return f"spill shard {name} missing"
+        if got != int(size):
+            return f"spill shard {name} is {got} bytes, manifest says " \
+                   f"{int(size)}"
+    return None
+
+
 def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
                  chunk_edges, tmpdir, opts, timings=None,
-                 spill_bytes=None, depth=0):
+                 spill_bytes=None, depth=0, checkpointer=None,
+                 resume=False, meta=None, nprocs=1):
     """Assignment over ``stream`` at k = prod(k_levels), recursing.
     ``timings`` (top-level dict) accumulates per-depth partition/spill
     walls under ``level{d}_partition`` / ``level{d}_spill`` keys;
     ``spill_bytes`` (its own dict — bytes are not seconds) accumulates
-    per-depth spilled-shard sizes."""
+    per-depth spilled-shard sizes.
+
+    ``checkpointer`` (depth 0 only — recursion passes None) arms the
+    two recovery granularities documented in the module docstring;
+    ``meta`` is the run fingerprint its saves carry. The level-0 flat
+    partition runs under fault scope ``level0``, and each completed
+    top-level part reports fault phase ``level``."""
     import time
 
-    from sheep_tpu import _partition_stream
+    from sheep_tpu import _partition_stream, _resolve_backend
     from sheep_tpu.io.edgestream import EdgeStream
+    from sheep_tpu.utils import checkpoint as ckpt_mod
+    from sheep_tpu.utils import fault
 
     def t_add(key, dt):
         if timings is not None:
             timings[key] = round(timings.get(key, 0.0) + dt, 3)
 
     n = stream.num_vertices
-    t0 = time.perf_counter()
-    # comm volume of inner levels is discarded (the final full-stream
-    # score recomputes it once); chunk_edges forwards as the backends'
-    # ctor option so the user's memory ceiling applies at every level
-    res = _partition_stream(stream, k_levels[0], backend=backend,
-                            refine=refine, refine_alpha=refine_alpha,
-                            chunk_edges=chunk_edges,
-                            **{**opts, "comm_volume": False})
-    assign = np.asarray(res.assignment, np.int32)
-    t_add(f"level{depth}_partition", time.perf_counter() - t0)
+    k1 = k_levels[0]
+    k_sub = int(np.prod(k_levels[1:])) if len(k_levels) > 1 else 1
+
+    state = ckpt_mod.resume_state(checkpointer, meta, resume,
+                                  raise_on_mismatch=nprocs == 1)
+    if nprocs > 1 and checkpointer is not None and resume:
+        state = ckpt_mod.reconcile_multihost_resume(checkpointer, state,
+                                                    meta)
+
+    level_dir = None
+    if checkpointer is not None:
+        # deterministic shard home, reused across resumes; inner
+        # recursion levels still use transient lvl_* dirs — stale ones
+        # from a killed attempt are unreferenced, reclaim them
+        level_dir = os.path.join(tmpdir, "level0_shards")
+        for stale in glob.glob(os.path.join(tmpdir, "lvl_*")):
+            shutil.rmtree(stale, ignore_errors=True)
+
+    assign = final = None
+    parts_done = 0
+    spill_names: list = []
+    spill_sizes = np.zeros(0, np.int64)
+    if state is not None:
+        assign = np.asarray(state.arrays["assign"], np.int32)
+        final = np.asarray(state.arrays["final"], np.int32).copy()
+        parts_done = int(state.chunk_idx)
+        spill_names = [str(x) for x in state.arrays["spill_names"]]
+        spill_sizes = np.asarray(state.arrays["spill_sizes"],
+                                 np.int64).copy()
+        problem = _spill_manifest_problem(level_dir, spill_names,
+                                          spill_sizes, parts_done)
+        if nprocs > 1:
+            # degrading adds collective work (a level-0 rebuild), so
+            # the verdict must be COLLECTIVE like reconcile's: one
+            # process rebuilding alone would cross schedules with
+            # peers that skipped straight to the recursion. (Reconcile
+            # already agreed every process holds the same step, so all
+            # processes reach this allgather together.)
+            from jax.experimental import multihost_utils
+
+            bad = np.asarray(multihost_utils.process_allgather(
+                np.array([1 if problem is not None else 0], np.int64)))
+            if bad.any() and problem is None:
+                problem = "a peer process reported spill damage"
+        if problem is not None:
+            ckpt_mod._warn(
+                f"hierarchy resume: {problem}; rebuilding the level "
+                f"from scratch")
+            state = None
+        else:
+            # the level-0 sub-domain is obsolete once a level-boundary
+            # checkpoint exists; reclaim whatever a crash left there
+            checkpointer.child("level0").clear(force=True)
+
+    if state is None:
+        level0_ck = None
+        if checkpointer is not None and getattr(
+                _resolve_backend(backend, {})[0], "supports_checkpoint",
+                False):
+            level0_ck = checkpointer.child("level0")
+        t0 = time.perf_counter()
+        # comm volume of inner levels is discarded (the final full-stream
+        # score recomputes it once); chunk_edges forwards as the backends'
+        # ctor option so the user's memory ceiling applies at every level
+        with fault.scope("level0") if depth == 0 else nullcontext():
+            res = _partition_stream(
+                stream, k1, backend=backend, refine=refine,
+                refine_alpha=refine_alpha, chunk_edges=chunk_edges,
+                **{**opts, "comm_volume": False},
+                **({"checkpointer": level0_ck, "resume": resume}
+                   if level0_ck is not None else {}))
+        assign = np.asarray(res.assignment, np.int32)
+        t_add(f"level{depth}_partition", time.perf_counter() - t0)
     if len(k_levels) == 1:
         return assign
 
-    k1 = k_levels[0]
-    k_sub = int(np.prod(k_levels[1:]))
     # dense local ids for every part in one O(V) pass: vertex v is the
     # local_id[v]-th member of part assign[v]
     order = np.argsort(assign, kind="stable")
     counts = np.bincount(assign, minlength=k1).astype(np.int64)
     offsets = np.zeros(k1 + 1, np.int64)
     np.cumsum(counts, out=offsets[1:])
-    local_id = np.empty(n, np.int32)
-    local_id[order] = (np.arange(n, dtype=np.int64)
-                       - np.repeat(offsets[:-1], counts)).astype(np.int32)
 
-    level_dir = tempfile.mkdtemp(prefix="lvl_", dir=tmpdir)
-    t0 = time.perf_counter()
-    paths = _spill_intra(stream, assign, k1, chunk_edges, level_dir,
-                         local_id)
-    t_add(f"level{depth}_spill", time.perf_counter() - t0)
-    if spill_bytes is not None:
-        key = f"level{depth}_spill_bytes"
-        spill_bytes[key] = spill_bytes.get(key, 0) + sum(
-            os.path.getsize(p) for p in paths)
-    del local_id
+    if state is None:
+        local_id = np.empty(n, np.int32)
+        local_id[order] = (np.arange(n, dtype=np.int64)
+                           - np.repeat(offsets[:-1], counts)).astype(np.int32)
+        if level_dir is None:
+            level_dir = tempfile.mkdtemp(prefix="lvl_", dir=tmpdir)
+        else:
+            os.makedirs(level_dir, exist_ok=True)
+        t0 = time.perf_counter()
+        paths = _spill_intra(stream, assign, k1, chunk_edges, level_dir,
+                             local_id)
+        t_add(f"level{depth}_spill", time.perf_counter() - t0)
+        if spill_bytes is not None:
+            key = f"level{depth}_spill_bytes"
+            spill_bytes[key] = spill_bytes.get(key, 0) + sum(
+                os.path.getsize(p) for p in paths)
+        del local_id
+        final = np.zeros(n, np.int32)
+        parts_done = 0
+        if checkpointer is not None:
+            spill_names = [os.path.basename(p) for p in paths]
+            spill_sizes = np.array([os.path.getsize(p) for p in paths],
+                                   np.int64)
+            # bank the level-0 result + shard manifest BEFORE dropping
+            # the level-0 chunk checkpoints: at no instant is the only
+            # copy of level-0 progress in volatile memory
+            _save_hier(checkpointer, 0, assign, final, spill_names,
+                       spill_sizes, meta)
+            if level0_ck is not None:
+                level0_ck.clear(force=True)
+    else:
+        paths = [os.path.join(level_dir, nm) for nm in spill_names]
 
-    final = np.empty(n, np.int32)
+    start_parts = parts_done
+    pending_rm: list = []
+    prev_rm: list = []
+
+    def save_boundary(p_next):
+        # consumed shards leave the manifest before their files leave
+        # the disk — and the files outlive the manifest by ONE save:
+        # load() may fall back to the RETAINED PREVIOUS step (corrupt
+        # latest .npz, multi-host one-step skew), whose manifest still
+        # names the shards this save marks consumed. Only shards
+        # already absent from BOTH retained manifests are removed.
+        for q in pending_rm:
+            spill_sizes[q] = -1
+        _save_hier(checkpointer, p_next, assign, final, spill_names,
+                   spill_sizes, meta)
+        for q in prev_rm:
+            try:
+                os.remove(paths[q])
+            except OSError:
+                pass
+        prev_rm[:] = pending_rm
+        pending_rm.clear()
+
+    ok = False
     try:
-        for p in range(k1):
+        for p in range(parts_done, k1):
             members = order[offsets[p]:offsets[p + 1]]
             if len(members) == 0:
-                continue
-            if len(members) <= k_sub:
+                pass
+            elif len(members) <= k_sub:
                 # degenerate tiny part: round-robin keeps every label in
                 # [0, k_sub); final_refine repairs these choices where a
                 # better neighborhood exists
-                final[members] = p * k_sub + np.arange(len(members),
-                                                       dtype=np.int32) % k_sub
-                continue
-            sub = EdgeStream.open(paths[p], n_vertices=len(members))
-            sub_assign = _hier_assign(sub, k_levels[1:], backend, refine,
-                                      refine_alpha, chunk_edges, tmpdir,
-                                      opts, timings=timings,
-                                      spill_bytes=spill_bytes,
-                                      depth=depth + 1)
-            final[members] = p * k_sub + sub_assign
-            os.remove(paths[p])  # subtree done: reclaim the shard early
+                final[members] = p * k_sub + np.arange(
+                    len(members), dtype=np.int32) % k_sub
+            else:
+                sub = EdgeStream.open(paths[p], n_vertices=len(members))
+                sub_assign = _hier_assign(sub, k_levels[1:], backend,
+                                          refine, refine_alpha,
+                                          chunk_edges, tmpdir, opts,
+                                          timings=timings,
+                                          spill_bytes=spill_bytes,
+                                          depth=depth + 1)
+                final[members] = p * k_sub + sub_assign
+                if checkpointer is None:
+                    os.remove(paths[p])  # subtree done: reclaim early
+                else:
+                    pending_rm.append(p)
+            if checkpointer is not None and (
+                    p == k1 - 1 or checkpointer.due_span(p, p + 1)):
+                save_boundary(p + 1)
+            if depth == 0:
+                fault.maybe_fail("level", p + 1 - start_parts)
+        ok = True
     finally:
-        shutil.rmtree(level_dir, ignore_errors=True)
+        # with a checkpointer, a fault must leave the shards for resume
+        if level_dir is not None and (ok or checkpointer is None):
+            shutil.rmtree(level_dir, ignore_errors=True)
     return final
 
 
@@ -186,7 +362,9 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                            final_refine: int = 0,
                            spill_dir: str | None = None,
                            n_vertices: int | None = None,
-                           refine_budget_bytes: int = 4 << 30, **opts):
+                           refine_budget_bytes: int = 4 << 30,
+                           checkpointer=None, resume: bool = False,
+                           nprocs: int = 1, **opts):
     """Partition into prod(k_levels) parts, one level at a time.
 
     ``k_levels`` — e.g. ``[8, 8]`` for k=64. ``refine`` rounds apply at
@@ -199,6 +377,17 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
     :func:`sheep_tpu.partition`. Returns a PartitionResult scored over
     the full stream at k = prod(k_levels); ``backend`` in the result is
     tagged ``+hier``.
+
+    ``checkpointer``/``resume`` (utils/checkpoint.Checkpointer) make
+    the run recoverable at chunk granularity inside level 0 and at
+    level boundaries for the recursion (module docstring); the spill
+    shards live under the checkpoint dir so a resumed run reuses them.
+    A successful run clears its checkpoint state like the flat
+    backends. ``nprocs`` > 1 reconciles the level-boundary resume step
+    across processes the way the flat multi-host paths do (level 0 is
+    an ordinary flat partition, so multi-host applies there; every
+    process then replays the identical deterministic recursion in
+    lockstep over its own spill copy).
     """
     from sheep_tpu.backends.base import score_stream
     from sheep_tpu.io.edgestream import open_input
@@ -224,17 +413,43 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
 
     import time
 
-    tmp_root = tempfile.mkdtemp(prefix="sheep_hier_", dir=spill_dir)
+    if checkpointer is not None:
+        # spill shards must survive the process to be resumable: root
+        # them under the checkpoint dir, per process (each multi-host
+        # process streams its own spill copy)
+        tmp_root = os.path.join(checkpointer.dir,
+                                f"hier_spill_p{checkpointer.process}")
+        os.makedirs(tmp_root, exist_ok=True)
+    else:
+        tmp_root = tempfile.mkdtemp(prefix="sheep_hier_", dir=spill_dir)
     timings: dict = {}
     spill_bytes: dict = {}
     try:
         # headerless binary formats otherwise pay a full stream scan
         # just to learn V (30 GB at the uk-class soak)
         with open_input(path, n_vertices=n_vertices) as es:
+            meta = None
+            if checkpointer is not None:
+                from sheep_tpu.utils import checkpoint as ckpt_mod
+
+                # every option that affects the result fingerprints the
+                # run, exactly like the flat backends' stream_meta use
+                meta = ckpt_mod.stream_meta(
+                    es, k_total, chunk_edges,
+                    weights=opts.get("weights", "unit"),
+                    alpha=opts.get("alpha", 1.0),
+                    comm_volume=comm_volume, state_format="hier",
+                    k_levels=[int(k) for k in k_levels],
+                    refine=int(refine), refine_alpha=float(refine_alpha),
+                    final_refine=int(final_refine),
+                    inner_backend=inner_backend)
             final = _hier_assign(es, k_levels, backend, refine,
                                  refine_alpha, chunk_edges, tmp_root,
                                  dict(opts), timings=timings,
-                                 spill_bytes=spill_bytes)
+                                 spill_bytes=spill_bytes,
+                                 checkpointer=checkpointer,
+                                 resume=resume, meta=meta,
+                                 nprocs=nprocs)
             w = None
             if opts.get("weights") == "degree":
                 # score with the same weights the levels balanced
@@ -288,6 +503,17 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                             chunk_edges))
                     res.phase_times["comm_volume"] = round(
                         time.perf_counter() - t0, 3)
+            if checkpointer is not None:
+                # success: drop the boundary state, the nested level-0
+                # domain, and the persistent spill root (the flat
+                # backends' clear-on-success contract)
+                checkpointer.clear(force=True)
+                shutil.rmtree(os.path.join(checkpointer.dir, "level0"),
+                              ignore_errors=True)
+                shutil.rmtree(tmp_root, ignore_errors=True)
             return res
     finally:
-        shutil.rmtree(tmp_root, ignore_errors=True)
+        if checkpointer is None:
+            # a faulted checkpointed run must keep its spill shards for
+            # the resume; un-checkpointed runs clean up unconditionally
+            shutil.rmtree(tmp_root, ignore_errors=True)

@@ -30,11 +30,29 @@ import numpy as np
 # dropped from PHASES. _read_manifest returns None on a version mismatch,
 # so v1 checkpoints degrade to a clean fresh start instead of a KeyError
 # mid-recovery.
-FORMAT_VERSION = 2
+# v3: the 'hier' phase joins PHASES (hierarchy level-boundary state:
+# level-0 result + spill-file manifest + per-part queue position, see
+# sheep_tpu/hierarchy.py) and recovery degrades gracefully — a corrupt/
+# truncated .npz or torn manifest falls back to the newest intact step
+# (the retained previous one) or a clean start, with a warning, instead
+# of raising mid-recovery.
+FORMAT_VERSION = 3
 
-# phase progression of every backend's pipeline (SURVEY.md §3.1); a
-# successful run clears its checkpoint instead of writing a terminal phase
-PHASES = ("degrees", "build", "score")
+# phase progression of every backend's pipeline (SURVEY.md §3.1) plus
+# the hierarchy driver's level-boundary phase; a successful run clears
+# its checkpoint instead of writing a terminal phase
+PHASES = ("degrees", "build", "score", "hier")
+
+
+def _warn(msg: str) -> None:
+    """Degradation warning: stderr + a trace event (no-op untraced), so
+    a resumed production run records that recovery was lossy."""
+    import sys
+
+    print(f"checkpoint warning: {msg}", file=sys.stderr)
+    from sheep_tpu import obs
+
+    obs.event("checkpoint_degraded", message=msg)
 
 
 def phase_index(phase: str) -> int:
@@ -64,13 +82,29 @@ class Checkpointer:
     :meth:`save` when it fires.
     """
 
-    def __init__(self, directory: str, every: int = 64, process: int = 0):
+    def __init__(self, directory: str, every: int = 64, process: int = 0,
+                 auto_clear: bool = True):
         if every < 1:
             raise ValueError("checkpoint cadence must be >= 1 chunk")
         self.dir = directory
         self.every = int(every)
         self.process = int(process)
+        # auto_clear=False suppresses the run-completion clear() the
+        # backends issue, for NESTED recovery domains: hierarchy's
+        # level-0 sub-run must leave its last chunk checkpoint on disk
+        # until the parent has banked the level-0 result in its own
+        # level-boundary checkpoint (a crash in that window otherwise
+        # loses the whole level). The owner clears with clear(force=True).
+        self.auto_clear = bool(auto_clear)
         os.makedirs(directory, exist_ok=True)
+
+    def child(self, name: str, auto_clear: bool = False) -> "Checkpointer":
+        """A checkpointer rooted at a subdirectory — a nested recovery
+        domain with the same cadence/process (hierarchy hands one to its
+        level-0 flat partition). Defaults to auto_clear=False: the
+        parent decides when the child's state is safe to drop."""
+        return Checkpointer(os.path.join(self.dir, name), every=self.every,
+                            process=self.process, auto_clear=auto_clear)
 
     # -- cadence -----------------------------------------------------------
     def due(self, chunks_done: int) -> bool:
@@ -117,7 +151,7 @@ class Checkpointer:
                 os.remove(tmp)
             raise
         prev = None
-        old = self._read_manifest()
+        old = self._read_manifest(quiet=True)
         if old is not None:
             prev = {"phase": old["phase"], "chunk_idx": old["chunk_idx"],
                     "data": old["data"]}
@@ -145,13 +179,29 @@ class Checkpointer:
             keep.add(prev["data"])
         self._sweep(keep=keep)
 
-    def _read_manifest(self) -> Optional[Dict]:
+    def _read_manifest(self, quiet: bool = False) -> Optional[Dict]:
+        """``quiet`` suppresses the degradation warnings for callers
+        that are not recovering (save() peeks at the old manifest for
+        the previous-step entry; a stale/foreign manifest there is not
+        a lossy recovery and must not fire a false alarm)."""
         try:
             with open(self._manifest_path) as f:
                 manifest = json.load(f)
-        except (FileNotFoundError, json.JSONDecodeError):
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+            # a torn/corrupt manifest cannot name ANY step — the atomic
+            # replace makes this near-impossible, but recovery must
+            # degrade, not traceback (ISSUE 8 satellite)
+            if not quiet:
+                _warn(f"manifest {self._manifest_path} is torn/unreadable; "
+                      f"starting clean")
             return None
         if manifest.get("version") != FORMAT_VERSION:
+            if not quiet:
+                _warn(f"checkpoint format v{manifest.get('version')} != "
+                      f"v{FORMAT_VERSION}; starting clean (checkpoints are "
+                      f"not portable across versions)")
             return None
         return manifest
 
@@ -160,7 +210,12 @@ class Checkpointer:
         try:
             with np.load(data_path) as z:
                 arrays = {k: z[k] for k in z.files}
-        except (FileNotFoundError, OSError):
+        except Exception as exc:
+            # a truncated .npz fails as BadZipFile/EOFError/zlib.error/
+            # ValueError depending on WHERE the bytes stop — any of them
+            # means this step is gone, and the caller falls back
+            _warn(f"checkpoint data {entry.get('data')} unreadable "
+                  f"({type(exc).__name__}: {exc})")
             return None
         return CheckpointState(
             phase=entry["phase"],
@@ -170,10 +225,24 @@ class Checkpointer:
         )
 
     def load(self) -> Optional[CheckpointState]:
+        """Newest intact checkpoint: the manifest's latest step, falling
+        back to its retained previous step when the latest data file is
+        corrupt/missing, then to a clean start — each fallback warned,
+        never raised (a torn checkpoint must not kill the recovery that
+        exists to survive exactly such crashes)."""
         manifest = self._read_manifest()
         if manifest is None:
             return None
-        return self._load_entry(manifest, manifest.get("meta", {}))
+        meta = manifest.get("meta", {})
+        for entry in (manifest, manifest.get("previous")):
+            if not entry:
+                continue
+            state = self._load_entry(entry, meta)
+            if state is not None:
+                return state
+        _warn(f"no intact checkpoint under {self.dir} (process "
+              f"{self.process}); resuming as a clean start")
+        return None
 
     def load_at(self, phase: str, chunk_idx: int) -> Optional[CheckpointState]:
         """Load the step (phase, chunk_idx) if it is the latest or the
@@ -188,7 +257,13 @@ class Checkpointer:
                 return self._load_entry(entry, meta)
         return None
 
-    def clear(self) -> None:
+    def clear(self, force: bool = False) -> None:
+        """Drop this process's checkpoint state. With auto_clear=False
+        (a nested child domain) only ``force=True`` clears — the
+        backends' run-completion clear() becomes a no-op and the parent
+        domain decides when the state is safe to drop."""
+        if not self.auto_clear and not force:
+            return
         self._sweep(keep=set())
         try:
             os.remove(self._manifest_path)
@@ -317,6 +392,13 @@ def resume_state(checkpointer: Optional[Checkpointer], meta: Dict,
             "upgrading sheep_tpu can change automatic chunk sizing "
             "(part of the fingerprint), in which case restart fresh — "
             "checkpoints are not portable across versions")
+    # the trace records where a killed run restarted, so trace_report
+    # can show the death/resume seam alongside the UNCLOSED spans of
+    # the previous (killed) run in the same appended file
+    from sheep_tpu import obs
+
+    obs.event("resume", phase=state.phase, chunk_idx=int(state.chunk_idx),
+              process=checkpointer.process)
     return state
 
 

@@ -3,24 +3,58 @@
 A test hook that kills the pipeline mid-stream, exercising the
 checkpoint/resume recovery path. Enabled via the environment variable
 
-    SHEEP_FAULT_INJECT="<phase>:<chunks>"     e.g. "build:3"
+    SHEEP_FAULT_INJECT="<phase>:<count>"      e.g. "build:3"
 
 which makes the named phase raise :class:`InjectedFault` after processing
 that many chunks. The recovery tests (tests/test_checkpoint.py) inject a
 fault, catch it, then resume from the last checkpoint and assert the final
 partition is identical to an uninterrupted run — the mergeable-forest
 property that makes chunk-level restart sound.
+
+Hierarchy phases (ISSUE 8): ``<phase>`` may also name an enclosing
+:func:`scope` instead of the streaming phase itself —
+
+    SHEEP_FAULT_INJECT="level0:3"   # inside hierarchy level 0, after 3
+                                    # chunks of whatever inner phase is
+                                    # streaming (the flat partition of
+                                    # level 0 runs under scope "level0")
+    SHEEP_FAULT_INJECT="level:1"    # after 1 completed level-boundary
+                                    # (hierarchy.py reports each part's
+                                    # completion as phase "level")
+
+so kill+resume drills can target the hierarchical driver at both of its
+recovery granularities (chunk-level inside level 0, level-boundary for
+the recursion).
 """
 
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
+from typing import List
 
 ENV_VAR = "SHEEP_FAULT_INJECT"
+
+# enclosing execution scopes (e.g. "level0" while hierarchy's level-0
+# flat partition streams); module-level is fine — injection is a
+# single-threaded test hook, never armed in production runs
+_SCOPES: List[str] = []
 
 
 class InjectedFault(RuntimeError):
     """Raised by the injection hook; never raised in production runs."""
+
+
+@contextmanager
+def scope(name: str):
+    """Mark the dynamic extent of a named execution scope; a spec whose
+    phase names the scope fires inside ANY streaming phase running
+    under it."""
+    _SCOPES.append(name)
+    try:
+        yield
+    finally:
+        _SCOPES.pop()
 
 
 def _parse(spec: str):
@@ -32,11 +66,16 @@ def _parse(spec: str):
 
 
 def maybe_fail(phase: str, chunks_done: int) -> None:
-    """Raise InjectedFault iff the env hook targets this phase and count."""
+    """Raise InjectedFault iff the env hook targets this phase (or an
+    enclosing scope) and count."""
     spec = os.environ.get(ENV_VAR)
     if not spec:
         return
     target_phase, target_count = _parse(spec)
-    if phase == target_phase and chunks_done >= target_count:
+    if target_phase != phase and target_phase not in _SCOPES:
+        return
+    if chunks_done >= target_count:
         raise InjectedFault(
-            f"injected fault in phase {phase!r} after {chunks_done} chunks")
+            f"injected fault in phase {phase!r}"
+            + (f" (scope {target_phase!r})" if target_phase != phase else "")
+            + f" after {chunks_done} chunks")

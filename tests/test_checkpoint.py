@@ -247,6 +247,305 @@ def test_fault_then_resume_carry_mode(tmp_path, phase, monkeypatch):
     assert res.comm_volume == expect.comm_volume
 
 
+# ------------------------------------- graceful degradation (ISSUE 8)
+
+def _truncate_mid_byte(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+
+
+def _manifest_data(ck):
+    import json
+
+    with open(ck._manifest_path) as f:
+        return json.load(f)["data"]
+
+
+def test_corrupt_latest_falls_back_to_previous(tmp_path, capsys):
+    """A truncated newest .npz degrades to the retained previous step
+    with a warning — never a traceback mid-recovery."""
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("build", 1, {"deg": np.arange(4, dtype=np.int64)}, {"k": 4})
+    ck.save("build", 2, {"deg": np.arange(4, dtype=np.int64) * 2}, {"k": 4})
+    _truncate_mid_byte(str(tmp_path / _manifest_data(ck)))
+    state = ck.load()
+    assert state is not None and state.chunk_idx == 1
+    assert np.array_equal(state.arrays["deg"], np.arange(4))
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_corrupt_all_degrades_to_clean_start(tmp_path, capsys):
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("build", 1, {"deg": np.zeros(4, np.int64)}, {"k": 4})
+    ck.save("build", 2, {"deg": np.zeros(4, np.int64)}, {"k": 4})
+    for f in os.listdir(tmp_path):
+        if f.endswith(".npz"):
+            _truncate_mid_byte(str(tmp_path / f))
+    assert ck.load() is None
+    err = capsys.readouterr().err
+    assert "unreadable" in err and "clean start" in err
+
+
+def test_torn_manifest_degrades_to_clean_start(tmp_path, capsys):
+    ck = Checkpointer(str(tmp_path), every=1)
+    ck.save("build", 1, {"deg": np.zeros(4, np.int64)}, {"k": 4})
+    with open(ck._manifest_path, "r+") as f:
+        raw = f.read()
+        f.seek(0)
+        f.truncate(len(raw) // 2)  # torn mid-write
+    assert ck.load() is None
+    assert "torn" in capsys.readouterr().err
+
+
+def test_resume_with_corrupt_checkpoint_completes(tmp_path, monkeypatch):
+    """End-to-end: fault a run, corrupt EVERY data file mid-byte, resume.
+    Recovery degrades to a clean start (warning, no raise) and the final
+    partition still matches the uninterrupted run bit for bit."""
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    backend = STREAMING_BACKENDS[0]
+    expect = get_backend(backend, **kw).partition(es, K, comm_volume=True)
+
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, "build:4")
+    with pytest.raises(InjectedFault):
+        get_backend(backend, **kw).partition(
+            es, K, comm_volume=True, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    for f in os.listdir(tmp_path):
+        if f.endswith(".npz"):
+            _truncate_mid_byte(str(tmp_path / f))
+
+    res = get_backend(backend, **kw).partition(
+        es, K, comm_volume=True, checkpointer=ck, resume=True)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert res.edge_cut == expect.edge_cut
+    assert res.comm_volume == expect.comm_volume
+
+
+# --------------------------- hierarchy survival drills (ISSUE 8 tentpole)
+
+def _hier_graph(tmp_path):
+    from sheep_tpu.io import formats
+
+    p = str(tmp_path / "hg.bin64")
+    formats.write_edges(p, generators.rmat(9, 8, seed=3))
+    return p
+
+
+HIER_KW = dict(refine=1, comm_volume=False, chunk_edges=CHUNK)
+
+
+def test_hier_fault_resume_mid_level0_bit_identical(tmp_path, monkeypatch):
+    """Kill the hierarchical run INSIDE level 0 (chunk granularity: the
+    level-0 flat partition checkpoints into the nested level0/ domain),
+    resume, and require a bit-identical final assignment."""
+    import sheep_tpu
+
+    p = _hier_graph(tmp_path)
+    backend = STREAMING_BACKENDS[0]
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level0:2")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+    sub = Checkpointer(str(tmp_path / "ck" / "level0"), every=1)
+    assert sub.load() is not None, \
+        "no chunk-level checkpoint inside level 0 before the fault"
+
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert res.edge_cut == expect.edge_cut
+    # success clears the whole recovery domain, spill shards included
+    assert ck.load() is None
+    assert os.listdir(tmp_path / "ck") == []
+
+
+def test_hier_fault_resume_level_boundary_bit_identical(tmp_path,
+                                                        monkeypatch):
+    """Kill the run AT a level boundary (one part's subtree finished and
+    checkpointed), resume, and require bit-identity; the saved state
+    must record the queue position and the spill manifest."""
+    import sheep_tpu
+
+    p = _hier_graph(tmp_path)
+    backend = STREAMING_BACKENDS[0]
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+    st = ck.load()
+    assert st is not None and st.phase == "hier" and st.chunk_idx == 1
+    assert {"assign", "final", "spill_names", "spill_sizes"} <= set(st.arrays)
+    # part 0's shard was consumed at its boundary; part 1's is pending
+    assert int(st.arrays["spill_sizes"][0]) == -1
+    assert int(st.arrays["spill_sizes"][1]) >= 0
+
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert res.edge_cut == expect.edge_cut
+    assert ck.load() is None
+
+
+def test_hier_resume_reuses_spill_manifest(tmp_path, monkeypatch):
+    """A level-boundary resume must REUSE the spill shards named in the
+    manifest, not re-stream the graph: _spill_intra is replaced with a
+    bomb for the resumed run."""
+    import sheep_tpu
+    from sheep_tpu import hierarchy
+
+    p = _hier_graph(tmp_path)
+    backend = STREAMING_BACKENDS[0]
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+
+    def bomb(*a, **kw):
+        raise AssertionError("resume re-spilled instead of reusing the "
+                             "manifest's shards")
+
+    monkeypatch.setattr(hierarchy, "_spill_intra", bomb)
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+
+
+def test_hier_resume_corrupt_spill_degrades(tmp_path, monkeypatch, capsys):
+    """A pending spill shard that went missing/torn degrades the resume
+    to a from-scratch level rebuild (warning, no raise) that still
+    matches the uninterrupted run."""
+    import sheep_tpu
+
+    p = _hier_graph(tmp_path)
+    backend = STREAMING_BACKENDS[0]
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+    st = ck.load()
+    pending = str(st.arrays["spill_names"][1])
+    _truncate_mid_byte(str(tmp_path / "ck" / "hier_spill_p0"
+                           / "level0_shards" / pending))
+
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert "spill shard" in capsys.readouterr().err
+
+
+def test_hier_corrupt_latest_falls_back_to_previous_boundary(tmp_path,
+                                                             monkeypatch,
+                                                             capsys):
+    """A corrupt LATEST level-boundary .npz falls back to the retained
+    previous step — whose manifest still names shards the latest step
+    marked consumed. Shard files outlive their manifest entry by one
+    save for exactly this fallback, so the resume replays from the
+    shards (no re-spill: _spill_intra is bombed) bit-identically."""
+    import sheep_tpu
+    from sheep_tpu import hierarchy
+
+    p = _hier_graph(tmp_path)
+    backend = STREAMING_BACKENDS[0]
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+    _truncate_mid_byte(str(tmp_path / "ck" / _manifest_data(ck)))
+    st = ck.load()  # previous step: nothing recursed yet
+    assert st is not None and st.chunk_idx == 0
+    capsys.readouterr()
+
+    def bomb(*a, **kw):
+        raise AssertionError("previous-step fallback re-spilled instead "
+                             "of reusing the retained shards")
+
+    monkeypatch.setattr(hierarchy, "_spill_intra", bomb)
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend=backend,
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_hier_boundary_checkpoint_without_chunk_support(tmp_path,
+                                                        monkeypatch):
+    """The pure backend cannot chunk-checkpoint (supports_checkpoint is
+    False), but hierarchy still gives it level-BOUNDARY recovery instead
+    of refusing the checkpointer outright."""
+    import sheep_tpu
+
+    p = _hier_graph(tmp_path)
+    expect = sheep_tpu.partition_hierarchical(p, [2, 2], backend="pure",
+                                              **HIER_KW)
+    ck = Checkpointer(str(tmp_path / "ck"), every=1)
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        sheep_tpu.partition_hierarchical(p, [2, 2], backend="pure",
+                                         checkpointer=ck, **HIER_KW)
+    monkeypatch.delenv(ENV_VAR)
+    assert ck.load() is not None
+
+    res = sheep_tpu.partition_hierarchical(p, [2, 2], backend="pure",
+                                           checkpointer=ck, resume=True,
+                                           **HIER_KW)
+    assert np.array_equal(res.assignment, expect.assignment)
+
+
+def test_cli_k_levels_checkpoint_resume(tmp_path, monkeypatch):
+    """The CLI drill: --k-levels + --checkpoint-dir killed at a level
+    boundary, resumed with --resume, written map identical to an
+    uninterrupted run's."""
+    from sheep_tpu import cli
+    from sheep_tpu.io import formats
+
+    p = _hier_graph(tmp_path)
+    base = ["--input", p, "--k-levels", "2,2", "--backend",
+            STREAMING_BACKENDS[0], "--refine", "1", "--chunk-edges",
+            str(CHUNK), "--no-comm-volume", "--json"]
+    out1 = str(tmp_path / "full.parts")
+    assert cli.main(base + ["--output", out1]) == 0
+
+    ckdir = str(tmp_path / "ck")
+    monkeypatch.setenv(ENV_VAR, "level:1")
+    with pytest.raises(InjectedFault):
+        cli.main(base + ["--checkpoint-dir", ckdir,
+                         "--checkpoint-every", "1"])
+    monkeypatch.delenv(ENV_VAR)
+
+    out2 = str(tmp_path / "resumed.parts")
+    assert cli.main(base + ["--checkpoint-dir", ckdir, "--resume",
+                            "--output", out2]) == 0
+    assert np.array_equal(formats.read_partition(out1),
+                          formats.read_partition(out2))
+
+
 def test_carry_checkpoint_gated_from_no_carry_resume(tmp_path, monkeypatch):
     """state_format distinguishes carry-mode checkpoints, so a checkpoint
     written with carry_tail=True refuses a carry_tail=False resume

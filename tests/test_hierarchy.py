@@ -150,11 +150,12 @@ def test_cli_k_levels(tmp_path, capsys):
     assert line["k"] == 4 and line["backend"].endswith("+hier[2, 2]")
     parts = formats.read_partition(out)
     assert parts.shape == (1 << 10,) and parts.max() < 4
-    # exclusions are clean usage errors
+    # exclusions are clean usage errors (--checkpoint-dir/--resume and
+    # multi-host now COMPOSE with --k-levels — ISSUE 8; the kill+resume
+    # drills live in tests/test_checkpoint.py)
     for argv in (["--input", p, "--k-levels", "2,2", "--k", "4"],
                  ["--input", p, "--k-levels", "2,x"],
-                 ["--input", p, "--k-levels", "2,2",
-                  "--checkpoint-dir", str(tmp_path)],
+                 ["--input", p, "--k-levels", "2,2", "--resume"],
                  # hierarchy-only flags are errors on the flat path
                  ["--input", p, "--k", "4", "--final-refine", "2"],
                  ["--input", p, "--k", "4", "--spill-dir", str(tmp_path)],

@@ -303,3 +303,62 @@ def test_multihost_resume_reconciles_one_step_skew(tmp_path):
     assert rcs == [0, 0], errs
     ref, expect_parent = _oracle()
     _check(outs, ref, expect_parent)
+
+
+HIER_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+addr, pid, nprocs, out_path, graph_path = sys.argv[1:6]
+from sheep_tpu import cli
+sys.exit(cli.main([
+    "--input", graph_path, "--k-levels", "2,2", "--backend", "tpu-sharded",
+    "--refine", "1", "--chunk-edges", "128", "--num-vertices", "512",
+    "--no-comm-volume", "--json", "--output", out_path,
+    "--coordinator", addr, "--num-processes", nprocs,
+    "--process-id", pid]))
+"""
+
+
+def test_hierarchy_multihost_level0_matches_single_process(tmp_path):
+    """--k-levels now composes with multi-host (ISSUE 8): level 0 runs
+    flat through the sharded backend across processes and the recursion
+    replays deterministically in lockstep on every process. Rank 0's
+    written map must equal a single-process hierarchical run (the forest
+    is backend-exact, so the cheap local backend is a valid oracle)."""
+    import sheep_tpu
+    from sheep_tpu.io import formats, generators
+
+    gp = str(tmp_path / "hier_g.edges")
+    formats.write_edges(gp, generators.rmat(9, 8, seed=21))
+    out_path = str(tmp_path / "hier.parts")
+
+    addr = f"127.0.0.1:{_free_port()}"
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("JAX_PLATFORMS", None)
+    procs, logs = [], []
+    for pid in range(2):
+        log_path = str(tmp_path / f"hier_log_{pid}.txt")
+        logs.append(log_path)
+        log_f = open(log_path, "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", HIER_WORKER, addr, str(pid), "2",
+             out_path, gp],
+            cwd=REPO, env=env, stdout=log_f, stderr=subprocess.STDOUT))
+    for p in procs:
+        try:
+            p.wait(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("hierarchy multihost worker timed out")
+    errs = [open(lg).read()[-2000:] for lg in logs]
+    assert [p.returncode for p in procs] == [0, 0], errs
+
+    local_be = "cpu" if "cpu" in sheep_tpu.list_backends() else "pure"
+    expect = sheep_tpu.partition_hierarchical(
+        gp, [2, 2], backend=local_be, refine=1, chunk_edges=128,
+        n_vertices=512, comm_volume=False)
+    got = formats.read_partition(out_path)
+    assert np.array_equal(got, np.asarray(expect.assignment)), errs

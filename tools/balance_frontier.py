@@ -1,11 +1,18 @@
 """Balance/cut frontier sweep (VERDICT r4 item 5): BETA in {1.1, 1.25,
 1.5, 2.0} (as alpha = BETA - 1) plus the alpha=1.0 default, across the
-eval graph families, cpu + tpu backends. Cut/balance are deterministic
-per config; walls are not recorded (sweeps run contended). Decides the
-default-alpha question with data -> tools/out/soak/balance_frontier.json
-and the BASELINE.md table."""
+eval graph families, cpu + tpu backends — plus the tpu-bigv row at the
+config-5 part count k=1024 (ROADMAP item 5: the committed bigv
+artifacts shipped balance ~1.97 from the alpha=1.0 default, the 2x
+envelope at its worst). Cut/balance are deterministic per config; walls
+are not recorded (sweeps run contended). Decides the default-alpha
+question with data -> tools/out/soak/balance_frontier.json and the
+BASELINE.md table."""
 import json, os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    # the bigv leg wants a multi-device (virtual) mesh; must precede jax init
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
 from sheep_tpu.utils.platform import pin_platform
 pin_platform("cpu")
 import sheep_tpu
@@ -38,6 +45,18 @@ def main():
                                  "cut_ratio": round(r.cut_ratio, 5),
                                  "balance": round(float(r.balance), 4)})
                     print(json.dumps(rows[-1]), flush=True)
+        if "tpu-bigv" in sheep_tpu.list_backends():
+            # the vertex-sharded frontier row at the config-5 part count
+            for aname, alpha in ALPHAS:
+                r = sheep_tpu.partition("rmat-hash:14:8:5", 1024,
+                                        backend="tpu-bigv", alpha=alpha,
+                                        comm_volume=False)
+                rows.append({"graph": "rmat-hash:14:8:5", "k": 1024,
+                             "backend": "tpu-bigv", "config": aname,
+                             "alpha": alpha,
+                             "cut_ratio": round(r.cut_ratio, 5),
+                             "balance": round(float(r.balance), 4)})
+                print(json.dumps(rows[-1]), flush=True)
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "out", "soak", "balance_frontier.json")
     with open(out, "w") as f:

@@ -76,6 +76,15 @@ def main():
                          "V-dominant regime (B >> Q) BASELINE.md "
                          "reserves hoisting for: squarings are paid "
                          "once per segment instead of every round")
+    ap.add_argument("--balance", type=float, default=None, metavar="BETA",
+                    help="guaranteed balance bound, threaded like the "
+                         "CLI's flat path: the host split runs at alpha "
+                         "= BETA - 1, delivering max part load <= BETA "
+                         "* total/k + max vertex weight. The committed "
+                         "k=1024 artifacts shipped balance ~1.97 from "
+                         "the alpha=1.0 default this flag replaces "
+                         "(ROADMAP item 5); the oracle leg runs at the "
+                         "same alpha so exact-equality checking holds")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="per-batch checkpointing via utils/checkpoint "
                          "(VERDICT r4 item 2: the s28 run needs to span "
@@ -89,6 +98,12 @@ def main():
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir (without it the "
                  "run would silently restart from scratch)")
+    alpha = 1.0
+    if args.balance is not None:
+        if args.balance <= 1.0:
+            ap.error("--balance must be > 1 (it bounds max part load "
+                     "at BETA * total/k)")
+        alpha = min(args.balance - 1.0, 1.0)
 
     nd = max(8, args.devices)
     os.environ["XLA_FLAGS"] = (
@@ -129,6 +144,8 @@ def main():
     result["segment_rounds"] = args.segment_rounds
     result["jumps"] = args.jumps
     result["hoist_bytes"] = args.hoist_bytes
+    result["balance_budget"] = args.balance
+    result["alpha"] = alpha
     ckpt = None
     if args.checkpoint_dir:
         from sheep_tpu.utils.checkpoint import Checkpointer
@@ -140,7 +157,7 @@ def main():
     big = get_backend(
         "tpu-bigv", chunk_edges=args.chunk_edges, jumps=args.jumps,
         segment_rounds=args.segment_rounds, n_devices=args.devices,
-        lift_levels=args.lift_levels,
+        lift_levels=args.lift_levels, alpha=alpha,
         hoist_bytes=args.hoist_bytes).partition(
             stream(), args.k, comm_volume=False,
             checkpointer=ckpt, resume=args.resume)
@@ -167,7 +184,8 @@ def main():
 
         assert native.available(), "native core needed for the oracle"
         t0 = time.perf_counter()
-        ref = get_backend("cpu", chunk_edges=args.chunk_edges).partition(
+        ref = get_backend("cpu", chunk_edges=args.chunk_edges,
+                          alpha=alpha).partition(
             stream(), args.k, comm_volume=False)
         result["native_oracle"] = {
             "wall_s": round(time.perf_counter() - t0, 1),
@@ -186,6 +204,10 @@ def main():
     # (ADVICE r4: a rerun at another D is a semantically different run
     # and must not clobber committed evidence)
     tag = "" if args.devices == 2 else f"_d{args.devices}"
+    if args.balance is not None:
+        # a balance-budgeted run is a different experiment; keep the
+        # default-alpha artifact (same ADVICE-r4 no-clobber rule as D)
+        tag += f"_b{args.balance:g}"
     out = os.path.join(REPO, "tools", "out", "soak",
                        f"bigv_s{args.scale}{tag}.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)

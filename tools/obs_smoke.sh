@@ -61,4 +61,34 @@ JAX_PLATFORMS=cpu SHEEP_SANITIZE=1 python -m sheep_tpu.cli \
 python tools/trace_report.py "$TRACE3" --check > "$OUT/report_sanitized.txt"
 grep -q "dispatch" "$OUT/report_sanitized.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3"
+# fourth leg: production survival (ISSUE 8) — a tiny --k-levels build
+# killed at a level boundary by SHEEP_FAULT_INJECT, then resumed from
+# its checkpoint into the SAME trace file. The resumed run must pass
+# the --check gate and the report must show the resume seam.
+TRACE4="$OUT/trace_resume.jsonl"
+CKPT4="$OUT/ckpt_resume"
+rm -rf "$TRACE4" "$CKPT4"
+# native cpu backend when built (no jit warm-up); tpu-on-cpu-jax otherwise
+BK=$(JAX_PLATFORMS=cpu python -c \
+    "from sheep_tpu import list_backends; bs = list_backends(); \
+     print('cpu' if 'cpu' in bs else 'tpu')")
+if JAX_PLATFORMS=cpu SHEEP_FAULT_INJECT=level:1 python -m sheep_tpu.cli \
+    --input rmat:9:8:1 --k-levels 2,2 --backend "$BK" --refine 1 \
+    --chunk-edges 512 --no-comm-volume \
+    --checkpoint-dir "$CKPT4" --checkpoint-every 1 \
+    --trace "$TRACE4" --heartbeat-secs 0.2 --json \
+    > /dev/null 2> "$OUT/fault.err"; then
+    echo "fault-injected run unexpectedly succeeded" >&2
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input rmat:9:8:1 --k-levels 2,2 --backend "$BK" --refine 1 \
+    --chunk-edges 512 --no-comm-volume \
+    --checkpoint-dir "$CKPT4" --resume \
+    --trace "$TRACE4" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_resume.json"
+python tools/trace_report.py "$TRACE4" --check > "$OUT/report_resume.txt"
+grep -q "resume:" "$OUT/report_resume.txt"
+grep -q '"event": "resume"' "$TRACE4"
+
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4"

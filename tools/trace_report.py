@@ -154,6 +154,12 @@ def parse_trace(path: str) -> dict:
             (e for e in events if e.get("event") == "backend_resolved"),
             None),
         "heartbeats": [e for e in events if e.get("event") == "heartbeat"],
+        # where a killed run restarted from its checkpoint, and any
+        # lossy recovery (corrupt step skipped) along the way — the
+        # other half of the dead-run forensics the UNCLOSED flags begin
+        "resumes": [e for e in events if e.get("event") == "resume"],
+        "degraded": [e for e in events
+                     if e.get("event") == "checkpoint_degraded"],
         "scores": [e for e in events if e.get("event") == "scores"],
         "counters": next((e for e in reversed(events)
                           if e.get("event") == "counters"), None),
@@ -316,6 +322,14 @@ def print_report(rep: dict, out) -> None:
                                            "eta_s") if last.get(k)
                 is not None]
         out.write(f"heartbeats: {len(hbs)}  last: {' '.join(bits)}\n")
+    for r in parsed["resumes"]:
+        bits = [f"{k}={r[k]}" for k in ("phase", "chunk_idx", "process")
+                if r.get(k) is not None]
+        out.write(f"resume: {' '.join(bits)} — this run restarted from "
+                  f"a checkpoint (the killed attempt is a previous run "
+                  f"in this file)\n")
+    for r in parsed["degraded"]:
+        out.write(f"checkpoint degraded: {r.get('message')}\n")
     cnt = parsed["counters"]
     if cnt:
         cs = {k: v for k, v in cnt.items() if k not in ("event", "ts")}
@@ -374,6 +388,8 @@ def main(argv=None) -> int:
                 "n_runs": rep["parsed"]["n_runs"],
                 "manifest": rep["parsed"]["manifest"],
                 "heartbeats": len(rep["parsed"]["heartbeats"]),
+                "resumes": rep["parsed"]["resumes"],
+                "degraded": rep["parsed"]["degraded"],
                 "unclosed": [n["name"] for n in rep["parsed"]["unclosed"]],
                 "counters": rep["parsed"]["counters"],
                 "check_failures": cf,
