@@ -292,8 +292,15 @@ def measure(scale: int, platform: str) -> dict:
     disp = {k: int(res_tpu.diagnostics[k])
             for k in ("host_syncs", "device_rounds", "batch_execs",
                       "dispatch_batch", "inflight_depth",
-                      "inflight_discards")
+                      "inflight_discards", "dispatch_retries",
+                      "degraded_dispatch_batch", "degraded_inflight",
+                      "device_loss_recoveries", "checkpoint_degraded")
             if k in res_tpu.diagnostics}
+    # fault-tolerance contract fields (ISSUE 9): ALWAYS emit
+    # dispatch_retries so the regression gate can see 0 -> N movement
+    # (a field missing on one side is incomparable, not zero)
+    disp.setdefault("dispatch_retries",
+                    int(res_tpu.diagnostics.get("dispatch_retries", 0)))
     if disp:
         log(f"dispatch counts (count x round-cost attribution): {disp}")
         out.update(disp)
@@ -476,7 +483,10 @@ def main():
     # link-quality swings without artifact archaeology
     for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est", "host_syncs",
               "device_rounds", "dispatch_batch", "inflight_depth",
-              "inflight_discards", "host_blocked_ms", "device_gap_ms"):
+              "inflight_discards", "host_blocked_ms", "device_gap_ms",
+              "dispatch_retries", "degraded_dispatch_batch",
+              "degraded_inflight", "device_loss_recoveries",
+              "checkpoint_degraded"):
         if f in result:
             extra[f] = result[f]
     if failures:

@@ -9,6 +9,25 @@ O(V + chunk), not O(E) — the edge stream is this workload's "long sequence"
 
 Binary files shard by byte offset (seek is free); text files stream
 line-blocks.
+
+Fault tolerance (ISSUE 9): physical reads run under a bounded retry
+policy (utils/retry.py — transient OSErrors back off and re-read, so
+one NFS blip doesn't kill an hours-long build), and binary streams are
+VALIDATED: a torn pair (file size not a multiple of the record size) or
+a short read (the file shrank under a live stream — "mid-stream EOF")
+is never silently folded into the forest. What happens instead is the
+``SHEEP_IO_POLICY`` contract:
+
+    strict      (default) raise :class:`CorruptStreamError` — the run
+                dies with a diagnosis instead of building a partition
+                of a graph that isn't the one on disk
+    quarantine  drop the torn tail / the missing remainder, emit a
+                ``chunk_quarantined`` trace event + stderr warning, and
+                continue over the intact prefix — the documented
+                degraded mode the chaos soak accepts
+
+Either way the result is quarantine-or-raise, never a wrong forest
+built from garbage bytes (tests/test_edgestream.py fuzz cases).
 """
 
 from __future__ import annotations
@@ -21,6 +40,58 @@ import numpy as np
 from sheep_tpu.io import formats
 
 DEFAULT_CHUNK_EDGES = 1 << 22  # 4M edges/chunk = 64 MB of u64 pairs
+
+IO_POLICY_ENV = "SHEEP_IO_POLICY"
+
+
+class CorruptStreamError(ValueError):
+    """Torn/corrupt/shrunken input detected under the strict IO policy."""
+
+
+def _io_policy() -> str:
+    v = os.environ.get(IO_POLICY_ENV, "strict") or "strict"
+    if v not in ("strict", "quarantine"):
+        raise ValueError(f"bad {IO_POLICY_ENV}={v!r}; "
+                         f"want 'strict' or 'quarantine'")
+    return v
+
+
+def _quarantine_or_raise(msg: str, **fields) -> None:
+    """Apply the IO policy to a detected corruption: raise (strict) or
+    warn + trace-event and let the caller continue (quarantine)."""
+    if _io_policy() == "strict":
+        raise CorruptStreamError(
+            msg + " (set SHEEP_IO_POLICY=quarantine to drop the "
+                  "damaged bytes and continue)")
+    import sys
+
+    print(f"edgestream quarantine: {msg}", file=sys.stderr)
+    from sheep_tpu import obs
+
+    obs.event("chunk_quarantined", message=msg, **fields)
+
+
+def _read_retry_policy():
+    """Read-side retry policy: same knobs as the device-side one, but a
+    fresh budget per stream pass (a pass that survives three separate
+    blips over a billion edges is healthy, not dying)."""
+    from sheep_tpu.utils.retry import RetryPolicy
+
+    return RetryPolicy()
+
+
+def _retrying(policy, fn, where: str):
+    """Run a physical read under the bounded TRANSIENT retry budget.
+    Non-transient errors (and an exhausted budget) propagate."""
+    from sheep_tpu.utils.retry import TRANSIENT, classify
+
+    while True:
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if classify(exc) != TRANSIENT or not policy.admit(TRANSIENT):
+                raise
+            policy.backoff(TRANSIENT, exc, where=where)
 
 
 class EdgeStream:
@@ -246,16 +317,53 @@ class EdgeStream:
                 yield e[off : off + chunk_edges]
 
     def _chunks_binary(self, chunk_edges, shard, num_shards, start_chunk):
+        from sheep_tpu.utils import fault
+
         dtype = np.dtype("<u4") if self.fmt == "bin32" else np.dtype("<u8")
         pair_bytes = 2 * dtype.itemsize
         total = self.num_edges
-        with open(self.path, "rb") as f:
+        policy = _read_retry_policy()
+        size = os.path.getsize(self.path)
+        if size % pair_bytes:
+            # torn trailing pair: num_edges floors it away, so without
+            # this check the damage would be SILENT truncation
+            _quarantine_or_raise(
+                f"{self.path}: {size} bytes is not a multiple of the "
+                f"{pair_bytes}-byte edge record ({size % pair_bytes} "
+                f"torn trailing bytes)",
+                path=self.path, torn_bytes=size % pair_bytes)
+        with _retrying(policy, lambda: open(self.path, "rb"),
+                       f"open {self.path}") as f:
+            reads = 0
             for idx, off in enumerate(range(0, total, chunk_edges)):
                 if not self._owns(idx, shard, num_shards, start_chunk):
                     continue
                 count = min(chunk_edges, total - off)
-                f.seek(off * pair_bytes)
-                flat = np.fromfile(f, dtype=dtype, count=2 * count)
+                reads += 1
+
+                def _read(off=off, count=count, reads=reads):
+                    fault.maybe_fail("read", reads, kinds=("read",))
+                    f.seek(off * pair_bytes)
+                    return np.fromfile(f, dtype=dtype, count=2 * count)
+
+                flat = _retrying(policy, _read,
+                                 f"read {self.path} chunk {idx}")
+                if len(flat) != 2 * count:
+                    # mid-stream EOF: the file shrank under us (or the
+                    # metadata lied). Never fold a half-read: keep the
+                    # intact pair prefix under quarantine, else raise.
+                    _quarantine_or_raise(
+                        f"{self.path}: short read at chunk {idx} "
+                        f"(wanted {count} edges at offset "
+                        f"{off * pair_bytes}, got {len(flat) // 2} "
+                        f"intact pairs) — stream truncated mid-pass",
+                        path=self.path, chunk=idx,
+                        expected=int(count), got=int(len(flat) // 2))
+                    flat = flat[: 2 * (len(flat) // 2)]
+                    if len(flat):
+                        yield flat.reshape(-1, 2).astype(np.int64,
+                                                         copy=False)
+                    return  # everything past the tear is gone
                 yield flat.reshape(-1, 2).astype(np.int64, copy=False)
 
     def _chunks_csr(self, chunk_edges, shard, num_shards, start_chunk):
@@ -303,11 +411,43 @@ class EdgeStream:
         copy of the subtle partial-line boundary handling (tail carry,
         consumed offset, EOF-without-trailing-newline). ``open_fn()``
         must return a binary file-like; ``parse(bytes)`` -> (edges,
-        consumed) is the shared block-parser contract."""
+        consumed) is the shared block-parser contract. Physical reads
+        run under the bounded transient-retry policy (module
+        docstring), with EXPLICIT repositioning before every read: a
+        failed buffered/gzip ``read`` may already have consumed raw
+        bytes (CPython discards data buffered by a mid-call error), so
+        a blind re-read would silently skip them — the seek to the
+        last consumed logical offset makes the retry sound (for
+        GzipFile a backward seek rewinds and re-decompresses, slow but
+        only on an actual retry). A non-seekable stream cannot
+        reposition, so its mid-stream reads are NOT retried — the
+        error propagates rather than risking a silent gap."""
+        from sheep_tpu.utils import fault
+
         tail = b""
-        with open_fn() as f:
+        policy = _read_retry_policy()
+        nblocks = 0
+        pos = 0  # logical (decompressed) offset of consumed bytes
+        with _retrying(policy, open_fn, "open text stream") as f:
+            try:
+                seekable = bool(f.seekable())
+            except Exception:
+                seekable = False
             while True:
-                block = f.read(1 << 24)
+                nblocks += 1
+
+                def _read(nblocks=nblocks, pos=pos):
+                    fault.maybe_fail("read", nblocks, kinds=("read",))
+                    if seekable:
+                        f.seek(pos)
+                    return f.read(1 << 24)
+
+                if seekable:
+                    block = _retrying(policy, _read,
+                                      f"read text block {nblocks}")
+                else:
+                    block = _read()
+                pos += len(block)
                 data = tail + block
                 if not data:
                     return

@@ -702,6 +702,8 @@ def fold_segments_pipelined(
     contract (as in :func:`fold_segments_batch`)."""
     from collections import deque
 
+    from sheep_tpu.utils import fault
+
     if inflight < 1:
         raise ValueError("inflight must be >= 1")
     if stats is None:
@@ -731,6 +733,13 @@ def fold_segments_pipelined(
         if state["idle_since"] is not None:
             _t_ms(stats, "device_gap_ms", now - state["idle_since"])
             state["idle_since"] = None
+        # dispatch-time injection point (ISSUE 9): a fault raised here
+        # unwinds the whole driver with the chain un-drained — exactly
+        # what a real allocation failure inside fold() does — so the
+        # backend-level retry/degrade wrapper sees the production shape
+        state["issued"] = state.get("issued", 0) + 1
+        fault.maybe_fail("dispatch", state["issued"],
+                         kinds=("oom", "device"))
         N = int(loB.shape[0])
         prevP = state["tipP"]
         lo2, hi2, P2, sv = fold(

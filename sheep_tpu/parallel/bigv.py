@@ -648,11 +648,39 @@ class BigVPipeline:
         from sheep_tpu.parallel.pipeline import (iter_batches_lockstep,
                                                  use_byte_range)
         from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils import retry as retry_mod
+        from sheep_tpu.utils import watchdog as wd_mod
         from sheep_tpu.utils.fault import maybe_fail
         from sheep_tpu.utils.prefetch import prefetch
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
+
+        # fault tolerance (ISSUE 9): bounded per-batch retry, single
+        # process only (a one-rank retry would desynchronize the
+        # collective schedules; multi-host keeps the checkpoint/
+        # kill+resume contract plus the stall watchdog). Sound because
+        # no bigv program donates its inputs: the pre-batch tables are
+        # intact after any fault, so re-folding the same batch is the
+        # identical computation.
+        policy = retry_mod.RetryPolicy()
+        # the per-chunk build point sits OUTSIDE _guarded (legacy kill
+        # semantics), so it must not offer kinds it cannot absorb —
+        # recoverable oom injection rides the "dispatch" point INSIDE
+        # the guarded step instead
+        bkinds = ("kill", "stall")
+        okinds = ("oom",) if self.procs == 1 else ()
+
+        def _guarded(fn, where, stats=None):
+            if self.procs > 1:
+                return fn()
+            before = sum(policy.attempts.values())
+            out = policy.run(fn, where=where)
+            grew = sum(policy.attempts.values()) - before
+            if grew and stats is not None:
+                stats["dispatch_retries"] = \
+                    stats.get("dispatch_retries", 0) + grew
+            return out
 
         def batches(start_chunk=0):
             return prefetch(iter_batches_lockstep(
@@ -709,13 +737,15 @@ class BigVPipeline:
             since = nb = 0
             # with-exit = deterministic prefetch-worker cancel on
             # exception unwind (utils/prefetch.py close contract)
-            with batches(start) as pf:
+            with wd_mod.watched(self.procs, "bigv-degrees",
+                                self.proc) as wd, batches(start) as pf:
                 for batch in pf:
                     deg_sh = self.deg_step(deg_sh, self._put(
                         self.batch_sharding, batch))
                     since += 1
                     nb += 1
-                    maybe_fail("degrees", nb)
+                    wd.touch(f"degrees batch {nb}")
+                    maybe_fail("degrees", nb, kinds=("kill", "stall"))
                     obs.chunk_progress(nb * d, cs, m_cheap)
                     at_ckpt = (checkpointer is not None and
                                checkpointer.due_span((nb - 1) * d, nb * d))
@@ -764,19 +794,33 @@ class BigVPipeline:
                 P_sh = self._shard_table(np.full(n + 1, n, np.int32))
                 start = 0
             nb = 0
-            with batches(start) as pf:
+            with wd_mod.watched(self.procs, "bigv-build",
+                                self.proc) as wd, batches(start) as pf:
                 for batch in pf:
                     seg_sp = obs.begin("segment", i=nb)
-                    P_sh, rounds = self.build_step(
-                        P_sh, pos_sh,
-                        self._put(self.batch_sharding, batch),
-                        stats=build_stats)
-                    total_rounds += rounds
+
+                    def _step(b=batch, i=nb):
+                        maybe_fail("dispatch", i + 1, kinds=okinds)
+                        return self.build_step(
+                            P_sh, pos_sh,
+                            self._put(self.batch_sharding, b),
+                            stats=build_stats)
+
+                    try:
+                        P_sh, rounds = _guarded(_step, "bigv.build",
+                                                stats=build_stats)
+                        total_rounds += rounds
+                        stats_acc.absorb(build_stats)
+                        seg_sp.end(rounds=int(rounds))
+                    finally:
+                        # idempotent: balances the span when a fault
+                        # unwinds mid-batch (recovered runs must still
+                        # render a complete tree)
+                        seg_sp.end()
                     nb += 1
-                    stats_acc.absorb(build_stats)
-                    seg_sp.end(rounds=int(rounds))
+                    wd.touch(f"build batch {nb}")
                     obs.chunk_progress(nb * d, cs, m_cheap)
-                    maybe_fail("build", nb)
+                    maybe_fail("build", nb, kinds=bkinds)
                     if checkpointer is not None and \
                             checkpointer.due_span((nb - 1) * d, nb * d):
                         checkpointer.save(
@@ -824,7 +868,8 @@ class BigVPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         nb = 0
-        with batches(start) as pf:
+        with wd_mod.watched(self.procs, "bigv-score",
+                            self.proc) as wd, batches(start) as pf:
             for batch in pf:
                 # designed per-batch score pull (two scalars)
                 c, tt = np.asarray(self.score_step(  # sheeplint: sync-ok
@@ -837,7 +882,8 @@ class BigVPipeline:
                         score_ops.cut_pair_keys_host(batch, assign_np,
                                                      n, k))
                 nb += 1
-                maybe_fail("score", nb)
+                wd.touch(f"score batch {nb}")
+                maybe_fail("score", nb, kinds=("kill", "stall"))
                 obs.chunk_progress(nb * d, cs, m_cheap)
                 if checkpointer is not None and \
                         checkpointer.due_span((nb - 1) * d, nb * d):

@@ -520,6 +520,7 @@ class ShardedPipeline:
         from collections import deque
 
         from sheep_tpu.ops.elim import _seed_ms_counters, _t_ms
+        from sheep_tpu.utils import fault
 
         loB, hiB = self.orient_batch_step(blocks_dev, pos)
         fold = self.fold_batch_step_donated if self.donate \
@@ -529,9 +530,18 @@ class ShardedPipeline:
         tip = (P_all, loB, hiB)
         fifo: deque = deque()
         idle_since = None
+        issued = {"n": 0}
 
         def issue():
             nonlocal tip, idle_since
+            # dispatch-time injection point (ISSUE 9): unwinds the whole
+            # group with the donated chain un-drained, like a real
+            # allocation failure inside fold(); recoverable kinds only
+            # single-process (a one-rank retry would skew collectives)
+            issued["n"] += 1
+            fault.maybe_fail(
+                "dispatch", issued["n"],
+                kinds=("oom", "device") if self.procs == 1 else ())
             if idle_since is not None and stats is not None:
                 _t_ms(stats, "device_gap_ms",
                       time.perf_counter() - idle_since)
@@ -750,11 +760,14 @@ class ShardedPipeline:
         from sheep_tpu.ops import score as score_ops
         from sheep_tpu.ops.split import tree_split_host
         from sheep_tpu.utils import checkpoint as ckpt
+        from sheep_tpu.utils import retry as retry_mod
+        from sheep_tpu.utils import watchdog as wd_mod
         from sheep_tpu.utils.fault import maybe_fail
         from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
+        ckpt_degraded0 = ckpt.degraded_events()
         meta = ckpt.stream_meta(stream, k, cs, weights=weights, alpha=alpha,
                                 comm_volume=comm_volume,
                                 state_format="sharded", devices=d,
@@ -794,15 +807,18 @@ class ShardedPipeline:
             start = state.chunk_idx if state else 0
             deg_all = self.init_degrees()
             since = batches = 0
-            with prefetch(self.iter_batches(stream,
-                                            start_chunk=start)) as pf:
+            with wd_mod.watched(self.procs, "sharded-degrees",
+                                self.proc) as wd, \
+                    prefetch(self.iter_batches(stream,
+                                               start_chunk=start)) as pf:
                 # with-exit = deterministic worker cancel on exception
                 # unwind (fault injection, checkpoint IO)
                 for batch in pf:
                     deg_all = self.deg_step(deg_all, self.put_batch(batch))
                     since += 1
                     batches += 1
-                    maybe_fail("degrees", batches)
+                    wd.touch(f"degrees batch {batches}")
+                    maybe_fail("degrees", batches, kinds=("kill", "stall"))
                     obs.chunk_progress(batches * d, cs, m_cheap)
                     # cadence is in *chunks* (one batch = d chunks),
                     # matching the single-device backends and the
@@ -843,95 +859,177 @@ class ShardedPipeline:
         obs.progress(phase="build", chunks_done=0, edges_done=0)
         merge_stats: dict = {}
         build_stats: dict = {}
+        # fault kinds the per-batch injection points can absorb: the
+        # in-process retry below only runs single-process (a one-rank
+        # retry would desynchronize the collective schedules), so chaos
+        # only offers the recoverable kinds there; multi-host points
+        # offer kill (the PR-8 contract) and stall (the watchdog's prey)
+        bkinds = ("kill", "oom", "device", "stall") if self.procs == 1 \
+            else ("kill", "stall")
         if state and from_phase >= 2:
             merged_minp = jnp.asarray(state.arrays["merged"])
         else:
+            # fault-tolerant build (ISSUE 9): one retryable attempt
+            # against an in-memory snapshot — the merged O(V) forest +
+            # next chunk index, exactly a checkpoint's payload, banked
+            # at every save. Build checkpoints store the O(V) *merged*
+            # forest, not the O(V*d) per-device stack; merging is
+            # associative and idempotent, so re-seeding one shard with
+            # it (others empty) reproduces the identical fixpoint.
+            # Multi-host: each process provides its local rows; the
+            # merged forest rides in global row 0 (process 0).
+            snap = {"idx": 0, "merged": None}
             if state and state.phase == "build":
-                # build checkpoints store the O(V) *merged* forest, not the
-                # O(V*d) per-device stack; merging is associative and
-                # idempotent, so re-seeding one shard with it (others
-                # empty) reproduces the identical fixpoint. Multi-host:
-                # each process provides its local rows; the merged forest
-                # rides in global row 0 (process 0).
+                snap["idx"] = state.chunk_idx
+                snap["merged"] = state.arrays["merged_partial"]
+
+            def _build_attempt():
                 rows = self.n_local
                 fa = np.full((rows, n + 1), n, np.int32)
-                if self.proc == 0:
-                    # vertex-space checkpoint -> position space, host-side
-                    # (no device round-trip, no eager op on a global array)
+                if snap["merged"] is not None and self.proc == 0:
+                    # vertex-space snapshot -> position space, host-side
+                    # (no device round-trip, no eager op on a global
+                    # array)
                     fa[0] = np.asarray(  # sheeplint: sync-ok
-                        state.arrays["merged_partial"],
+                        snap["merged"],
                         dtype=np.int32)[np.asarray(order)]  # sheeplint: sync-ok
                 P_all = self._put(self.state_sharding, fa)
-                start = state.chunk_idx
-            else:
-                P_all = self.init_forest()
-                start = 0
-            batches = 0
-            if self.dispatch_batch > 1 or self.inflight > 1:
-                # batched segment dispatch: stage dispatch_batch sharded
-                # batches as one (rows, N, C, 2) block per process —
-                # the prefetch worker groups the lockstep batch stream,
-                # so every process stages identical groups and the
-                # pmin'd stats keep the collective schedules aligned
-                nb = self.dispatch_batch
-                build_stats["dispatch_batch"] = nb
-                build_stats["inflight_depth"] = self.inflight
-                empty = None
-                # with-exit = deterministic worker cancel on an
-                # exception unwind (fault injection, checkpoint IO),
-                # as in _device_chunk_groups
-                with prefetch_batched(
-                        self.iter_batches(stream, start_chunk=start),
-                        nb) as pf:
-                    for group in pf:
-                        gl = len(group)
-                        if gl < nb:
-                            if empty is None:
-                                empty = np.full((self.n_local, cs, 2), n,
-                                                np.int32)
-                            group = group + [empty] * (nb - gl)
-                        blocks = np.stack(group, axis=1)
-                        before = batches
-                        dsp = obs.begin("dispatch", i=before, batches=gl)
-                        P_all = self.build_step_batch(
-                            P_all,
-                            self._put(self.block_edges_sharding, blocks),
-                            pos, stats=build_stats)
-                        batches += gl
-                        stats_acc.absorb(build_stats)
-                        dsp.end()
-                        obs.chunk_progress(batches * d, cs, m_cheap)
-                        for b in range(before + 1, batches + 1):
-                            maybe_fail("build", b)
-                        if checkpointer is not None and \
-                                checkpointer.due_span(before * d, batches * d):
-                            partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
-                                self.merge(P_all, stats=merge_stats), pos))
-                            checkpointer.save(
-                                "build", start + batches * d,
-                                {"deg": deg_host, "merged_partial": partial},
-                                meta)
-            else:
-                with prefetch(self.iter_batches(
-                        stream, start_chunk=start)) as pf:
-                    for batch in pf:
-                        seg_sp = obs.begin("segment", i=batches)
-                        P_all = self.build_step(P_all,
-                                                self.put_batch(batch), pos)
-                        batches += 1
-                        seg_sp.end()
-                        obs.chunk_progress(batches * d, cs, m_cheap)
-                        maybe_fail("build", batches)
-                        if checkpointer is not None and \
-                                checkpointer.due_span((batches - 1) * d,
-                                                      batches * d):
-                            partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
-                                self.merge(P_all, stats=merge_stats), pos))
-                            checkpointer.save(
-                                "build", start + batches * d,
-                                {"deg": deg_host,
-                                 "merged_partial": partial},
-                                meta)
+                start = snap["idx"]
+                batches = 0
+                with wd_mod.watched(self.procs, "sharded-build",
+                                    self.proc) as wd:
+                    if self.dispatch_batch > 1 or self.inflight > 1:
+                        # batched segment dispatch: stage dispatch_batch
+                        # sharded batches as one (rows, N, C, 2) block
+                        # per process — the prefetch worker groups the
+                        # lockstep batch stream, so every process stages
+                        # identical groups and the pmin'd stats keep the
+                        # collective schedules aligned
+                        nb = self.dispatch_batch
+                        build_stats["dispatch_batch"] = nb
+                        build_stats["inflight_depth"] = self.inflight
+                        empty = None
+                        # with-exit = deterministic worker cancel on an
+                        # exception unwind (fault injection, checkpoint
+                        # IO), as in _device_chunk_groups
+                        with prefetch_batched(
+                                self.iter_batches(stream,
+                                                  start_chunk=start),
+                                nb) as pf:
+                            for group in pf:
+                                gl = len(group)
+                                if gl < nb:
+                                    if empty is None:
+                                        empty = np.full(
+                                            (self.n_local, cs, 2), n,
+                                            np.int32)
+                                    group = group + [empty] * (nb - gl)
+                                blocks = np.stack(group, axis=1)
+                                before = batches
+                                dsp = obs.begin("dispatch", i=before,
+                                                batches=gl)
+                                try:
+                                    P_all = self.build_step_batch(
+                                        P_all,
+                                        self._put(
+                                            self.block_edges_sharding,
+                                            blocks),
+                                        pos, stats=build_stats)
+                                finally:
+                                    stats_acc.absorb(build_stats)
+                                    dsp.end()
+                                batches += gl
+                                wd.touch(f"build batch {batches}")
+                                obs.chunk_progress(batches * d, cs,
+                                                   m_cheap)
+                                for b in range(before + 1, batches + 1):
+                                    maybe_fail("build", b, kinds=bkinds)
+                                if checkpointer is not None and \
+                                        checkpointer.due_span(
+                                            before * d, batches * d):
+                                    partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
+                                        self.merge(P_all,
+                                                   stats=merge_stats),
+                                        pos))
+                                    snap["idx"] = start + batches * d
+                                    snap["merged"] = partial
+                                    checkpointer.save(
+                                        "build", start + batches * d,
+                                        {"deg": deg_host,
+                                         "merged_partial": partial},
+                                        meta)
+                    else:
+                        with prefetch(self.iter_batches(
+                                stream, start_chunk=start)) as pf:
+                            for batch in pf:
+                                seg_sp = obs.begin("segment", i=batches)
+                                try:
+                                    P_all = self.build_step(
+                                        P_all, self.put_batch(batch),
+                                        pos)
+                                finally:
+                                    seg_sp.end()
+                                batches += 1
+                                wd.touch(f"build batch {batches}")
+                                obs.chunk_progress(batches * d, cs,
+                                                   m_cheap)
+                                maybe_fail("build", batches,
+                                           kinds=bkinds)
+                                if checkpointer is not None and \
+                                        checkpointer.due_span(
+                                            (batches - 1) * d,
+                                            batches * d):
+                                    partial = np.asarray(self.to_minp(  # sheeplint: sync-ok
+                                        self.merge(P_all,
+                                                   stats=merge_stats),
+                                        pos))
+                                    snap["idx"] = start + batches * d
+                                    snap["merged"] = partial
+                                    checkpointer.save(
+                                        "build", start + batches * d,
+                                        {"deg": deg_host,
+                                         "merged_partial": partial},
+                                        meta)
+                return P_all
+
+            def _on_resource():
+                nxt = retry_mod.degrade_dispatch(
+                    n, cs, self.dispatch_batch, self.inflight,
+                    self.donate, build_stats, snap["idx"])
+                if nxt is not None:
+                    self.dispatch_batch, self.inflight = nxt
+
+            def _save_snapshot():
+                if checkpointer is not None and \
+                        snap["merged"] is not None:
+                    checkpointer.save(
+                        "build", snap["idx"],
+                        {"deg": deg_host,
+                         "merged_partial": snap["merged"]}, meta)
+
+            def _on_device_loss():
+                retry_mod.recover_device_loss(build_stats, snap["idx"],
+                                              _save_snapshot)
+
+            policy = retry_mod.RetryPolicy()
+            while True:
+                try:
+                    P_all = _build_attempt()
+                    break
+                except Exception as exc:
+                    if self.procs > 1:
+                        # a one-rank in-process retry would skew the
+                        # collective schedules: multi-host keeps the
+                        # fault->checkpoint->kill+resume contract
+                        raise
+                    # shared classify/budget/count/backoff protocol
+                    # (retry.handle_build_fault); FATAL and exhausted
+                    # budgets re-raise inside
+                    retry_mod.handle_build_fault(
+                        policy, exc, "sharded.build", build_stats,
+                        on_resource=_on_resource,
+                        on_device_loss=_on_device_loss)
+                    stats_acc.absorb(build_stats)
             msp = obs.begin("merge", devices=int(d))
             merged_minp = self.to_minp(
                 self.merge(P_all, stats=merge_stats), pos)
@@ -970,7 +1068,10 @@ class ShardedPipeline:
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         batches = 0
-        with prefetch(self.iter_batches(stream, start_chunk=start)) as pf:
+        with wd_mod.watched(self.procs, "sharded-score",
+                            self.proc) as wd, \
+                prefetch(self.iter_batches(stream,
+                                           start_chunk=start)) as pf:
             for batch in pf:
                 dev_batch = self.put_batch(batch)
                 c, tt = np.asarray(  # sheeplint: sync-ok
@@ -982,7 +1083,8 @@ class ShardedPipeline:
                         cv_chunks,
                         score_ops.cut_pair_keys_host(batch, assign, n, k))
                 batches += 1
-                maybe_fail("score", batches)
+                wd.touch(f"score batch {batches}")
+                maybe_fail("score", batches, kinds=("kill", "stall"))
                 obs.chunk_progress(batches * d, cs, m_cheap)
                 if checkpointer is not None and \
                         checkpointer.due_span((batches - 1) * d,
@@ -1016,6 +1118,9 @@ class ShardedPipeline:
         root_sp.end()
         if checkpointer is not None:
             checkpointer.clear()
+        if ckpt.degraded_events() > ckpt_degraded0:
+            build_stats["checkpoint_degraded"] = \
+                ckpt.degraded_events() - ckpt_degraded0
         return {
             "assignment": assign_host, "parent": parent, "pos": pos_host,
             "degrees": deg_host, "edge_cut": cut, "total_edges": total,

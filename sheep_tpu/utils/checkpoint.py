@@ -44,11 +44,24 @@ FORMAT_VERSION = 3
 PHASES = ("degrees", "build", "score", "hier")
 
 
+# process-wide count of degraded recoveries, surfaced by the backends
+# as the `checkpoint_degraded` diagnostic so silent degradation shows
+# up in the perf trajectory (bench contract info field, ISSUE 9)
+_DEGRADED_EVENTS = 0
+
+
+def degraded_events() -> int:
+    """How many checkpoint recoveries degraded in this process so far."""
+    return _DEGRADED_EVENTS
+
+
 def _warn(msg: str) -> None:
     """Degradation warning: stderr + a trace event (no-op untraced), so
     a resumed production run records that recovery was lossy."""
     import sys
 
+    global _DEGRADED_EVENTS
+    _DEGRADED_EVENTS += 1
     print(f"checkpoint warning: {msg}", file=sys.stderr)
     from sheep_tpu import obs
 

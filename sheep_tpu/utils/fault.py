@@ -1,37 +1,64 @@
 """Fault injection (SURVEY.md §5 "Failure detection / fault injection").
 
-A test hook that kills the pipeline mid-stream, exercising the
-checkpoint/resume recovery path. Enabled via the environment variable
+A test hook that injects faults into the pipeline mid-stream, exercising
+the checkpoint/resume recovery path (PR 8) and the in-process
+fault-tolerance layer (ISSUE 9: utils/retry.py). Enabled via the
+environment variable ``SHEEP_FAULT_INJECT``, three grammars:
+
+**Kill at a deterministic point (legacy, PR-8 drills)**::
 
     SHEEP_FAULT_INJECT="<phase>:<count>"      e.g. "build:3"
 
-which makes the named phase raise :class:`InjectedFault` after processing
-that many chunks. The recovery tests (tests/test_checkpoint.py) inject a
-fault, catch it, then resume from the last checkpoint and assert the final
-partition is identical to an uninterrupted run — the mergeable-forest
-property that makes chunk-level restart sound.
+makes the named phase raise :class:`InjectedFault` after processing that
+many chunks — and on EVERY later call, so a caught-and-ignored fault
+cannot silently continue (the recovery tests catch it, clear the env,
+then resume from the last checkpoint). ``<phase>`` may also name an
+enclosing :func:`scope` ("level0:3", "level:1" — the hierarchy
+granularities of PR 8).
 
-Hierarchy phases (ISSUE 8): ``<phase>`` may also name an enclosing
-:func:`scope` instead of the streaming phase itself —
+**Typed fault at a deterministic point (ISSUE 9 pinned tests)**::
 
-    SHEEP_FAULT_INJECT="level0:3"   # inside hierarchy level 0, after 3
-                                    # chunks of whatever inner phase is
-                                    # streaming (the flat partition of
-                                    # level 0 runs under scope "level0")
-    SHEEP_FAULT_INJECT="level:1"    # after 1 completed level-boundary
-                                    # (hierarchy.py reports each part's
-                                    # completion as phase "level")
+    SHEEP_FAULT_INJECT="<kind>@<phase>:<count>[:<shots>]"
+                                                   e.g. "oom@dispatch:2"
 
-so kill+resume drills can target the hierarchical driver at both of its
-recovery granularities (chunk-level inside level 0, level-boundary for
-the recursion).
+raises the kind's exception at the first call where the count is
+reached, at most ``shots`` times per process (default 1 — unlike the
+kill grammar these faults are *handled* in-process, and re-raising
+forever at the same point would defeat the retry the injection exists
+to exercise; shots > 1 drills REPEATED faults, e.g. two OOMs forcing
+two degradation steps). Kinds:
+
+    oom      :class:`InjectedResourceExhausted`  (fault_class=resource)
+    device   :class:`InjectedDeviceLoss`         (fault_class=device_loss)
+    read     :class:`InjectedReadError`          (OSError; transient)
+    kill     :class:`InjectedFault`              (fatal — like legacy)
+    stall    no exception: sleeps ``STALL_S`` seconds at the point — the
+             slow-peer emulation that ages heartbeat/watchdog clocks
+             without wedging the test process
+
+**Randomized chaos schedule (tools/chaos_soak.py)**::
+
+    SHEEP_FAULT_INJECT="chaos:<seed>[:<budget>[:<rate>]]"
+
+arms a seeded RNG over every injection point: each point draws, and
+with probability ``rate`` (default 0.08) injects one fault drawn from
+the kinds that point declared, until ``budget`` faults (default 2) have
+fired. Deterministic given the seed and the (deterministic) call
+sequence; each injection emits a ``chaos_inject`` trace event so the
+soak runner can audit what actually fired.
+
+Phase names are injection POINTS, not just streaming phases: the
+batched dispatch drivers report phase "dispatch" per issued execution,
+edge readers report phase "read" per physical read, and the classic
+per-chunk sites keep their phase names ("degrees"/"build"/"score").
 """
 
 from __future__ import annotations
 
 import os
+import random
 from contextlib import contextmanager
-from typing import List
+from typing import Dict, List, Tuple
 
 ENV_VAR = "SHEEP_FAULT_INJECT"
 
@@ -40,9 +67,68 @@ ENV_VAR = "SHEEP_FAULT_INJECT"
 # single-threaded test hook, never armed in production runs
 _SCOPES: List[str] = []
 
+# shots-consumed state for the typed grammar, keyed by spec; re-armed
+# on an observed env TRANSITION (maybe_fail sees a different value than
+# last time, including unset) and by the explicit reset() test helper —
+# keying alone would leave a re-set identical spec permanently consumed
+_CONSUMED: Dict[str, int] = {}
+
+# chaos schedule state, keyed by spec (seed change -> fresh schedule;
+# same transition/reset re-arming as _CONSUMED)
+_CHAOS: Dict[str, dict] = {}
+
+_LAST_SPEC: List = [None]
+
+
+def reset() -> None:
+    """Forget all consumed-shot and chaos-schedule state, re-arming
+    whatever spec is (or will be) in the environment. Test helper —
+    production runs arm one spec per process and never need it."""
+    _CONSUMED.clear()
+    _CHAOS.clear()
+    _LAST_SPEC[0] = None
+
+CHAOS_DEFAULT_BUDGET = 2
+CHAOS_DEFAULT_RATE = 0.08
+
 
 class InjectedFault(RuntimeError):
-    """Raised by the injection hook; never raised in production runs."""
+    """Kill-style injected fault; never raised in production runs. The
+    retry layer classifies it FATAL — it exists to kill the process so
+    the checkpoint/resume drills stay honest."""
+
+    fault_class = "fatal"
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Injected RESOURCE_EXHAUSTED-class fault: same retry-layer path as
+    a real XLA 'RESOURCE_EXHAUSTED: ...' allocation failure."""
+
+    fault_class = "resource"
+
+
+class InjectedDeviceLoss(RuntimeError):
+    """Injected device-loss-class fault: snapshot + reinit + resume."""
+
+    fault_class = "device_loss"
+
+
+class InjectedReadError(OSError):
+    """Injected transient read failure (an OSError, like the real
+    thing): the edgestream's bounded read retry absorbs it."""
+
+    fault_class = "transient"
+
+
+_KINDS = {
+    "kill": InjectedFault,
+    "oom": InjectedResourceExhausted,
+    "device": InjectedDeviceLoss,
+    "read": InjectedReadError,
+    "stall": None,  # sleeps instead of raising (slow-peer emulation)
+}
+
+STALL_S = 0.5
 
 
 @contextmanager
@@ -57,25 +143,115 @@ def scope(name: str):
         _SCOPES.pop()
 
 
-def _parse(spec: str):
-    phase, _, count = spec.partition(":")
+def _parse(spec: str) -> Tuple[str, str, int, int]:
+    """spec -> (kind, phase, count, shots); kind '' = legacy grammar."""
+    head, _, count = spec.partition(":")
+    kind, at, phase = head.partition("@")
+    if not at:
+        kind, phase = "", head
+    elif kind not in _KINDS:
+        raise ValueError(f"bad {ENV_VAR} kind {kind!r}; "
+                         f"want one of {sorted(_KINDS)}")
+    count, _, shots = count.partition(":")
     try:
-        return phase, int(count)
+        return kind, phase, int(count), int(shots) if shots else 1
     except ValueError:
-        raise ValueError(f"bad {ENV_VAR} spec {spec!r}; want '<phase>:<int>'")
+        raise ValueError(f"bad {ENV_VAR} spec {spec!r}; want "
+                         f"'[kind@]<phase>:<int>[:<shots>]' or "
+                         f"'chaos:<seed>'")
 
 
-def maybe_fail(phase: str, chunks_done: int) -> None:
-    """Raise InjectedFault iff the env hook targets this phase (or an
-    enclosing scope) and count."""
+def _raise_kind(kind: str, msg: str):
+    if kind == "stall":
+        import time
+
+        time.sleep(STALL_S)
+        return
+    exc_type = _KINDS[kind]
+    if kind == "oom":
+        # carry the real-world status string so pattern-based
+        # classification (not just the fault_class attr) is exercised
+        raise exc_type(f"RESOURCE_EXHAUSTED (injected): {msg}")
+    raise exc_type(f"injected {kind} fault: {msg}")
+
+
+def _chaos_state(spec: str) -> dict:
+    st = _CHAOS.get(spec)
+    if st is None:
+        parts = spec.split(":")
+        try:
+            seed = int(parts[1])
+            budget = int(parts[2]) if len(parts) > 2 \
+                else CHAOS_DEFAULT_BUDGET
+            rate = float(parts[3]) if len(parts) > 3 \
+                else CHAOS_DEFAULT_RATE
+        except (IndexError, ValueError):
+            raise ValueError(f"bad {ENV_VAR} spec {spec!r}; want "
+                             f"'chaos:<seed>[:<budget>[:<rate>]]'")
+        st = _CHAOS[spec] = {"rng": random.Random(seed),
+                             "budget": budget, "rate": rate,
+                             "points": 0, "injected": 0}
+    return st
+
+
+def _maybe_chaos(spec: str, phase: str, kinds: Tuple[str, ...]) -> None:
+    st = _chaos_state(spec)
+    st["points"] += 1
+    if st["injected"] >= st["budget"]:
+        return
+    # draw even when this point offers no kinds we can pick (keeps the
+    # point sequence — and thus the schedule — stable as call sites
+    # gain or lose kind capabilities)
+    r = st["rng"].random()
+    pick = st["rng"].randrange(len(kinds)) if kinds else 0
+    if r >= st["rate"] or not kinds:
+        return
+    kind = kinds[pick]
+    st["injected"] += 1
+    from sheep_tpu import obs
+
+    obs.event("chaos_inject", kind=kind, phase=phase,
+              point=st["points"], injected=st["injected"],
+              budget=st["budget"])
+    _raise_kind(kind, f"chaos point {st['points']} in phase {phase!r}")
+
+
+def maybe_fail(phase: str, chunks_done: int,
+               kinds: Tuple[str, ...] = ("kill",)) -> None:
+    """Injection point: raise per the armed ``SHEEP_FAULT_INJECT`` spec
+    iff it targets this phase (or an enclosing scope) and count.
+    ``kinds`` declares which fault kinds this call site can absorb —
+    chaos schedules only draw from them (a reader can't OOM the device;
+    a dispatch loop can't tear a file read)."""
     spec = os.environ.get(ENV_VAR)
+    if spec != _LAST_SPEC[0]:
+        # env transition observed: a newly-(re)armed spec starts with
+        # fresh shot/schedule state
+        _LAST_SPEC[0] = spec
+        if spec:
+            _CONSUMED.pop(spec, None)
+            _CHAOS.pop(spec, None)
     if not spec:
         return
-    target_phase, target_count = _parse(spec)
+    if spec.startswith("chaos:"):
+        _maybe_chaos(spec, phase, kinds)
+        return
+    kind, target_phase, target_count, shots = _parse(spec)
     if target_phase != phase and target_phase not in _SCOPES:
         return
-    if chunks_done >= target_count:
-        raise InjectedFault(
-            f"injected fault in phase {phase!r}"
-            + (f" (scope {target_phase!r})" if target_phase != phase else "")
-            + f" after {chunks_done} chunks")
+    if chunks_done < target_count:
+        return
+    where = (f"phase {phase!r}"
+             + (f" (scope {target_phase!r})" if target_phase != phase
+                else "")
+             + f" after {chunks_done} chunks")
+    if not kind:  # legacy kill grammar: raises on every later call too
+        raise InjectedFault(f"injected fault in {where}")
+    if _CONSUMED.get(spec, 0) >= shots:  # typed grammar: bounded shots
+        return
+    _CONSUMED[spec] = _CONSUMED.get(spec, 0) + 1
+    from sheep_tpu import obs
+
+    obs.event("fault_inject", kind=kind, phase=phase,
+              chunks_done=int(chunks_done))
+    _raise_kind(kind, where)

@@ -103,6 +103,38 @@ def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
     return best
 
 
+def degraded_dispatch(n: int, chunk_edges: int, dispatch_batch: int,
+                      inflight: int, donate: bool = False):
+    """One RESOURCE_EXHAUSTED degradation step for the dispatch drivers
+    (ISSUE 9): halve ``dispatch_batch`` or ``inflight`` — whichever
+    frees MORE modeled bytes per the build-phase HBM model above — and
+    return the new ``(dispatch_batch, inflight)`` pair, or ``None`` when
+    both are already 1 (nothing left to shed; the caller falls back to
+    a plain retry, then to the checkpoint/kill+resume contract).
+
+    Reusing :func:`build_phase_bytes` instead of a fixed halving order
+    keeps the degrade schedule consistent with the auto-sizing rule
+    (:func:`dispatch_batch_for`): the knob that the model says holds the
+    most staging is the knob an OOM most plausibly indicts."""
+    batch, depth = max(1, int(dispatch_batch)), max(1, int(inflight))
+    if batch <= 1 and depth <= 1:
+        return None
+
+    def total(b, d):
+        return build_phase_bytes(n, chunk_edges, dispatch_batch=b,
+                                 inflight=d, donate=donate)["total_bytes"]
+
+    cand = []
+    if batch > 1:
+        cand.append((total(batch // 2, depth), (batch // 2, depth)))
+    if depth > 1:
+        cand.append((total(batch, depth // 2), (batch, depth // 2)))
+    # smallest modeled footprint wins; ties prefer halving the batch
+    # (listed first), which keeps the pipeline depth — and its overlap —
+    # alive longest
+    return min(cand, key=lambda c: c[0])[1]
+
+
 def max_vertices_for(hbm_bytes: int, chunk_edges: int) -> int:
     """Largest power-of-2 vertex count whose build fits ``hbm_bytes``."""
     v = 1

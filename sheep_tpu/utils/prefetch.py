@@ -10,7 +10,14 @@ approximately max(io, compute) instead of their sum (VERDICT r1 item 6).
 
 The wrapper preserves item order exactly (checkpoint chunk indices and
 fault-injection counters are unaffected) and propagates worker exceptions
-to the consumer at the point of `next()`.
+to the consumer at the point of `next()` — with the ORIGINAL worker-side
+traceback attached, so the consumer's log names the failing reader frame
+rather than this module's re-raise. A worker that dies without
+delivering its termination sentinel (killed out-of-band) surfaces as a
+RuntimeError at the next `next()` instead of an eternal blocking get,
+and ``close()`` joins with a timeout, so neither path can hang the
+consumer's unwind (ISSUE 9 satellite; regression-tested with an
+injected reader fault).
 
 Lifecycle (ISSUE 4 satellite): :func:`prefetch` returns a
 :class:`Prefetcher`, an iterator with an explicit :meth:`Prefetcher.close`
@@ -37,10 +44,12 @@ _END = object()
 
 
 class _Raised:
-    __slots__ = ("exc",)
+    __slots__ = ("exc", "tb")
 
     def __init__(self, exc: BaseException):
         self.exc = exc
+        self.tb = exc.__traceback__  # worker-side frames, re-attached
+        #                              at the consumer's re-raise
 
 
 class Prefetcher(Iterator[T]):
@@ -89,7 +98,33 @@ class Prefetcher(Iterator[T]):
     def __next__(self) -> T:
         if self._closed or self._done:
             raise StopIteration
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                # liveness guard (ISSUE 9 satellite): a worker that died
+                # without delivering its end/exception sentinel (thread
+                # killed out-of-band, sentinel put failed) must surface
+                # as a diagnosis at the consumer, not an eternal
+                # blocking get
+                if not self._thread.is_alive():
+                    # the worker may have delivered its final item or
+                    # sentinel BETWEEN the get timeout and the
+                    # liveness check — drain once before declaring it
+                    # sentinelless, or a legitimate last chunk (or the
+                    # real worker exception) would be replaced by the
+                    # bogus died-without diagnosis
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    self._done = True
+                    self._stop.set()
+                    raise RuntimeError(
+                        "prefetch worker died without delivering a "
+                        "result or its termination sentinel")
         if item is _END:
             self._done = True
             self._stop.set()
@@ -97,7 +132,11 @@ class Prefetcher(Iterator[T]):
         if isinstance(item, _Raised):
             self._done = True
             self._stop.set()
-            raise item.exc
+            # re-raise with the ORIGINAL worker-side traceback attached
+            # (explicit, so the frames that name the failing reader
+            # survive even if something cleared __traceback__ in
+            # transit) — the consumer's log points at the real fault
+            raise item.exc.with_traceback(item.tb)
         return item
 
     def close(self, timeout: float = 5.0) -> None:

@@ -73,6 +73,10 @@ def test_fallback_emits_null_vs_baseline():
     # the overlap counters ride the emitted line too (ISSUE 4)
     for f in ("host_blocked_ms", "device_gap_ms"):
         assert line[f] >= 0, f
+    # the fault-tolerance contract (ISSUE 9): dispatch_retries is
+    # ALWAYS emitted (0 on a healthy run) so the regression gate can
+    # see 0 -> N movement instead of an incomparable missing field
+    assert line["dispatch_retries"] == 0
 
 
 def test_skip_probe_short_circuits():

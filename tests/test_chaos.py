@@ -1,0 +1,426 @@
+"""Fault-tolerant execution (ISSUE 9): retry policy, fault
+classification, graceful OOM degradation, device-loss recovery, the
+straggler watchdog, and the chaos harness.
+
+The two pinned acceptance drills live here:
+
+- an injected RESOURCE_EXHAUSTED at dispatch time completes the build
+  IN THE SAME PROCESS at a reduced dispatch_batch, bit-identical to the
+  unfaulted run;
+- randomized chaos schedules through tools/chaos_soak.py end
+  bit-identical to the clean oracle or documented-degraded, with zero
+  unhandled crashes (2 schedules tier-1; the full 20 is @slow).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.base import get_backend
+from sheep_tpu.io import generators
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.utils import fault, retry
+from sheep_tpu.utils.membudget import degraded_dispatch
+from sheep_tpu.utils.watchdog import (NULL_WATCHDOG, StallWatchdog,
+                                      maybe_watchdog, watched)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def graph():
+    e = generators.random_graph(300, 3000, seed=1)
+    return e, (lambda: EdgeStream.from_array(e, n_vertices=300))
+
+
+@pytest.fixture
+def oracle(graph):
+    _, es = graph
+    return get_backend("tpu", chunk_edges=512).partition(
+        es(), 4, comm_volume=False)
+
+
+# -- classification --------------------------------------------------------
+
+class TestClassify:
+    def test_injected_faults_carry_their_class(self):
+        assert retry.classify(fault.InjectedResourceExhausted("x")) \
+            == retry.RESOURCE
+        assert retry.classify(fault.InjectedDeviceLoss("x")) \
+            == retry.DEVICE_LOSS
+        assert retry.classify(fault.InjectedReadError("x")) \
+            == retry.TRANSIENT
+        assert retry.classify(fault.InjectedFault("x")) == retry.FATAL
+
+    def test_xla_style_messages(self):
+        # real PJRT errors surface as RuntimeError subclasses whose
+        # MESSAGE carries the gRPC status — match on the text
+        assert retry.classify(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 137438953472 bytes")) == retry.RESOURCE
+        assert retry.classify(RuntimeError(
+            "INTERNAL: Failed to connect to TPU worker")) \
+            == retry.DEVICE_LOSS
+        assert retry.classify(RuntimeError(
+            "UNAVAILABLE: socket closed")) == retry.TRANSIENT
+
+    def test_memory_and_os_errors(self):
+        assert retry.classify(MemoryError()) == retry.RESOURCE
+        assert retry.classify(OSError("disk hiccup")) == retry.TRANSIENT
+
+    def test_everything_else_is_fatal(self):
+        assert retry.classify(ValueError("bad input")) == retry.FATAL
+        assert retry.classify(KeyError("x")) == retry.FATAL
+
+
+class TestRetryPolicy:
+    def test_bounded_per_class(self):
+        p = retry.RetryPolicy(max_retries=2, base_delay_s=0.0)
+        assert p.admit(retry.RESOURCE)
+        p.record(retry.RESOURCE, RuntimeError("x"), "t")
+        p.record(retry.RESOURCE, RuntimeError("x"), "t")
+        assert not p.admit(retry.RESOURCE)
+        # budgets are PER CLASS: resource exhaustion leaves the
+        # transient budget intact
+        assert p.admit(retry.TRANSIENT)
+        assert not p.admit(retry.FATAL)
+
+    def test_backoff_grows_and_caps(self):
+        p = retry.RetryPolicy(max_retries=9, base_delay_s=0.1,
+                              max_delay_s=0.5, jitter=0.0)
+        delays = [p.delay_s(a) for a in range(5)]
+        assert delays == sorted(delays)
+        assert delays[0] == pytest.approx(0.1)
+        assert delays[-1] == pytest.approx(0.5)
+
+    def test_jitter_bounded_and_seeded(self):
+        p1 = retry.RetryPolicy(max_retries=3, base_delay_s=0.1,
+                               jitter=0.5, seed=7)
+        p2 = retry.RetryPolicy(max_retries=3, base_delay_s=0.1,
+                               jitter=0.5, seed=7)
+        d1 = [p1.delay_s(0) for _ in range(8)]
+        assert d1 == [p2.delay_s(0) for _ in range(8)]  # deterministic
+        assert all(0.05 <= d <= 0.15 for d in d1)
+
+    def test_run_retries_then_returns(self):
+        p = retry.RetryPolicy(max_retries=3, base_delay_s=0.0)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        assert p.run(flaky, where="t") == "ok"
+        assert calls["n"] == 3
+
+    def test_run_reraises_fatal_and_exhausted(self):
+        p = retry.RetryPolicy(max_retries=1, base_delay_s=0.0)
+        with pytest.raises(ValueError):
+            p.run(lambda: (_ for _ in ()).throw(ValueError("bug")), "t")
+        with pytest.raises(OSError):
+            p.run(lambda: (_ for _ in ()).throw(OSError("always")), "t")
+
+    def test_env_knob_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("SHEEP_RETRY_MAX", "0")
+        p = retry.RetryPolicy()
+        assert not p.admit(retry.RESOURCE)
+
+
+# -- membudget degrade picker ----------------------------------------------
+
+class TestDegradedDispatch:
+    def test_halves_toward_one_and_stops(self):
+        n, cs = 1 << 20, 1 << 18
+        b, d = 8, 2
+        seen = []
+        while True:
+            nxt = degraded_dispatch(n, cs, b, d)
+            if nxt is None:
+                break
+            assert nxt != (b, d)
+            # exactly one knob halves per step
+            assert (nxt[0] == b // 2 and nxt[1] == d) or \
+                (nxt[0] == b and nxt[1] == d // 2)
+            b, d = nxt
+            seen.append(nxt)
+        assert (b, d) == (1, 1)
+        assert len(seen) >= 4  # 8x2 -> 1x1 takes four halvings
+
+    def test_picks_the_bigger_saving(self):
+        from sheep_tpu.utils.membudget import build_phase_bytes
+        n, cs = 1 << 20, 1 << 18
+        nxt = degraded_dispatch(n, cs, 4, 2, donate=False)
+        other = (2, 2) if nxt == (4, 1) else (4, 1)
+        total = lambda b, d: build_phase_bytes(  # noqa: E731
+            n, cs, dispatch_batch=b, inflight=d)["total_bytes"]
+        assert total(*nxt) <= total(*other)
+
+    def test_none_when_nothing_to_shed(self):
+        assert degraded_dispatch(1 << 20, 1 << 18, 1, 1) is None
+
+
+# -- chaos grammar ---------------------------------------------------------
+
+class TestChaosGrammar:
+    def test_deterministic_schedule(self, monkeypatch):
+        spec = "chaos:123:1:0.2"
+        monkeypatch.setenv(fault.ENV_VAR, spec)
+
+        def first_fire():
+            fault.reset()
+            for i in range(200):
+                try:
+                    fault.maybe_fail("build", i, kinds=("oom",))
+                except fault.InjectedResourceExhausted:
+                    return i
+            return None
+
+        a = first_fire()
+        assert a is not None
+        assert first_fire() == a  # same seed -> same point
+
+    def test_kinds_restrict_what_fires(self, monkeypatch):
+        spec = "chaos:123:5:1.0"  # fire at every point
+        monkeypatch.setenv(fault.ENV_VAR, spec)
+        fault.reset()
+        with pytest.raises(fault.InjectedReadError):
+            fault.maybe_fail("read", 1, kinds=("read",))
+        # a point that declares NO kinds draws but never injects
+        fault.maybe_fail("degrees", 1, kinds=())
+
+    def test_budget_exhausts(self, monkeypatch):
+        spec = "chaos:9:2:1.0"
+        monkeypatch.setenv(fault.ENV_VAR, spec)
+        fault.reset()
+        fired = 0
+        for i in range(50):
+            try:
+                fault.maybe_fail("build", i, kinds=("oom",))
+            except fault.InjectedResourceExhausted:
+                fired += 1
+        assert fired == 2
+
+    def test_typed_shots(self, monkeypatch):
+        spec = "oom@build:3:2"
+        monkeypatch.setenv(fault.ENV_VAR, spec)
+        fault.reset()
+        fired = 0
+        for _ in range(4):
+            try:
+                fault.maybe_fail("build", 5)
+            except fault.InjectedResourceExhausted:
+                fired += 1
+        assert fired == 2  # bounded shots, then inert
+
+    def test_stall_kind_sleeps_not_raises(self, monkeypatch):
+        monkeypatch.setattr(fault, "STALL_S", 0.05)
+        spec = "chaos:123:1:1.0"
+        monkeypatch.setenv(fault.ENV_VAR, spec)
+        fault.reset()
+        t0 = time.perf_counter()
+        fault.maybe_fail("build", 1, kinds=("stall",))
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_bad_specs_raise(self, monkeypatch):
+        for bad in ("chaos:", "chaos:x", "wat@build:1", "oom@build:z"):
+            monkeypatch.setenv(fault.ENV_VAR, bad)
+            with pytest.raises(ValueError):
+                fault.maybe_fail("build", 1)
+
+
+# -- pinned in-process recovery drills -------------------------------------
+
+class TestInProcessRecovery:
+    def test_oom_at_dispatch_degrades_bit_identical(self, graph, oracle,
+                                                    monkeypatch):
+        """THE acceptance drill: two injected RESOURCE_EXHAUSTED at
+        dispatch time -> the build completes in the same process at a
+        REDUCED dispatch_batch, bit-identical to the unfaulted run,
+        with the dispatch_retries / degraded_dispatch_batch trail."""
+        _, es = graph
+        monkeypatch.setenv(fault.ENV_VAR, "oom@dispatch:2:2")
+        fault.reset()
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        res = get_backend("tpu", chunk_edges=512, dispatch_batch=4,
+                          inflight=2).partition(es(), 4,
+                                                comm_volume=False)
+        np.testing.assert_array_equal(res.assignment, oracle.assignment)
+        assert res.edge_cut == oracle.edge_cut
+        d = res.diagnostics
+        assert d.get("dispatch_retries", 0) >= 2
+        assert 1 <= d["degraded_dispatch_batch"] < 4
+        assert d["degraded_inflight"] >= 1
+
+    def test_device_loss_snapshots_and_recovers(self, graph, oracle,
+                                                tmp_path, monkeypatch):
+        _, es = graph
+        from sheep_tpu.utils.checkpoint import Checkpointer
+
+        monkeypatch.setenv(fault.ENV_VAR, "device@build:2")
+        fault.reset()
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        ck = Checkpointer(str(tmp_path / "ck"), every=1)
+        res = get_backend("tpu", chunk_edges=512).partition(
+            es(), 4, comm_volume=False, checkpointer=ck)
+        np.testing.assert_array_equal(res.assignment, oracle.assignment)
+        assert res.diagnostics.get("device_loss_recoveries", 0) >= 1
+        assert res.diagnostics.get("dispatch_retries", 0) >= 1
+
+    def test_adaptive_branch_oom_retries(self, graph, oracle,
+                                         monkeypatch):
+        _, es = graph
+        monkeypatch.setenv(fault.ENV_VAR, "oom@build:3")
+        fault.reset()
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        res = get_backend("tpu", chunk_edges=512).partition(
+            es(), 4, comm_volume=False)
+        np.testing.assert_array_equal(res.assignment, oracle.assignment)
+        assert res.diagnostics.get("dispatch_retries", 0) >= 1
+
+    def test_kill_faults_still_propagate(self, graph, monkeypatch):
+        """The legacy kill grammar is FATAL to the retry layer: the
+        PR-8 checkpoint/kill+resume drills must keep seeing the
+        process-killing exception, not a silent in-process retry."""
+        _, es = graph
+        monkeypatch.setenv(fault.ENV_VAR, "build:2")
+        with pytest.raises(fault.InjectedFault):
+            get_backend("tpu", chunk_edges=512).partition(
+                es(), 4, comm_volume=False)
+
+    def test_retry_budget_exhaustion_reraises(self, graph, monkeypatch):
+        _, es = graph
+        monkeypatch.setenv(fault.ENV_VAR, "oom@build:2:99")
+        fault.reset()
+        monkeypatch.setenv("SHEEP_RETRY_MAX", "2")
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        with pytest.raises(fault.InjectedResourceExhausted):
+            get_backend("tpu", chunk_edges=512).partition(
+                es(), 4, comm_volume=False)
+
+    def test_sharded_oom_degrades_bit_identical(self, monkeypatch):
+        e = generators.random_graph(200, 2000, seed=2)
+
+        def es():
+            return EdgeStream.from_array(e, n_vertices=200)
+
+        clean = get_backend("tpu-sharded", chunk_edges=256).partition(
+            es(), 4, comm_volume=False)
+        monkeypatch.setenv(fault.ENV_VAR, "oom@dispatch:2")
+        fault.reset()
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        res = get_backend("tpu-sharded", chunk_edges=256,
+                          dispatch_batch=2, inflight=2).partition(
+            es(), 4, comm_volume=False)
+        np.testing.assert_array_equal(res.assignment, clean.assignment)
+        assert res.diagnostics.get("dispatch_retries", 0) >= 1
+
+    def test_checkpoint_degraded_surfaces_in_diagnostics(self, graph,
+                                                         tmp_path):
+        """A torn manifest at resume is a lossy recovery: the run
+        completes clean-start AND carries checkpoint_degraded in its
+        diagnostics so the degradation shows in the perf trajectory."""
+        _, es = graph
+        from sheep_tpu.utils.checkpoint import Checkpointer
+
+        ck = Checkpointer(str(tmp_path / "ck"), every=1)
+        with open(ck._manifest_path, "w") as f:
+            f.write('{"version": 3, "phase": "build"')  # torn JSON
+        res = get_backend("tpu", chunk_edges=512).partition(
+            es(), 4, comm_volume=False, checkpointer=ck, resume=True)
+        assert res.diagnostics.get("checkpoint_degraded", 0) >= 1
+
+
+# -- watchdog --------------------------------------------------------------
+
+class TestWatchdog:
+    def test_interrupts_stalled_main(self, capsys):
+        wd = StallWatchdog(0.3, label="drill", poll_s=0.05)
+        wd.start()
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                time.sleep(10)  # "hung collective"
+        finally:
+            wd.stop()
+        assert wd.fired_at is not None and wd.fired_at >= 0.3
+        assert "no progress in 'drill'" in capsys.readouterr().err
+
+    def test_touch_keeps_it_quiet(self):
+        wd = StallWatchdog(0.4, label="t", poll_s=0.05)
+        wd.start()
+        try:
+            for _ in range(12):
+                wd.touch("batch")
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert wd.fired_at is None
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv("SHEEP_PEER_TIMEOUT_S", raising=False)
+        assert maybe_watchdog(2, "t") is None
+        monkeypatch.setenv("SHEEP_PEER_TIMEOUT_S", "junk")
+        assert maybe_watchdog(2, "t") is None
+        with watched(1, "t") as wd:
+            assert wd is NULL_WATCHDOG
+            wd.touch("free")  # inert
+
+    def test_watched_stops_on_exit(self, monkeypatch):
+        monkeypatch.setenv("SHEEP_PEER_TIMEOUT_S", "0.2")
+        with watched(1, "t") as wd:
+            assert wd is not NULL_WATCHDOG
+        # stopped: a stall AFTER scope exit must not interrupt us
+        time.sleep(0.5)
+
+    def test_stall_chaos_plus_watchdog_end_to_end(self, graph,
+                                                  monkeypatch):
+        """A chaos stall ages the clock but progress resumes before the
+        (generous) timeout: the run completes untouched."""
+        _, es = graph
+        monkeypatch.setattr(fault, "STALL_S", 0.05)
+        monkeypatch.setenv("SHEEP_PEER_TIMEOUT_S", "30")
+        monkeypatch.setenv(fault.ENV_VAR, "chaos:5:2:0.3")
+        fault.reset()
+        res = get_backend("tpu-sharded", chunk_edges=512).partition(
+            es(), 4, comm_volume=False)
+        assert res.edge_cut >= 0
+
+
+# -- chaos soak (subprocess, through the real CLI) -------------------------
+
+def _run_soak(schedules, tmp_path, extra=()):
+    cmd = [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+           "--schedules", str(schedules), "--scale", "8", "--ef", "8",
+           "--chunk-edges", "256", "--out", str(tmp_path / "soak"),
+           "--json", *extra]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("SHEEP_FAULT_INJECT", None)
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+def test_chaos_soak_small(tmp_path):
+    """Two seeded schedules end-to-end through the CLI: every verdict
+    identical-or-documented, zero unhandled crashes."""
+    summary = _run_soak(2, tmp_path)
+    assert summary["failed"] == 0
+    assert sum(summary["verdicts"].values()) == 2
+
+
+@pytest.mark.slow
+def test_chaos_soak_acceptance(tmp_path):
+    """The full ISSUE 9 acceptance criterion: >= 20 seeded randomized
+    fault schedules, zero unhandled crashes."""
+    summary = _run_soak(20, tmp_path)
+    assert summary["failed"] == 0
+    assert sum(summary["verdicts"].values()) == 20
+    assert summary["total_injected"] >= 20

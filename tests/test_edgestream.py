@@ -156,6 +156,136 @@ def test_memory_stream():
     np.testing.assert_array_equal(es.read_all(), e)
 
 
+class TestCorruptStreamFuzz:
+    """ISSUE 9 satellite: corrupt/truncated inputs are
+    quarantine-or-raise per SHEEP_IO_POLICY — never a silently wrong
+    edge multiset (and therefore never a wrong forest)."""
+
+    def _bin(self, tmp_path, e, fmt="bin64"):
+        p = str(tmp_path / f"g.{fmt}")
+        formats.write_edges(p, e)
+        return p
+
+    @pytest.mark.parametrize("fmt,extra", [("bin64", 3), ("bin64", 8),
+                                           ("bin32", 1), ("bin32", 4)])
+    def test_torn_trailing_pair(self, tmp_path, fmt, extra):
+        """'short chunk': a record torn mid-pair at EOF. num_edges
+        floors it away, so without validation the damage is silent."""
+        from sheep_tpu.io.edgestream import CorruptStreamError
+
+        e = generators.random_graph(64, 300, seed=5)
+        p = self._bin(tmp_path, e, fmt)
+        with open(p, "ab") as f:
+            f.write(b"\xff" * extra)
+        with pytest.raises(CorruptStreamError):
+            list(EdgeStream.open(p).chunks(64))
+
+    @pytest.mark.parametrize("fmt", ["bin64", "bin32"])
+    def test_torn_tail_quarantines(self, tmp_path, fmt, monkeypatch):
+        e = generators.random_graph(64, 300, seed=5)
+        p = self._bin(tmp_path, e, fmt)
+        with open(p, "ab") as f:
+            f.write(b"\xff" * 3)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        got = np.concatenate(list(EdgeStream.open(p).chunks(64)))
+        # the torn bytes are DROPPED, the intact prefix is exact
+        np.testing.assert_array_equal(got, e)
+
+    def test_midstream_eof_strict_raises(self, tmp_path):
+        """The file shrinks under a live stream (concurrent truncation):
+        the short read must raise, not fold garbage."""
+        from sheep_tpu.io.edgestream import CorruptStreamError
+
+        e = generators.random_graph(64, 300, seed=6)
+        p = self._bin(tmp_path, e)
+        es = EdgeStream.open(p)
+        assert es.num_edges == 300  # cache the pre-truncation size
+        with open(p, "r+b") as f:
+            f.truncate(100 * 16)
+        with pytest.raises(CorruptStreamError):
+            list(es.chunks(64))
+
+    def test_midstream_eof_quarantines_prefix(self, tmp_path,
+                                              monkeypatch):
+        e = generators.random_graph(64, 300, seed=6)
+        p = self._bin(tmp_path, e)
+        es = EdgeStream.open(p)
+        assert es.num_edges == 300
+        with open(p, "r+b") as f:
+            f.truncate(100 * 16)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        got = np.concatenate(list(es.chunks(64)))
+        np.testing.assert_array_equal(got, e[:100])  # intact prefix only
+
+    def test_flipped_csr_header_raises(self, tmp_path):
+        """Flipped/garbage header magic: a clean ValueError diagnosis,
+        never a parse of garbage as edges."""
+        from sheep_tpu.io import csr as csr_mod
+
+        e = generators.random_graph(32, 100, seed=7)
+        p = str(tmp_path / "g.csr")
+        csr_mod.write_csr(p, EdgeStream.from_array(e, n_vertices=32))
+        raw = bytearray(open(p, "rb").read())
+        raw[0] ^= 0xFF  # flip the first magic byte
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ValueError):
+            EdgeStream.open(p).num_edges
+
+    def test_transient_read_fault_is_retried(self, tmp_path,
+                                             monkeypatch):
+        """An injected transient read failure is absorbed by the
+        bounded retry: the stream is byte-exact, no fault escapes."""
+        e = generators.random_graph(64, 300, seed=8)
+        p = self._bin(tmp_path, e)
+        monkeypatch.setenv("SHEEP_FAULT_INJECT", "read@read:2")
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        from sheep_tpu.utils import fault
+
+        fault.reset()
+        got = np.concatenate(list(EdgeStream.open(p).chunks(50)))
+        np.testing.assert_array_equal(got, e)
+
+    def test_text_read_fault_is_retried(self, tmp_path, monkeypatch):
+        e = generators.random_graph(64, 300, seed=9)
+        p = str(tmp_path / "g.edges")
+        formats.write_edges(p, e)
+        monkeypatch.setenv("SHEEP_FAULT_INJECT", "read@read:1")
+        monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.0")
+        from sheep_tpu.utils import fault
+
+        fault.reset()
+        got = np.concatenate(list(EdgeStream.open(p).chunks(50)))
+        np.testing.assert_array_equal(got, e)
+
+    def test_bad_policy_value_rejected(self, tmp_path, monkeypatch):
+        e = generators.random_graph(16, 50, seed=1)
+        p = self._bin(tmp_path, e)
+        with open(p, "ab") as f:
+            f.write(b"\x01")
+        monkeypatch.setenv("SHEEP_IO_POLICY", "yolo")
+        with pytest.raises(ValueError):
+            list(EdgeStream.open(p).chunks(64))
+
+    def test_quarantined_build_never_wrong_forest(self, tmp_path,
+                                                  monkeypatch):
+        """End-to-end: a quarantined (truncated) stream builds the
+        forest OF THE INTACT PREFIX — equal to a clean build over that
+        prefix, not some third thing."""
+        from sheep_tpu.backends.base import get_backend
+
+        e = generators.random_graph(64, 300, seed=10)
+        p = self._bin(tmp_path, e)
+        with open(p, "ab") as f:
+            f.write(b"\xff" * 5)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        res = get_backend("tpu", chunk_edges=128).partition(
+            EdgeStream.open(p, n_vertices=64), 4, comm_volume=False)
+        ref = get_backend("tpu", chunk_edges=128).partition(
+            EdgeStream.from_array(e, n_vertices=64), 4,
+            comm_volume=False)
+        np.testing.assert_array_equal(res.assignment, ref.assignment)
+
+
 class TestSizeBounds:
     def test_upper_bound_exact_for_binary(self, tmp_path):
         e = np.array([[0, 1], [1, 2], [2, 3]], np.int64)

@@ -26,6 +26,64 @@ def test_exception_propagates():
         list(it)
 
 
+def test_exception_carries_worker_traceback():
+    """ISSUE 9 satellite: the re-raise at next() must carry the
+    ORIGINAL worker-side frames, so the log names the failing reader
+    function, not the prefetch machinery."""
+    import traceback
+
+    def injected_reader_fault():
+        yield 1
+        raise OSError("injected reader fault")
+
+    it = prefetch(injected_reader_fault())
+    assert next(it) == 1
+    with pytest.raises(OSError) as ei:
+        next(it)
+    frames = "".join(traceback.format_exception(
+        ei.type, ei.value, ei.tb))
+    assert "injected_reader_fault" in frames
+
+
+def test_close_does_not_hang_on_dead_worker():
+    """close() after the worker died (here: on an injected reader
+    fault) must return promptly — the join is timeout-bounded and the
+    thread is already gone."""
+    def gen():
+        raise OSError("dead on arrival")
+        yield  # pragma: no cover
+
+    it = prefetch(gen())
+    with pytest.raises(OSError):
+        next(it)
+    t0 = time.perf_counter()
+    it.close()
+    assert time.perf_counter() - t0 < 1.0
+    assert it.closed
+
+
+def test_next_raises_on_sentinelless_worker_death():
+    """A worker that dies WITHOUT delivering its end/exception sentinel
+    (thread killed out-of-band) surfaces as a prompt RuntimeError at
+    next(), never an eternal blocking get."""
+    import queue
+
+    from sheep_tpu.utils.prefetch import Prefetcher
+
+    pf = Prefetcher.__new__(Prefetcher)
+    pf._q = queue.Queue(maxsize=2)
+    pf._stop = threading.Event()
+    pf._closed = pf._done = False
+    pf._thread = threading.Thread(target=lambda: None)
+    pf._thread.start()
+    pf._thread.join()  # dead, queue empty, no sentinel
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="died without"):
+        next(pf)
+    assert time.perf_counter() - t0 < 3.0
+    pf.close()
+
+
 def test_early_exit_stops_worker():
     produced = []
 

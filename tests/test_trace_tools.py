@@ -279,6 +279,40 @@ def test_bench_regress_rise_from_zero_is_gated(tmp_path):
     assert bench_regress.main([same, old]) == 0
 
 
+def test_bench_regress_gates_dispatch_retries(tmp_path):
+    """ISSUE 9 contract: dispatch_retries is higher-is-worse. A healthy
+    capture has 0, so any movement off zero gates absolutely (the
+    old==0 rule); the degradation info fields report but never gate."""
+    old = _write(tmp_path, "old.json", {**BASE, "dispatch_retries": 0})
+    new = _write(tmp_path, "new.json", {**BASE, "dispatch_retries": 3})
+    assert bench_regress.main([new, old]) == 2
+    same = _write(tmp_path, "same.json", {**BASE, "dispatch_retries": 0})
+    assert bench_regress.main([same, old]) == 0
+
+
+def test_bench_regress_degradation_fields_are_info_only(tmp_path):
+    """degraded_dispatch_batch / device_loss_recoveries /
+    checkpoint_degraded are consequences of environmental faults, not
+    code regressions: visible in the rows, never gating."""
+    old = _write(tmp_path, "old.json",
+                 {**BASE, "degraded_dispatch_batch": 8,
+                  "device_loss_recoveries": 0,
+                  "checkpoint_degraded": 0})
+    new = _write(tmp_path, "new.json",
+                 {**BASE, "degraded_dispatch_batch": 1,
+                  "device_loss_recoveries": 2,
+                  "checkpoint_degraded": 1})
+    assert bench_regress.main([new, old]) == 0
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_regress.main([new, old])
+    out = buf.getvalue()
+    assert "degraded_dispatch_batch" in out and "info" in out
+
+
 def test_bench_regress_incomparable_metrics_pass(tmp_path):
     """A cpu-jax fallback row must never false-alarm against a real
     accelerator row — different metric strings are vacuously PASS."""

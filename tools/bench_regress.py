@@ -14,14 +14,26 @@ each compared only when present in BOTH captures:
     value, vs_baseline, r_colo_est    higher is better (relative drop
                                       beyond --threshold regresses)
     host_syncs, device_rounds,        lower is better (relative rise
-    host_blocked_ms                   beyond --threshold regresses —
-                                      dispatch counts are deterministic,
+    host_blocked_ms,                  beyond --threshold regresses —
+    dispatch_retries                  dispatch counts are deterministic,
                                       so a rise is a real scheduling
                                       change, not noise; host_blocked_ms
                                       is the dispatch pipeline's
                                       host-stall wall, the quantity the
                                       in-flight overlap exists to
-                                      shrink)
+                                      shrink; dispatch_retries is the
+                                      fault-tolerance layer's
+                                      graceful-degradation count — a
+                                      healthy capture has 0, and any
+                                      movement off 0 is gated
+                                      absolutely)
+
+Degradation info fields (never gated, always reported):
+``degraded_dispatch_batch`` / ``degraded_inflight`` (the reduced knobs
+after an OOM backoff), ``device_loss_recoveries``, and
+``checkpoint_degraded`` (lossy checkpoint recoveries) — environmental
+consequences that must be VISIBLE in the perf trajectory without
+false-alarming the gate.
 
 Link-state fields (rtt_ms, h2d_mbs, d2h_mbs) and device_gap_ms (device
 idle between executions — collapses with pipelining but swings with
@@ -47,10 +59,21 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # host_blocked_ms is wall-derived (like value) and so can swing with
 # link quality within one platform — gated anyway per the contract: a
 # sustained rise is the dispatch pipeline regressing, and same-metric
-# comparison plus the threshold absorb ordinary swings
-LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms")
+# comparison plus the threshold absorb ordinary swings.
+# dispatch_retries (ISSUE 9) gates graceful degradation: a healthy
+# capture retries 0 times, so ANY rise (0 -> N is gated absolutely by
+# the old==0 rule below) means the bench survived faults it used to
+# not have — visible, not silent.
+LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
+                "dispatch_retries")
+# degraded_* and checkpoint_degraded are consequences of faults the
+# environment injected, not regressions of the code under test — they
+# ride as info so the degradation is VISIBLE in the perf trajectory
+# while only the retry count itself gates
 INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
-             "inflight_depth", "inflight_discards", "device_gap_ms")
+             "inflight_depth", "inflight_discards", "device_gap_ms",
+             "degraded_dispatch_batch", "degraded_inflight",
+             "device_loss_recoveries", "checkpoint_degraded")
 
 
 def load_capture(path: str):

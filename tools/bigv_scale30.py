@@ -105,6 +105,34 @@ def main():
                      "at BETA * total/k)")
         alpha = min(args.balance - 1.0, 1.0)
 
+    # artifact path up front (also the auto-resume idempotency key)
+    tag = "" if args.devices == 2 else f"_d{args.devices}"
+    if args.balance is not None:
+        # a balance-budgeted run is a different experiment; keep the
+        # default-alpha artifact (same ADVICE-r4 no-clobber rule as D)
+        tag += f"_b{args.balance:g}"
+    out = os.path.join(REPO, "tools", "out", "soak",
+                       f"bigv_s{args.scale}{tag}.json")
+    if args.resume and os.path.exists(out):
+        # unattended re-entry (tools/run_paused_aware.sh auto-resume,
+        # ISSUE 9 satellite): a completed artifact means the previous
+        # attempt finished AFTER the supervisor decided to retry (e.g.
+        # killed between the final write and exit) — converge instead
+        # of re-burning hours re-proving the same verdict
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            prior = None
+        if prior and "bigv" in prior and (
+                prior.get("oracle_equal") is True
+                or ("native_oracle" not in prior
+                    and "oracle_equal" not in prior)):
+            print(f"auto-resume: completed artifact already at {out} "
+                  f"(oracle_equal={prior.get('oracle_equal')}); "
+                  f"nothing to do")
+            return
+
     nd = max(8, args.devices)
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -199,17 +227,10 @@ def main():
 
     # write the artifact BEFORE any equality verdicting exits: a
     # multi-hour disagreeing run must still leave its evidence on disk
-    # (oracle_equal: false), not vanish into an AssertionError
-    # key the artifact by mesh size when it differs from the default
-    # (ADVICE r4: a rerun at another D is a semantically different run
-    # and must not clobber committed evidence)
-    tag = "" if args.devices == 2 else f"_d{args.devices}"
-    if args.balance is not None:
-        # a balance-budgeted run is a different experiment; keep the
-        # default-alpha artifact (same ADVICE-r4 no-clobber rule as D)
-        tag += f"_b{args.balance:g}"
-    out = os.path.join(REPO, "tools", "out", "soak",
-                       f"bigv_s{args.scale}{tag}.json")
+    # (oracle_equal: false), not vanish into an AssertionError. The
+    # path is keyed by mesh size / balance up top (ADVICE r4: a rerun
+    # at another D or BETA is a semantically different run and must not
+    # clobber committed evidence).
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump(result, f, indent=1)

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Chaos soak harness (ISSUE 9 acceptance gate).
+
+Replays N randomized fault schedules through the real CLI and asserts
+every run either converges BIT-IDENTICAL to a clean oracle or ends in a
+documented degraded state — zero unhandled crashes. Each schedule arms
+
+    SHEEP_FAULT_INJECT=chaos:<seed>[:<budget>[:<rate>]]
+
+(utils/fault.py) over a small materialized .bin64 graph, so every fault
+class has live injection points: OOM + device-loss at the dispatch/build
+points (absorbed in-process by the retry/degrade layer), read errors at
+the physical reads (absorbed by the edgestream retry), stalls (aging the
+watchdog clocks), and kills (process death — the harness resumes the run
+from its checkpoint with --resume, PR-8 style, under a fresh derived
+seed so the same kill cannot recur forever).
+
+Per-schedule verdicts:
+
+    identical            output partition map byte-equal to the oracle
+    degraded_documented  differs, but the trace carries the documented
+                         degradation events (chunk_quarantined /
+                         checkpoint_degraded)
+    wrong_forest         differs with NO documented degradation  [FAIL]
+    unhandled_crash      nonzero exit not caused by an injected KILL
+                         (InjectedFault — fatal by design) or the
+                         watchdog's stall exit; an escaped oom/device/
+                         read injection lands here, because those are
+                         supposed to be absorbed in-process    [FAIL]
+    resume_exhausted     still dying after --max-resumes resumes  [FAIL]
+
+Usage::
+
+    python tools/chaos_soak.py                  # 20 schedules, tpu/cpu-jax
+    python tools/chaos_soak.py --schedules 3 --json
+    python tools/chaos_soak.py --backend tpu-sharded --schedules 5
+
+Writes a summary JSON next to the per-schedule artifacts (kept with
+--keep, else under a temp dir); exits nonzero on any FAIL verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAIL_VERDICTS = ("wrong_forest", "unhandled_crash", "resume_exhausted")
+
+
+def _run_cli(cmd, env, timeout):
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def _events(trace_path):
+    """Counts of the interesting trace events across ALL runs appended
+    to the schedule's trace file."""
+    counts: dict = {}
+    try:
+        with open(trace_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                ev = rec.get("event")
+                if ev in ("chaos_inject", "fault_inject"):
+                    k = f"inject_{rec.get('kind', '?')}"
+                    counts[k] = counts.get(k, 0) + 1
+                elif ev in ("retry", "dispatch_degraded",
+                            "device_reinit", "chunk_quarantined",
+                            "checkpoint_degraded", "straggler_timeout",
+                            "resume"):
+                    counts[ev] = counts.get(ev, 0) + 1
+    except OSError:
+        pass
+    return counts
+
+
+def run_schedule(i, seed, args, base_cmd, oracle_bytes, out_dir, env0,
+                 stall_exit):
+    sdir = os.path.join(out_dir, f"sched_{i:03d}")
+    os.makedirs(sdir, exist_ok=True)
+    trace = os.path.join(sdir, "trace.jsonl")
+    parts = os.path.join(sdir, "parts.pbin")
+    ckpt = os.path.join(sdir, "ckpt")
+    cmd = base_cmd + ["--checkpoint-dir", ckpt,
+                      "--checkpoint-every", str(args.checkpoint_every),
+                      "--trace", trace, "--output", parts]
+    if i % 2:
+        # alternate schedules through the pipelined dispatch path so
+        # dispatch-time OOM/degrade sees real in-flight chains
+        cmd = cmd + ["--dispatch-batch", "2", "--inflight", "2"]
+    rec = {"schedule": i, "seed": seed, "attempts": 0, "rcs": []}
+    attempts = 0
+    while True:
+        env = dict(env0)
+        # a fresh derived seed per resume: the re-run must not
+        # deterministically re-kill at the same point forever
+        env["SHEEP_FAULT_INJECT"] = (
+            f"chaos:{seed * 1000 + attempts}:{args.budget}:{args.rate}")
+        try:
+            rc, _out, err = _run_cli(
+                cmd + (["--resume"] if attempts else []), env,
+                args.timeout)
+        except subprocess.TimeoutExpired:
+            # a wedged run is a verdict, not a harness crash — exactly
+            # the hang class this gate exists to surface
+            rec["verdict"] = "unhandled_crash"
+            rec["stderr_tail"] = (f"run hung past --timeout "
+                                  f"{args.timeout}s and was killed")
+            return rec
+        rec["rcs"].append(rc)
+        if rc == 0:
+            break
+        attempts += 1
+        rec["attempts"] = attempts
+        # only a KILL-kind injection (fatal by design) or the
+        # watchdog's stall exit is an EXPECTED death. An escaped
+        # InjectedResourceExhausted/InjectedDeviceLoss/InjectedReadError
+        # means the in-process handling regressed — exactly the bug
+        # class this gate exists to catch, so it must flag, not resume.
+        if "InjectedFault" not in err and rc != stall_exit:
+            rec["verdict"] = "unhandled_crash"
+            rec["stderr_tail"] = err[-800:]
+            return rec
+        if attempts > args.max_resumes:
+            rec["verdict"] = "resume_exhausted"
+            return rec
+    rec["attempts"] = attempts
+    rec["events"] = _events(trace)
+    try:
+        with open(parts, "rb") as f:
+            got = f.read()
+    except OSError:
+        rec["verdict"] = "unhandled_crash"
+        rec["stderr_tail"] = "run exited 0 but wrote no partition map"
+        return rec
+    if got == oracle_bytes:
+        rec["verdict"] = "identical"
+    elif rec["events"].get("chunk_quarantined") or \
+            rec["events"].get("checkpoint_degraded"):
+        rec["verdict"] = "degraded_documented"
+    else:
+        rec["verdict"] = "wrong_forest"
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay randomized fault schedules through the CLI "
+                    "and assert oracle-identical or documented-degraded "
+                    "convergence.")
+    ap.add_argument("--schedules", type=int, default=20)
+    ap.add_argument("--seed0", type=int, default=1)
+    ap.add_argument("--scale", type=int, default=9,
+                    help="2^SCALE vertices for the soak graph")
+    ap.add_argument("--ef", type=int, default=8, help="edges per vertex")
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--chunk-edges", type=int, default=512)
+    ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--budget", type=int, default=2,
+                    help="max injected faults per schedule attempt")
+    ap.add_argument("--rate", type=float, default=0.15,
+                    help="per-point injection probability")
+    ap.add_argument("--max-resumes", type=int, default=8)
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="seconds per CLI invocation")
+    ap.add_argument("--out", default=None,
+                    help="artifact dir (default: fresh temp dir)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep per-schedule artifacts on success")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from sheep_tpu.io import formats, generators
+    from sheep_tpu.utils import watchdog
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="sheep_chaos_")
+    os.makedirs(out_dir, exist_ok=True)
+    n = 1 << args.scale
+    graph = os.path.join(out_dir, f"soak_s{args.scale}.bin64")
+    e = generators.random_graph(n, args.ef << args.scale,
+                                seed=args.seed0)
+    formats.write_edges(graph, e)
+
+    env0 = dict(os.environ)
+    env0["JAX_PLATFORMS"] = env0.get("JAX_PLATFORMS", "cpu")
+    env0.pop("SHEEP_FAULT_INJECT", None)
+    # faster retry backoff: the soak injects dozens of faults and the
+    # production default backoff would be pure dead time here
+    env0.setdefault("SHEEP_RETRY_BASE_S", "0.01")
+
+    base_cmd = [sys.executable, "-m", "sheep_tpu.cli",
+                "--input", graph, "--num-vertices", str(n),
+                "--k", str(args.k), "--backend", args.backend,
+                "--chunk-edges", str(args.chunk_edges),
+                "--no-comm-volume", "--json"]
+
+    # clean oracle: same command, no faults, no checkpointing
+    oracle_parts = os.path.join(out_dir, "oracle.pbin")
+    rc, out, err = _run_cli(base_cmd + ["--output", oracle_parts],
+                            env0, args.timeout)
+    if rc != 0:
+        print(f"oracle run failed (rc={rc}):\n{err[-800:]}",
+              file=sys.stderr)
+        return 1
+    with open(oracle_parts, "rb") as f:
+        oracle_bytes = f.read()
+
+    results = []
+    for i in range(args.schedules):
+        rec = run_schedule(i, args.seed0 + i, args, base_cmd,
+                           oracle_bytes, out_dir, env0,
+                           watchdog.EXIT_CODE)
+        results.append(rec)
+        ev = rec.get("events", {})
+        injected = sum(v for k, v in ev.items()
+                       if k.startswith("inject_"))
+        print(f"schedule {i:3d} seed {rec['seed']:4d}: "
+              f"{rec['verdict']:<20} attempts={rec['attempts']} "
+              f"injected={injected} events={ev}", flush=True)
+
+    summary = {
+        "schedules": args.schedules,
+        "backend": args.backend,
+        "verdicts": {},
+        "total_injected": 0,
+        "results": results,
+    }
+    for rec in results:
+        v = rec["verdict"]
+        summary["verdicts"][v] = summary["verdicts"].get(v, 0) + 1
+        summary["total_injected"] += sum(
+            c for k, c in rec.get("events", {}).items()
+            if k.startswith("inject_"))
+    failed = sum(summary["verdicts"].get(v, 0) for v in FAIL_VERDICTS)
+    summary["failed"] = failed
+    with open(os.path.join(out_dir, "chaos_soak.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(f"chaos soak: {summary['verdicts']} "
+              f"({summary['total_injected']} faults injected) "
+              f"-> {'FAIL' if failed else 'PASS'} "
+              f"(artifacts: {out_dir})")
+    if not args.keep and not failed and args.out is None:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,4 +91,35 @@ python tools/trace_report.py "$TRACE4" --check > "$OUT/report_resume.txt"
 grep -q "resume:" "$OUT/report_resume.txt"
 grep -q '"event": "resume"' "$TRACE4"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4"
+# fifth leg: chaos (ISSUE 9) — one seeded chaos schedule through the
+# CLI over a real file stream (so read-error points are live), absorbed
+# IN-PROCESS by the retry/degrade layer: the run must exit 0, the trace
+# must pass the --check gate, and the injected faults + their handling
+# (retry / dispatch_degraded / device_reinit) must be on the record.
+# Seed 46 is pinned: on this code's point sequence it injects two read
+# faults, both absorbed by the edgestream's bounded retry (read points
+# dominate the chaos draw — three passes touch every chunk). The
+# OOM-degrade and device-reinit paths are pinned deterministically by
+# tests/test_chaos.py instead; the grep below accepts either shape so
+# a shifted point sequence only needs a seed with >= 1 absorbed fault.
+TRACE5="$OUT/trace_chaos.jsonl"
+GRAPH5="$OUT/chaos.bin64"
+rm -f "$TRACE5"
+JAX_PLATFORMS=cpu python - "$GRAPH5" <<'PYEOF'
+import sys
+from sheep_tpu.io import formats, generators
+formats.write_edges(sys.argv[1], generators.random_graph(512, 4096, seed=7))
+PYEOF
+JAX_PLATFORMS=cpu SHEEP_FAULT_INJECT=chaos:46:2:0.15 SHEEP_RETRY_BASE_S=0.01 \
+    python -m sheep_tpu.cli \
+    --input "$GRAPH5" --num-vertices 512 --k 4 --backend tpu \
+    --dispatch-batch 2 --inflight 2 --chunk-edges 512 --no-comm-volume \
+    --trace "$TRACE5" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_chaos.json" 2> "$OUT/chaos.err"
+python tools/trace_report.py "$TRACE5" --check > "$OUT/report_chaos.txt"
+grep -q '"event": "chaos_inject"' "$TRACE5"
+grep -q '"event": "retry"' "$TRACE5"
+grep -qE '"event": "(dispatch_degraded|device_reinit)"' "$TRACE5" || \
+    grep -q '"kind": "read"' "$TRACE5"
+
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5"
