@@ -406,7 +406,7 @@ class TpuBackend(Partitioner):
         # Device accumulation is int32; flush to a host int64 accumulator
         # before a vertex could possibly see 2^31 endpoints, so trillion-edge
         # streams cannot overflow (cross-chunk totals live host-side).
-        flush_every = max(1, (2**31 - 1) // max(2 * cs, 1))
+        flush_every = degrees_ops.flush_every_for(cs)
         if state:
             deg_host = state.arrays["deg"].copy()
         else:
@@ -460,10 +460,7 @@ class TpuBackend(Partitioner):
             # positions are int32 ranks; degree values only matter
             # ordinally, so clip the int64 totals into int32 for the
             # device sort via rankdata
-            deg_rank = deg_host \
-                if deg_host.size == 0 or deg_host.max() < 2**31 \
-                else np.argsort(np.argsort(deg_host, kind="stable"),
-                                kind="stable")
+            deg_rank = degrees_ops.rank_clip_i32(deg_host)
             deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
             pos, order = order_ops.elimination_order(deg_dev, n)
             # tiny host pull as the completion barrier: block_until_ready

@@ -25,6 +25,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="sheep",
         description="TPU-native distributed graph partitioner "
                     "(SHEEP elimination-tree algorithm)",
+        epilog="server mode: `sheep serve --socket PATH` runs the "
+               "resident sheepd daemon (warm compiled programs, "
+               "multi-tenant job queue); `sheep submit --server PATH "
+               "--input G --k N` submits to one. See README 'Server "
+               "mode'.",
     )
     p.add_argument("--input",
                    help="edge list (.edges/.txt text, .bin32/.bin64 "
@@ -230,6 +235,20 @@ def _parse_warm_schedule(spec: str, parser) -> tuple:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # server verbs (ISSUE 10): `sheep serve ...` runs the resident
+    # daemon, `sheep submit ...` talks to one — both also installed as
+    # standalone console scripts (sheepd / sheep-submit). Dispatched
+    # before argparse so the flat flag grammar stays untouched.
+    if argv and argv[0] == "serve":
+        from sheep_tpu.server.daemon import main as daemon_main
+
+        return daemon_main(argv[1:])
+    if argv and argv[0] == "submit":
+        from sheep_tpu.server.client import main as submit_main
+
+        return submit_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.heartbeat_secs is not None:

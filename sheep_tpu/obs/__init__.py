@@ -81,6 +81,20 @@ def begin(name: str, **attrs):
     return t.begin(name, **attrs) if t is not None else NULL_SPAN
 
 
+def begin_detached(name: str, parent=None, **attrs):
+    """Explicitly-started DETACHED span: parented to the given span id
+    (or a root when None) instead of the calling thread's span stack,
+    and never pushed onto that stack. The form for intervals that
+    interleave rather than nest — e.g. per-job spans on the server
+    scheduler thread. ``parent`` accepts a Span too (its id is used)."""
+    t = _TRACER
+    if t is None:
+        return NULL_SPAN
+    if isinstance(parent, (Span, NullSpan)):
+        parent = getattr(parent, "id", None)
+    return t.begin_detached(name, parent=parent, **attrs)
+
+
 def absorb(stats: dict) -> None:
     """One-shot overwrite-merge of a stats dict into the registry (see
     CounterRegistry.absorb). For the per-chunk absorption of a RUN's

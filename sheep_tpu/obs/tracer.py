@@ -119,16 +119,29 @@ class NullStatsAccumulator:
 
 NULL_STATS = NullStatsAccumulator()
 
+# sentinel: "no explicit parent given — derive from the thread-local
+# stack" (None is a valid explicit parent meaning "root")
+_STACK_PARENT = object()
+
 
 class Span:
     """One traced interval. Usable as a context manager or via explicit
     ``start()``/``end()`` (unbalanced on purpose when the process dies —
-    see module docstring)."""
+    see module docstring).
+
+    ``parent``/``attach``: by default a span parents to the enclosing
+    span on ITS thread's stack and joins that stack. A DETACHED span
+    (``attach=False``, parent given explicitly) does neither — it is the
+    form for interleaved long-lived intervals that do not nest in time
+    on any one thread (the server scheduler's per-job spans: job A's
+    root must not become the parent of job B's phases just because both
+    jobs step on the scheduler thread)."""
 
     __slots__ = ("_tracer", "name", "attrs", "id", "parent", "_t0",
-                 "_snap", "_done")
+                 "_snap", "_done", "_parent_arg", "_attach")
 
-    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict,
+                 parent=_STACK_PARENT, attach: bool = True):
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
@@ -137,15 +150,19 @@ class Span:
         self._t0 = 0.0
         self._snap: dict = {}
         self._done = False
+        self._parent_arg = parent
+        self._attach = attach
 
     def start(self) -> "Span":
         tr = self._tracer
-        self.parent = tr._current_id()
+        self.parent = tr._current_id() \
+            if self._parent_arg is _STACK_PARENT else self._parent_arg
         self.id = tr._next_id()
         self._snap = tr.counters.snapshot()
         with tr._balance_lock:  # spans may start on worker threads
             tr._open_spans += 1
-        tr._push(self.id)
+        if self._attach:
+            tr._push(self.id)
         tr.emit("span_start", span=self.name, id=self.id,
                 parent=self.parent, **self.attrs)
         self._t0 = time.perf_counter()
@@ -159,7 +176,8 @@ class Span:
         secs = time.perf_counter() - self._t0
         with tr._balance_lock:
             tr._open_spans -= 1
-        tr._pop(self.id)
+        if self._attach:
+            tr._pop(self.id)
         fields = dict(span=self.name, id=self.id, parent=self.parent,
                       secs=round(secs, 6), **self.attrs)
         fields.update(extra)
@@ -230,6 +248,13 @@ class Tracer:
 
     def begin(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs).start()
+
+    def begin_detached(self, name: str, parent=None, **attrs) -> Span:
+        """Start a DETACHED span: explicit ``parent`` span id (or None
+        for a root), never on any thread's span stack — for intervals
+        that interleave in time instead of nesting (see Span)."""
+        return Span(self, name, attrs, parent=parent,
+                    attach=False).start()
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)

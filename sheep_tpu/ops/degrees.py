@@ -24,3 +24,28 @@ def degree_chunk(deg: jax.Array, edges: jax.Array, n: int) -> jax.Array:
 
 def init_degrees(n: int) -> jax.Array:
     return jnp.zeros(n + 1, dtype=jnp.int32)
+
+
+def flush_every_for(chunk_edges: int) -> int:
+    """Chunks between flushes of the int32 device accumulator into the
+    int64 host totals: flush BEFORE any vertex could possibly see 2^31
+    endpoints, so trillion-edge streams cannot overflow. Shared by the
+    tpu backend and the server engine — the served build's degree
+    totals must accumulate exactly like the CLI's for the bit-identity
+    contract."""
+    return max(1, (2**31 - 1) // max(2 * chunk_edges, 1))
+
+
+def rank_clip_i32(deg_host):
+    """int64 host degree totals -> int32-safe sort keys for the device
+    elimination order. Degree values only matter ORDINALLY, so totals
+    past int32 range are replaced by their stable ranks (double
+    argsort); below it the totals pass through unchanged. Shared by
+    the tpu backend and the server engine (same bit-identity argument
+    as :func:`flush_every_for`)."""
+    import numpy as np
+
+    if deg_host.size == 0 or deg_host.max() < 2**31:
+        return deg_host
+    return np.argsort(np.argsort(deg_host, kind="stable"),
+                      kind="stable")

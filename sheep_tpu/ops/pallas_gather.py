@@ -3,7 +3,8 @@ weak #3).
 
 CLOSED 2026-08-01: answered on real hardware — Mosaic rejects or
 crashes on every gather form larger than one (8, 128) register tile,
-probed exhaustively on-chip (tools/pallas_smoke{,2,3}.py; BASELINE.md
+probed exhaustively on-chip (tools/pallas_smoke.py --variant 1|2|3;
+BASELINE.md
 round-5 capture section), so XLA's native gather stands as the
 hot-loop primitive by measurement. This module stays as the recorded
 artifact of that evaluation and for the interpreter-mode semantics pin

@@ -1,0 +1,253 @@
+"""Thin sheepd client + the ``sheep-submit`` CLI verb.
+
+    from sheep_tpu.server.client import SheepClient
+
+    with SheepClient("/run/sheepd.sock") as c:
+        jid = c.submit("graph.bin64", k=64, tenant="alice")["job_id"]
+        job = c.wait(jid, timeout_s=600)
+        print(job["results"][0]["edge_cut"])
+
+Addressing: a string containing ``/`` (or ending in ``.sock``) is a
+unix socket path; ``host:port`` or a bare integer is TCP. One request
+per call, synchronous. The client itself is sockets + json only — it
+needs no accelerator and never touches a device (the parent package's
+backend registry does import jax at interpreter load; the daemon-side
+machinery proper — engine/scheduler — stays un-imported here, see
+``sheep_tpu/server/__init__.py``).
+
+CLI::
+
+    sheep-submit --server /run/sheepd.sock --input g.edges --k 8,64 \\
+        --wait [--output parts.pbin] [--tenant alice] [--deadline 60]
+    sheep-submit --server ... --status JOB | --cancel JOB | --stats \\
+        | --ping | --shutdown
+
+Exit codes: 0 op succeeded (for --wait: job DONE), 1 usage/transport,
+2 daemon answered ok=false, 3 job reached a non-done terminal state
+(failed / cancelled / deadline_exceeded / rejected), 4 --wait's
+--timeout elapsed with the job still queued/running (not terminal —
+do not resubmit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+from typing import Optional
+
+from sheep_tpu.server import protocol
+
+
+def _connect(server: str, timeout_s: float) -> socket.socket:
+    server = str(server)
+    if "/" in server or server.endswith(".sock"):
+        s = socket.socket(socket.AF_UNIX)
+        s.settimeout(timeout_s)
+        s.connect(server)
+        return s
+    host, _, port = server.rpartition(":")
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise ServerError(
+            f"bad --server address {server!r}: want a unix socket path "
+            f"(contains '/') or host:port") from None
+    s = socket.create_connection((host or "127.0.0.1", port_n),
+                                 timeout=timeout_s)
+    return s
+
+
+class SheepClient:
+    """One connection to a sheepd; methods mirror the protocol ops and
+    return the daemon's response body (raising :class:`ServerError`
+    on ok=false)."""
+
+    def __init__(self, server: str, timeout_s: float = 600.0):
+        self.server = server
+        self._sock = _connect(server, timeout_s)
+        self._rf = self._sock.makefile("rb")
+
+    def close(self) -> None:
+        try:
+            self._rf.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "SheepClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def request(self, doc: dict) -> dict:
+        self._sock.sendall(protocol.dumps(doc))
+        line = self._rf.readline()
+        if not line:
+            raise ServerError("connection closed by daemon")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ServerError(resp.get("error", "unknown daemon error"))
+        return resp
+
+    # -- ops -----------------------------------------------------------
+    def ping(self) -> dict:
+        return self.request({"op": "ping"})
+
+    def submit(self, input: str, k, tenant: str = "default",
+               **job_fields) -> dict:
+        job = {"input": input, "k": k, **job_fields}
+        return self.request({"op": "submit", "tenant": tenant,
+                             "job": job})
+
+    def status(self, job_id: str) -> dict:
+        return self.request({"op": "status", "job_id": job_id})["job"]
+
+    def wait(self, job_id: str,
+             timeout_s: Optional[float] = None) -> dict:
+        return self.request({"op": "wait", "job_id": job_id,
+                             "timeout_s": timeout_s})["job"]
+
+    def cancel(self, job_id: str) -> str:
+        return self.request({"op": "cancel",
+                             "job_id": job_id})["state"]
+
+    def list(self) -> list:
+        return self.request({"op": "list"})["jobs"]
+
+    def stats(self) -> dict:
+        return self.request({"op": "stats"})["stats"]
+
+    def shutdown(self, drain: bool = False) -> dict:
+        return self.request({"op": "shutdown", "drain": drain})
+
+    def result_assignment(self, job: dict, k: Optional[int] = None):
+        """Decode the packed assignment for part count ``k`` (default:
+        the job's first) from a wait/status descriptor — only present
+        when the job was submitted with ``return_assignment``."""
+        for row in job.get("results") or []:
+            if k is None or row.get("k") == k:
+                if "assignment" not in row:
+                    break
+                return protocol.decode_assignment(row["assignment"])
+        raise ServerError(
+            f"job {job.get('job_id')} carries no assignment for k={k} "
+            f"(submit with return_assignment=true)")
+
+
+class ServerError(RuntimeError):
+    """The daemon answered ok=false (or went away mid-request)."""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sheep-submit",
+        description="submit partition jobs to a running sheepd")
+    p.add_argument("--server", required=True,
+                   help="daemon address: unix socket path or host:port")
+    p.add_argument("--input", help="graph path or synthetic spec "
+                                   "(as the main CLI's --input)")
+    p.add_argument("--k", help="part count, or comma list for multi-k "
+                               "from one shared tree")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--chunk-edges", type=int, default=None)
+    p.add_argument("--dispatch-batch", type=int, default=None)
+    p.add_argument("--alpha", type=float, default=None)
+    p.add_argument("--weights", choices=["unit", "degree"], default=None)
+    p.add_argument("--comm-volume", action="store_true")
+    p.add_argument("--num-vertices", type=int, default=None)
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="seconds from submit until the job must be "
+                        "done (expired -> deadline_exceeded)")
+    p.add_argument("--output", default=None,
+                   help="daemon-side partition map path (.parts/.pbin)")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job is terminal; print its "
+                        "descriptor; exit 0 only on done")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="with --wait: give up after this many seconds")
+    p.add_argument("--status", metavar="JOB")
+    p.add_argument("--cancel", metavar="JOB")
+    p.add_argument("--stats", action="store_true")
+    p.add_argument("--ping", action="store_true")
+    p.add_argument("--shutdown", action="store_true")
+    p.add_argument("--drain", action="store_true",
+                   help="with --shutdown: finish accepted jobs first")
+    return p
+
+
+def main(argv=None) -> int:
+    p = build_parser()
+    args = p.parse_args(argv)
+    modes = [bool(args.input), bool(args.status), bool(args.cancel),
+             args.stats, args.ping, args.shutdown]
+    if sum(modes) != 1:
+        p.error("pass exactly one of --input (submit), --status, "
+                "--cancel, --stats, --ping, --shutdown")
+    try:
+        with SheepClient(args.server) as c:
+            if args.ping:
+                print(json.dumps(c.ping()))
+                return 0
+            if args.stats:
+                print(json.dumps(c.stats(), indent=1))
+                return 0
+            if args.shutdown:
+                print(json.dumps(c.shutdown(drain=args.drain)))
+                return 0
+            if args.status:
+                print(json.dumps(c.status(args.status)))
+                return 0
+            if args.cancel:
+                print(json.dumps({"job_id": args.cancel,
+                                  "state": c.cancel(args.cancel)}))
+                return 0
+            # submit
+            if not args.k:
+                p.error("--input needs --k")
+            try:
+                ks = [int(x) for x in str(args.k).split(",") if x != ""]
+            except ValueError:
+                ks = []
+            if not ks or any(k < 1 for k in ks):
+                p.error(f"--k must be a positive int or comma list "
+                        f"(got {args.k!r})")
+            job = {"k": ks}
+            for field, val in (("chunk_edges", args.chunk_edges),
+                               ("dispatch_batch", args.dispatch_batch),
+                               ("alpha", args.alpha),
+                               ("weights", args.weights),
+                               ("num_vertices", args.num_vertices),
+                               ("deadline_s", args.deadline),
+                               ("output", args.output)):
+                if val is not None:
+                    job[field] = val
+            if args.comm_volume:
+                job["comm_volume"] = True
+            resp = c.submit(args.input, tenant=args.tenant, **job)
+            if not args.wait:
+                print(json.dumps(resp))
+                return 0
+            desc = c.wait(resp["job_id"], timeout_s=args.timeout)
+            print(json.dumps(desc))
+            if desc.get("state") == "done":
+                return 0
+            if desc.get("state") in ("queued", "running"):
+                # --timeout elapsed with the job still in flight: NOT a
+                # terminal failure — a supervisor must not resubmit
+                print(f"sheep-submit: wait timed out; job "
+                      f"{desc.get('job_id')} is still "
+                      f"{desc.get('state')}", file=sys.stderr)
+                return 4
+            return 3
+    except (ServerError, OSError, json.JSONDecodeError) as e:
+        kind = "daemon" if isinstance(e, ServerError) else "transport"
+        print(f"sheep-submit: {kind} error: {e}", file=sys.stderr)
+        return 2 if isinstance(e, ServerError) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

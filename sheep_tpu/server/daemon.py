@@ -1,0 +1,259 @@
+"""sheepd — the resident partition daemon (ISSUE 10 tentpole).
+
+    sheepd --socket /run/sheepd.sock [--trace t.jsonl] [...]
+    sheepd --port 7433 [--host 127.0.0.1]
+    sheep serve ...            # same thing, via the main CLI
+
+One process holds the warm jit caches, the device chunk cache and the
+admission scheduler (:mod:`sheep_tpu.server.scheduler`); connections
+speak the newline-JSON protocol (:mod:`sheep_tpu.server.protocol`).
+Thread model: one accept loop, one handler thread per connection
+(handlers only touch the scheduler's locked API — a slow client can
+never stall the dispatch chain), one dispatch thread stepping the
+admitted jobs.
+
+Faults in a served job degrade THAT job (the per-job retry/degrade
+layer in the engine, ISSUE 9 reused); a handler or protocol error is
+answered on the wire; only a failure of the daemon's own bring-up
+(socket bind, trace sink) is fatal. ``shutdown`` (or SIGTERM/SIGINT)
+runs the clean path: cancel-or-drain the jobs, end every span, stop
+the heartbeat, close the tracer — a clean shutdown leaves a trace
+with ZERO unclosed spans (tools/obs_smoke.sh leg 6 gates this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+
+from sheep_tpu.server import protocol
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sheepd",
+        description="resident partition server: warm compiled programs, "
+                    "device chunk cache, membudget-aware multi-tenant "
+                    "job queue")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="unix socket path to listen on")
+    p.add_argument("--port", type=int, default=None,
+                   help="TCP port to listen on (local use; no auth)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="TCP bind address (default 127.0.0.1)")
+    p.add_argument("--budget-bytes", type=int, default=None,
+                   help="admission budget in device bytes (default: "
+                        "SHEEP_CACHE_BYTES, else 90%% of reported HBM, "
+                        "else unlimited on cpu-jax)")
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="append the obs trace (manifest, per-job span "
+                        "trees, heartbeats) to FILE")
+    p.add_argument("--heartbeat-secs", type=float, default=None,
+                   metavar="S",
+                   help="with --trace: periodic progress heartbeats")
+    return p
+
+
+class Daemon:
+    def __init__(self, args):
+        self.args = args
+        self._sock: socket.socket = None
+        self._threads: list = []
+        self._shutdown_evt = threading.Event()
+        self.scheduler = None
+        self._root_span = None
+
+    # -- wire ----------------------------------------------------------
+    def _bind(self) -> socket.socket:
+        a = self.args
+        if (a.socket is None) == (a.port is None):
+            raise SystemExit("sheepd: pass exactly one of --socket PATH "
+                             "or --port N")
+        if a.socket is not None:
+            # a stale socket file from a dead daemon would fail the
+            # bind; connect-probe it so we never steal a live one
+            if os.path.exists(a.socket):
+                probe = socket.socket(socket.AF_UNIX)
+                try:
+                    probe.settimeout(0.5)
+                    probe.connect(a.socket)
+                except OSError:
+                    os.unlink(a.socket)
+                else:
+                    probe.close()
+                    raise SystemExit(f"sheepd: {a.socket} already has a "
+                                     f"live daemon")
+                finally:
+                    probe.close()
+            s = socket.socket(socket.AF_UNIX)
+            s.bind(a.socket)
+        else:
+            s = socket.socket(socket.AF_INET)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((a.host, a.port))
+        s.listen(64)
+        return s
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown_evt.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by shutdown
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="sheepd-conn")
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        with conn:
+            rf = conn.makefile("rb")
+            try:
+                while True:
+                    try:
+                        line = protocol.read_line(rf)
+                    except protocol.ProtocolError as e:
+                        conn.sendall(protocol.dumps(
+                            {"ok": False, "error": str(e)}))
+                        return
+                    if line is None:
+                        return
+                    if not line.strip():
+                        continue
+                    try:
+                        req = protocol.parse_request(line)
+                        resp = self._dispatch(req)
+                    except protocol.ProtocolError as e:
+                        resp = {"ok": False, "error": str(e)}
+                    except Exception as e:  # noqa: BLE001 — answered
+                        resp = {"ok": False,
+                                "error": f"internal: {type(e).__name__}: "
+                                         f"{str(e)[:300]}"}
+                    try:
+                        conn.sendall(protocol.dumps(resp))
+                    except OSError:
+                        return  # peer went away mid-answer
+            finally:
+                rf.close()
+
+    # -- ops -----------------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        op = req["op"]
+        sched = self.scheduler
+        if op == "ping":
+            return {"ok": True, "pid": os.getpid(),
+                    "uptime_s": sched.stats()["uptime_s"]}
+        if op == "submit":
+            spec = protocol.JobSpec.from_request(
+                req.get("job"), tenant=req.get("tenant", "default"))
+            job = sched.submit(spec)
+            return {"ok": True, "job_id": job.id, "state": job.state,
+                    **({"error": job.error} if job.error else {})}
+        if op in ("status", "wait", "cancel"):
+            job_id = req.get("job_id")
+            if not job_id:
+                raise protocol.ProtocolError(f"{op} needs job_id")
+            if op == "cancel":
+                state = sched.cancel(job_id)
+                if state is None:
+                    raise protocol.ProtocolError(
+                        f"unknown job {job_id!r}")
+                return {"ok": True, "job_id": job_id, "state": state}
+            if op == "wait":
+                job = sched.wait(job_id,
+                                 timeout_s=req.get("timeout_s"))
+            else:
+                job = sched.get(job_id)
+            if job is None:
+                raise protocol.ProtocolError(f"unknown job {job_id!r}")
+            return {"ok": True, "job": job.descriptor(with_results=True)}
+        if op == "list":
+            return {"ok": True,
+                    "jobs": [j.descriptor() for j in sched.jobs()]}
+        if op == "stats":
+            return {"ok": True, "stats": sched.stats()}
+        if op == "shutdown":
+            drain = bool(req.get("drain", False))
+            sched.shutdown(drain=drain)
+            self._shutdown_evt.set()
+            return {"ok": True, "draining": drain}
+        raise protocol.ProtocolError(f"unhandled op {op!r}")
+
+    # -- lifecycle -----------------------------------------------------
+    def serve(self) -> int:
+        from sheep_tpu.utils.platform import (enable_compilation_cache,
+                                              pin_platform)
+
+        pin_platform()
+        enable_compilation_cache()
+        from sheep_tpu import obs
+        from sheep_tpu.server.scheduler import Scheduler
+
+        a = self.args
+        tracer = None
+        if a.trace:
+            tracer = obs.install(obs.Tracer(a.trace))
+            obs.emit_manifest(tracer, config=vars(a), backend="sheepd")
+            if a.heartbeat_secs:
+                tracer.heartbeat = obs.Heartbeat(
+                    tracer, a.heartbeat_secs).start()
+        root_span = obs.begin("serve")
+        self._root_span = root_span
+        try:
+            self.scheduler = Scheduler(
+                budget_bytes=a.budget_bytes,
+                root_span_id=getattr(root_span, "id", None))
+            self._sock = self._bind()
+            addr = a.socket if a.socket is not None \
+                else f"{a.host}:{a.port}"
+            print(f"sheepd: listening on {addr} (budget="
+                  f"{self.scheduler.budget or 'unlimited'})",
+                  file=sys.stderr, flush=True)
+
+            def _sig(_num, _frame):
+                self.scheduler.shutdown(drain=False)
+                self._shutdown_evt.set()
+
+            try:
+                signal.signal(signal.SIGTERM, _sig)
+                signal.signal(signal.SIGINT, _sig)
+            except ValueError:
+                pass  # not the main thread (embedded/test use)
+            acceptor = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="sheepd-accept")
+            acceptor.start()
+            # the dispatch loop runs on THIS thread until shutdown
+            self.scheduler.run()
+            self._shutdown_evt.set()
+            return 0
+        finally:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+            if a.socket and os.path.exists(a.socket):
+                try:
+                    os.unlink(a.socket)
+                except OSError:
+                    pass
+            root_span.end()
+            if tracer is not None:
+                if tracer.heartbeat is not None:
+                    tracer.heartbeat.stop()
+                obs.uninstall()
+                tracer.close()
+            print("sheepd: shut down cleanly", file=sys.stderr,
+                  flush=True)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return Daemon(args).serve()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

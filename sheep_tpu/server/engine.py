@@ -1,0 +1,306 @@
+"""One served partition job as a cooperative step generator.
+
+The daemon cannot afford one thread blocked per job (a blocked host
+thread serializes nothing usefully — device executions already
+serialize on the one dispatch chain), so a job is a GENERATOR over the
+existing ops: each ``yield`` marks one unit of device work done
+(a degrees chunk, a staged build group, a scoring chunk), and the
+scheduler round-robins ``next()`` across admitted jobs. That makes the
+interleave explicit and deterministic: staged segments from DIFFERENT
+jobs alternate on one dispatch chain, each folding into its own
+carried table — sound because each job's elimination fixpoint is
+order-independent in its own constraint multiset (the PR-1/PR-3
+invariant; no job ever reads another's table).
+
+Bit-identity with the cold CLI build is by construction, not by luck:
+the degree accumulation (int64 host totals), the rank clip, the
+elimination order, the batched fold (unique fixpoint at any batch
+shape), the host tree split and the scoring pass are the same ops the
+``tpu`` backend drives, in the same vertex spaces.
+
+Fault containment (per job, ISSUE 9 reused): each staged group folds
+under the job's own :class:`~sheep_tpu.utils.retry.RetryPolicy` —
+an OOM-class fault degrades THAT job's dispatch batch (membudget
+model) and re-folds the same staged block bit-identically
+(``donate=False`` keeps the inputs valid across the retry); read
+faults never even surface here (the edgestream's bounded retry
+absorbs them). A fault that exhausts its budget fails the job, not
+the daemon.
+
+Cancellation: the scheduler calls ``close()`` on the step generator;
+GeneratorExit unwinds through the ``finally`` blocks below, which
+close the chunk/group iterators — and through them the prefetch
+workers (``Prefetcher.close()``: stop + drain + join) — and end the
+job's phase spans, deterministically, before the job is marked
+cancelled.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from sheep_tpu import obs
+from sheep_tpu.backends.tpu_backend import (_device_chunk_groups,
+                                            _device_chunks,
+                                            resolve_dispatch_batch)
+from sheep_tpu.io.edgestream import open_input
+from sheep_tpu.ops import degrees as degrees_ops
+from sheep_tpu.ops import elim as elim_ops
+from sheep_tpu.ops import order as order_ops
+from sheep_tpu.ops import score as score_ops
+from sheep_tpu.ops import split as split_ops
+from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
+from sheep_tpu.utils import retry as retry_mod
+
+
+class JobEngine:
+    """Drives one admitted job; see module docstring. ``job`` is a
+    :class:`sheep_tpu.server.scheduler.Job`; ``cache`` an optional
+    shared device chunk cache (the daemon's, keyed to this input)."""
+
+    def __init__(self, job, cache=None):
+        self.job = job
+        self.cache = cache
+        # live dispatch knobs — the retry layer's degrade hook halves
+        # these mid-build; the staging loop restages at the new shape
+        self.batch: Optional[int] = None
+        self._n = 0
+        self._cs = 0
+        self._build_idx = 0
+
+    # -- fault hooks (per job; the daemon survives, the job degrades) --
+    def _on_resource(self):
+        # DETACH from the shared chunk cache rather than clearing it in
+        # place: a suspended _device_chunks iterator may be mid-way
+        # through cache.chunks, and emptying the list under it would
+        # make it restart the upload stream at 0 (re-folding the prefix
+        # — harmless for the fixpoint, but wasted device work and a
+        # skewed step count). The cache_shed flag tells the scheduler
+        # to drop the whole entry at finalize, so the HBM is released
+        # when the engine's references die and future jobs start fresh.
+        if self.cache is not None:
+            self.cache = None
+            self.job.cache_shed = True
+        nxt = retry_mod.degrade_dispatch(
+            self._n, self._cs, self.batch or 1, 1, False,
+            self.job.stats, self._build_idx)
+        if nxt is not None:
+            self.batch = nxt[0]
+
+    def _on_device_loss(self):
+        # best-effort in-process runtime reinit (utils/retry, ISSUE 9):
+        # THIS job's live device arrays died with the old client, so
+        # its own retries usually exhaust and the job FAILS — but the
+        # reinit is what keeps the resident daemon able to serve the
+        # NEXT job on a fresh runtime instead of failing every request
+        # against a dead accelerator forever. (No snapshot hook: served
+        # jobs have no checkpointer; kill+resume is the CLI contract.)
+        retry_mod.recover_device_loss(self.job.stats, self._build_idx)
+
+    def steps(self):
+        """The step generator (see module docstring); sets
+        ``job.results`` before finishing."""
+        job = self.job
+        stats = job.stats
+        stats_acc = obs.stats_accumulator()
+        policy = retry_mod.RetryPolicy()
+        t_phase: dict = {}
+        with open_input(job.spec.input,
+                        n_vertices=job.spec.num_vertices) as es:
+            n = es.num_vertices
+            check_tpu_vertex_range(n, "sheepd")
+            cs = es.clamp_chunk_edges(job.spec.chunk_edges)
+            self._n, self._cs = n, cs
+            self.batch = resolve_dispatch_batch(job.spec.dispatch_batch,
+                                                n, cs)
+            stats["dispatch_batch"] = self.batch
+            job.n_vertices = n
+
+            # ---- degrees --------------------------------------------
+            t0 = time.perf_counter()
+            sp = obs.begin_detached("degrees", parent=job.span_id)
+            deg_host = np.zeros(n, dtype=np.int64)
+            deg = degrees_ops.init_degrees(n)
+            flush_every = degrees_ops.flush_every_for(cs)
+            since = 0
+            chunks = _device_chunks(es, cs, n, self.cache, 0)
+            try:
+                for padded in chunks:
+                    deg = degrees_ops.degree_chunk(deg, padded, n)
+                    since += 1
+                    if since >= flush_every:
+                        deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
+                                               dtype=np.int64)
+                        deg = degrees_ops.init_degrees(n)
+                        since = 0
+                    stats_acc.absorb(stats)
+                    yield "degrees"
+            finally:
+                chunks.close()
+                sp.end()
+            deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
+                                   dtype=np.int64)
+            t_phase["degrees"] = time.perf_counter() - t0
+
+            # ---- sort (one step) ------------------------------------
+            t0 = time.perf_counter()
+            sp = obs.begin_detached("sort", parent=job.span_id)
+            try:
+                # the rank clip + flush cadence are SHARED with the tpu
+                # backend (ops/degrees.py) — the served==CLI bit-identity
+                # contract must not rest on two hand-maintained copies
+                deg_rank = degrees_ops.rank_clip_i32(deg_host)
+                deg_dev = jnp.asarray(deg_rank, dtype=jnp.int32)
+                pos, order = order_ops.elimination_order(deg_dev, n)
+                # tiny pull as the real completion barrier (same rule
+                # as the tpu backend: block_until_ready is not a
+                # barrier on a tunneled device)
+                pos_host = np.asarray(pos[:n])  # sheeplint: sync-ok
+            finally:
+                sp.end()
+            t_phase["sort"] = time.perf_counter() - t0
+            yield "sort"
+
+            # ---- build: staged batched dispatch ---------------------
+            t0 = time.perf_counter()
+            sp = obs.begin_detached("build", parent=job.span_id)
+            P = jnp.full(n + 1, n, dtype=jnp.int32)
+            total_rounds = 0
+            self._build_idx = 0
+            sentinel_chunk = None
+            try:
+                while True:
+                    batch = self.batch
+                    groups = _device_chunk_groups(
+                        es, cs, n, self.cache, self._build_idx, batch)
+                    restage = False
+                    try:
+                        for group in groups:
+                            gl = len(group)
+                            if gl < batch:
+                                if sentinel_chunk is None:
+                                    sentinel_chunk = jnp.full(
+                                        (cs, 2), n, jnp.int32)
+                                group = group + [sentinel_chunk] * \
+                                    (batch - gl)
+                            loB, hiB = elim_ops.orient_chunks_batch_pos(
+                                jnp.stack(group), pos, n)
+                            while True:
+                                try:
+                                    P2, rounds = \
+                                        elim_ops.fold_segments_batch(
+                                            P, loB, hiB, n,
+                                            segment_rounds=job.spec
+                                            .segment_rounds,
+                                            stats=stats, donate=False)
+                                    break
+                                except Exception as exc:
+                                    # classify/budget/count/backoff —
+                                    # degrade THIS job, never the
+                                    # daemon; donate=False keeps
+                                    # P/loB/hiB valid for the retry
+                                    retry_mod.handle_build_fault(
+                                        policy, exc,
+                                        f"sheepd.{job.id}.build", stats,
+                                        on_resource=self._on_resource,
+                                        on_device_loss=self
+                                        ._on_device_loss)
+                            P = P2
+                            total_rounds += int(rounds)
+                            self._build_idx += gl
+                            stats_acc.absorb(stats)
+                            yield "build"
+                            if self.batch != batch:
+                                # degraded mid-stream: restage the
+                                # remainder at the new shape
+                                restage = True
+                                break
+                    finally:
+                        groups.close()
+                    if not restage:
+                        break
+            finally:
+                sp.end(rounds=int(total_rounds))
+            stats["fixpoint_rounds"] = float(total_rounds)
+            minp = P[pos]
+            np.asarray(minp[:1])  # barrier  # sheeplint: sync-ok
+            t_phase["build"] = time.perf_counter() - t0
+
+            # ---- split (host, per k — the multi-k reuse query) ------
+            t0 = time.perf_counter()
+            sp = obs.begin_detached("split", parent=job.span_id)
+            try:
+                parent = elim_ops.minp_to_parent(minp, order, n)
+                w = deg_host.astype(np.float64) \
+                    if job.spec.weights == "degree" else None
+                assigns = {}
+                for k in job.spec.ks:
+                    assigns[k] = split_ops.tree_split_host(
+                        parent, pos_host, k, weights=w,
+                        alpha=job.spec.alpha)
+            finally:
+                sp.end()
+            t_phase["split"] = time.perf_counter() - t0
+            yield "split"
+
+            # ---- score: ONE stream pass for every k -----------------
+            t0 = time.perf_counter()
+            sp = obs.begin_detached("score", parent=job.span_id)
+            dev_assign = {
+                k: jnp.concatenate([jnp.asarray(a, dtype=jnp.int32),
+                                    jnp.zeros(1, dtype=jnp.int32)])
+                for k, a in assigns.items()}
+            cut = {k: 0 for k in assigns}
+            cv_chunks: dict = {k: [] for k in assigns}
+            total = 0
+            chunks = _device_chunks(es, cs, n, self.cache, 0)
+            try:
+                for padded in chunks:
+                    first = True
+                    for k, a_dev in dev_assign.items():
+                        c, tt = score_ops.score_chunk(padded, a_dev, n)
+                        # designed per-chunk score pull (two scalars)
+                        cut[k] += int(c)  # sheeplint: sync-ok
+                        if first:
+                            total += int(tt)  # sheeplint: sync-ok
+                            first = False
+                        if job.spec.comm_volume:
+                            score_ops.accumulate_cv_keys(
+                                cv_chunks[k],
+                                score_ops.cut_pair_keys_host(
+                                    padded, a_dev, n, k))
+                    stats_acc.absorb(stats)
+                    yield "score"
+            finally:
+                chunks.close()
+                sp.end()
+            t_phase["score"] = time.perf_counter() - t0
+
+        from sheep_tpu.core import pure
+        from sheep_tpu.utils.checkpoint import compact_cv_keys
+
+        results = []
+        for k in job.spec.ks:
+            cv = int(len(compact_cv_keys(cv_chunks[k]))) \
+                if job.spec.comm_volume else None
+            bal = pure.part_balance(
+                assigns[k], k,
+                deg_host if job.spec.weights == "degree" else None)
+            results.append(PartitionResult(
+                assignment=assigns[k], k=k, edge_cut=cut[k],
+                total_edges=total,
+                cut_ratio=cut[k] / max(total, 1), balance=bal,
+                comm_volume=cv, phase_times=dict(t_phase),
+                backend="sheepd",
+                diagnostics={kk: (round(float(v), 3)
+                                  if str(kk).startswith("t_")
+                                  or str(kk).endswith("_ms")
+                                  else float(v))
+                             for kk, v in stats.items()
+                             if isinstance(v, (int, float))}))
+        job.results = results

@@ -1,0 +1,190 @@
+"""sheepd wire protocol: newline-delimited JSON over a local socket.
+
+One request per line, one response per line, strictly in order per
+connection (a client may pipeline). Every response carries ``ok``:
+``{"ok": true, ...}`` or ``{"ok": false, "error": "..."}`` — a
+malformed request is answered, never dropped, and never kills the
+connection, let alone the daemon.
+
+Requests (``op`` selects):
+
+    {"op": "ping"}
+    {"op": "submit", "tenant": "alice", "job": {...JobSpec fields...}}
+    {"op": "status", "job_id": "j3"}
+    {"op": "wait",   "job_id": "j3", "timeout_s": 30}
+    {"op": "cancel", "job_id": "j3"}
+    {"op": "list"}
+    {"op": "stats"}
+    {"op": "shutdown", "drain": false}
+
+Job lifecycle (:data:`JOB_STATES`)::
+
+    queued ----> running ----> done | failed | deadline_exceeded
+       |            |
+       |            +--------> cancelled
+       +--> cancelled | rejected
+
+``rejected`` is the admission scheduler's verdict for a job whose
+modeled device footprint exceeds the daemon's whole budget even at the
+fully degraded dispatch shape (membudget.build_phase_bytes at
+dispatch_batch=1); ``queued`` jobs fit the budget but not the current
+free headroom and run when earlier jobs release it.
+
+Deadline semantics: ``deadline_s`` is measured from SUBMIT (queue wait
+counts — the client asked for a result by then, not for a start). An
+expired job reports ``deadline_exceeded`` whether it was still queued
+or mid-build; expiry cancels only that job's step generator, never the
+dispatch chain (other jobs' carried tables are untouched).
+
+Assignments travel base64-packed (little-endian int32) only when the
+submitter asked (``return_assignment``) — scores always travel.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# terminal states never transition again
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+DEADLINE_EXCEEDED = "deadline_exceeded"
+REJECTED = "rejected"
+
+JOB_STATES = (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
+              DEADLINE_EXCEEDED, REJECTED)
+TERMINAL_STATES = (DONE, FAILED, CANCELLED, DEADLINE_EXCEEDED, REJECTED)
+
+OPS = ("ping", "submit", "status", "wait", "cancel", "list", "stats",
+       "shutdown")
+
+MAX_REQUEST_BYTES = 1 << 20  # one request line; jobs are specs, not data
+
+
+class ProtocolError(ValueError):
+    """Malformed request — answered with ok=false, never fatal."""
+
+
+@dataclass
+class JobSpec:
+    """One partition request, validated at the protocol boundary so the
+    scheduler only ever sees well-formed work."""
+
+    input: str
+    ks: list
+    tenant: str = "default"
+    chunk_edges: int = 1 << 22
+    dispatch_batch: int = 0        # 0 = auto (membudget-sized)
+    segment_rounds: int = 2
+    alpha: float = 1.0
+    weights: str = "unit"
+    comm_volume: bool = False
+    num_vertices: Optional[int] = None
+    deadline_s: Optional[float] = None
+    output: Optional[str] = None   # daemon-side partition map path
+    return_assignment: bool = False
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_request(cls, body: dict, tenant: str = "default") -> "JobSpec":
+        if not isinstance(body, dict):
+            raise ProtocolError("job must be an object")
+        if not body.get("input"):
+            raise ProtocolError("job.input is required")
+        ks = body.get("k", body.get("ks"))
+        if isinstance(ks, int):
+            ks = [ks]
+        if not isinstance(ks, list) or not ks \
+                or not all(isinstance(k, int) and k >= 1 for k in ks):
+            raise ProtocolError("job.k must be a positive int or a "
+                               "non-empty list of them")
+        ks = list(dict.fromkeys(ks))  # dupes would alias result rows
+        known = {"input", "k", "ks", "chunk_edges", "dispatch_batch",
+                 "segment_rounds", "alpha", "weights", "comm_volume",
+                 "num_vertices", "deadline_s", "output",
+                 "return_assignment"}
+        unknown = set(body) - known
+        if unknown:
+            raise ProtocolError(f"unknown job field(s): {sorted(unknown)}")
+        spec = cls(
+            input=str(body["input"]), ks=ks, tenant=str(tenant),
+            chunk_edges=int(body.get("chunk_edges", 1 << 22)),
+            dispatch_batch=int(body.get("dispatch_batch", 0)),
+            segment_rounds=int(body.get("segment_rounds", 2)),
+            alpha=float(body.get("alpha", 1.0)),
+            weights=str(body.get("weights", "unit")),
+            comm_volume=bool(body.get("comm_volume", False)),
+            num_vertices=(None if body.get("num_vertices") is None
+                          else int(body["num_vertices"])),
+            deadline_s=(None if body.get("deadline_s") is None
+                        else float(body["deadline_s"])),
+            output=(None if body.get("output") is None
+                    else str(body["output"])),
+            return_assignment=bool(body.get("return_assignment", False)),
+        )
+        if spec.chunk_edges < 1:
+            raise ProtocolError("job.chunk_edges must be >= 1")
+        if spec.dispatch_batch < 0:
+            raise ProtocolError("job.dispatch_batch must be >= 0 "
+                               "(0 = auto)")
+        if spec.weights not in ("unit", "degree"):
+            raise ProtocolError("job.weights must be 'unit' or 'degree'")
+        if spec.deadline_s is not None and spec.deadline_s <= 0:
+            raise ProtocolError("job.deadline_s must be > 0 seconds")
+        if spec.alpha <= 0:
+            raise ProtocolError("job.alpha must be > 0")
+        return spec
+
+
+def encode_assignment(assignment) -> dict:
+    """int array[V] -> {"b64": ..., "n": V, "dtype": "int32"}."""
+    a = np.asarray(assignment, dtype="<i4")
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "n": int(a.size), "dtype": "int32"}
+
+
+def decode_assignment(doc: dict) -> np.ndarray:
+    raw = base64.b64decode(doc["b64"])
+    a = np.frombuffer(raw, dtype="<i4").astype(np.int32)
+    if a.size != int(doc["n"]):
+        raise ProtocolError(f"assignment payload holds {a.size} entries, "
+                            f"header says {doc['n']}")
+    return a
+
+
+def dumps(doc: dict) -> bytes:
+    return (json.dumps(doc, separators=(",", ":")) + "\n").encode()
+
+
+def parse_request(line: bytes) -> dict:
+    if len(line) > MAX_REQUEST_BYTES:
+        raise ProtocolError("request line exceeds 1 MiB")
+    try:
+        doc = json.loads(line.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"bad JSON request: {e}") from None
+    if not isinstance(doc, dict):
+        raise ProtocolError("request must be a JSON object")
+    op = doc.get("op")
+    if op not in OPS:
+        raise ProtocolError(f"unknown op {op!r}; want one of {OPS}")
+    return doc
+
+
+def read_line(sock_file) -> Optional[bytes]:
+    """One protocol line from a socket makefile; None on clean EOF.
+    Bounded: a peer streaming an endless unterminated line cannot grow
+    memory past the request cap."""
+    line = sock_file.readline(MAX_REQUEST_BYTES + 2)
+    if not line:
+        return None
+    if not line.endswith(b"\n") and len(line) > MAX_REQUEST_BYTES:
+        raise ProtocolError("unterminated request line exceeds 1 MiB")
+    return line.rstrip(b"\n")

@@ -1,0 +1,613 @@
+"""Multi-tenant job queue + admission + the interleaving dispatch loop.
+
+The scheduler owns every job from submit to terminal state:
+
+**Admission (membudget-aware).** Each job's device footprint is
+modeled up front with the same HBM model the backends auto-size
+against (``utils/membudget.build_phase_bytes`` at the job's resolved
+dispatch batch). Against the daemon's budget (``SHEEP_CACHE_BYTES``
+override, else 90% of reported HBM, else unlimited on cpu-jax):
+
+- a job that exceeds the WHOLE budget is first shed down the same
+  degradation schedule an OOM would force (``membudget
+  .degraded_dispatch`` — halve the batch while the model says that
+  frees the most), and REJECTED only if it still cannot fit at the
+  fully degraded shape;
+- a job that fits the budget but not the current free headroom stays
+  QUEUED until earlier jobs release their reservation;
+- admitted jobs reserve their modeled bytes until terminal.
+
+**Interleave.** Admitted jobs step round-robin on one thread: each
+step is one staged group of device work
+(:class:`~sheep_tpu.server.engine.JobEngine`), so segments from
+different jobs alternate on one dispatch chain, each folding into its
+own carried table (order-independence of each job's fixpoint in its
+own constraint multiset makes this sound — and
+tests/test_server.py pins interleaved == solo bit-equality).
+
+**Warm programs.** The hot jitted entry points are module-level jit
+caches; the scheduler snapshots their compile-cache sizes around every
+job, so a served response can PROVE warm reuse (``jit_compiles == 0``
+for a repeat shape) — the 8-13 s cold warm-up the daemon exists to
+amortize (BENCH_r03-r05).
+
+**Deadlines / cancellation.** Both are scheduler-side cuts between
+steps: the job's step generator is closed (unwinding through the
+engine's ``finally`` blocks — prefetch workers cancel via
+``Prefetcher.close``, phase spans end) and only that job changes
+state; the dispatch chain and every other job's table are untouched.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+from sheep_tpu import obs
+from sheep_tpu.server import protocol
+from sheep_tpu.server.engine import JobEngine
+from sheep_tpu.server.protocol import (CANCELLED, DEADLINE_EXCEEDED, DONE,
+                                       FAILED, QUEUED, REJECTED, RUNNING,
+                                       TERMINAL_STATES, JobSpec)
+
+
+def _hot_programs():
+    """The jitted entry points whose per-process compile caches ARE the
+    daemon's warm state (one cache entry per distinct shape/static
+    combination)."""
+    from sheep_tpu.ops import degrees as degrees_ops
+    from sheep_tpu.ops import elim as elim_ops
+    from sheep_tpu.ops import order as order_ops
+    from sheep_tpu.ops import score as score_ops
+
+    return {
+        "fold_segments_batch_pos": elim_ops.fold_segments_batch_pos,
+        "fold_segments_batch_pos_donated":
+            elim_ops.fold_segments_batch_pos_donated,
+        "orient_chunks_batch_pos": elim_ops.orient_chunks_batch_pos,
+        "degree_chunk": degrees_ops.degree_chunk,
+        "elimination_order": order_ops.elimination_order,
+        "score_chunk": score_ops.score_chunk,
+    }
+
+
+def compile_cache_sizes() -> dict:
+    """{program: compiled-variant count} for the hot programs — the
+    warm-reuse evidence (a repeat shape adds zero everywhere)."""
+    out = {}
+    for name, fn in _hot_programs().items():
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = -1  # jit internals changed; counter degraded
+    return out
+
+
+def resolve_budget_bytes(budget_bytes: Optional[int] = None):
+    """The daemon's admission budget: an explicit flag wins, then the
+    ``SHEEP_CACHE_BYTES`` override (the documented HBM-budget knob),
+    then 90% of the accelerator's reported HBM; None = unlimited
+    (cpu-jax, where "device" memory is host RAM and the model would
+    gate nothing real)."""
+    if budget_bytes is not None:
+        return int(budget_bytes) if budget_bytes > 0 else None
+    env = os.environ.get("SHEEP_CACHE_BYTES")
+    if env is not None:
+        try:
+            val = int(env)
+        except ValueError:
+            val = 0
+        if val > 0:
+            return val
+        # SHEEP_CACHE_BYTES=0 means "spend nothing on the chunk cache"
+        # everywhere else (tpu_backend._chunk_cache_budget) — for
+        # admission it must NOT mean "unlimited"; fall through to the
+        # platform default instead
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    from sheep_tpu.backends.tpu_backend import _device_hbm_bytes
+
+    hbm = _device_hbm_bytes(purpose="the admission budget")
+    return int(0.9 * hbm) if hbm > 0 else None
+
+
+class Job:
+    """One submitted job: spec + lifecycle + results. State transitions
+    happen only under the scheduler's lock."""
+
+    def __init__(self, job_id: str, spec: JobSpec, n_vertices: int,
+                 modeled_bytes: Optional[int]):
+        self.id = job_id
+        self.spec = spec
+        self.state = QUEUED
+        self.error: Optional[str] = None
+        self.submit_t = time.time()
+        self.start_t: Optional[float] = None
+        self.end_t: Optional[float] = None
+        self.deadline_t = None if spec.deadline_s is None \
+            else self.submit_t + spec.deadline_s
+        self.n_vertices = n_vertices
+        self.modeled_bytes = modeled_bytes
+        self.stats: dict = {}
+        self.results: Optional[list] = None
+        self.gen = None           # the engine step generator, once running
+        self.span = None          # detached obs span for the job tree
+        self.span_id = None
+        self.cancel_requested = False
+        self.steps = 0
+        # per-step compile-cache delta sum (None until started): the
+        # dispatch thread serializes steps, so attributing each step's
+        # global cache growth to the job that ran it is EXACT even
+        # under interleaving — a finalize-time delta would blame one
+        # job for every concurrent job's compiles
+        self.jit_compiles: Optional[int] = None
+        # the engine shed the shared chunk cache under memory pressure;
+        # the scheduler drops the cache entry at finalize so the HBM is
+        # released and future jobs start a fresh cache
+        self.cache_shed = False
+
+    def descriptor(self, with_results: bool = False) -> dict:
+        d = {"job_id": self.id, "tenant": self.spec.tenant,
+             "input": self.spec.input, "k": list(self.spec.ks),
+             "state": self.state, "submit_t": round(self.submit_t, 3),
+             "n_vertices": int(self.n_vertices),
+             "modeled_bytes": self.modeled_bytes, "steps": self.steps}
+        if self.error is not None:
+            d["error"] = self.error
+        if self.deadline_t is not None:
+            d["deadline_t"] = round(self.deadline_t, 3)
+        if self.start_t is not None:
+            d["start_t"] = round(self.start_t, 3)
+        if self.end_t is not None:
+            d["end_t"] = round(self.end_t, 3)
+            base = self.start_t if self.start_t is not None \
+                else self.submit_t
+            d["wall_s"] = round(self.end_t - base, 4)
+        if self.jit_compiles is not None:
+            d["jit_compiles"] = self.jit_compiles
+        if self.state == DONE and self.results is not None:
+            d["results"] = []
+            for r in self.results:
+                row = r.summary()
+                if with_results and self.spec.return_assignment:
+                    row["assignment"] = protocol.encode_assignment(
+                        r.assignment)
+                d["results"].append(row)
+        return d
+
+
+class Scheduler:
+    """See module docstring. Thread model: any number of submitter
+    threads (the daemon's connection handlers) call submit/cancel/wait;
+    ONE dispatch thread calls :meth:`run`. All shared state is guarded
+    by ``self._lock`` (the condition's lock)."""
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 root_span_id=None):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.budget = resolve_budget_bytes(budget_bytes)
+        self.root_span_id = root_span_id
+        self._jobs: "OrderedDict[str, Job]" = OrderedDict()
+        self._pending: deque = deque()
+        self._active: deque = deque()   # admitted; round-robin order
+        self._ids = itertools.count(1)
+        self._stop = False
+        self._draining = False
+        self._caches: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.totals = {"submitted": 0, "done": 0, "failed": 0,
+                       "cancelled": 0, "rejected": 0,
+                       "deadline_exceeded": 0}
+        self.started_t = time.time()
+
+    # ------------------------------------------------------------------
+    # submit-side API (connection handler threads)
+    # ------------------------------------------------------------------
+    def submit(self, spec: JobSpec) -> Job:
+        """Validate + model + enqueue. Raises ProtocolError on inputs
+        that cannot be opened (answered ok=false; no job is created) —
+        admission-budget verdicts come back as a REJECTED job instead,
+        so they are queryable like any other terminal state."""
+        n = self._probe_num_vertices(spec)
+        modeled, batch, rejected_why = self._model(spec, n)
+        with self._lock:
+            if self._stop or self._draining:
+                raise protocol.ProtocolError("daemon is shutting down")
+            job = Job(f"j{next(self._ids)}", spec, n, modeled)
+            # the admission pre-shed: run at the degraded batch that
+            # fits (the same knob an OOM would halve mid-run)
+            if batch is not None and batch != spec.dispatch_batch:
+                job.spec.dispatch_batch = batch
+                job.stats["admission_dispatch_batch"] = batch
+            self._jobs[job.id] = job
+            self.totals["submitted"] += 1
+            if rejected_why is not None:
+                job.state = REJECTED
+                job.error = rejected_why
+                job.end_t = time.time()
+                self.totals["rejected"] += 1
+            else:
+                self._pending.append(job)
+            obs.event("job_submit", job=job.id, tenant=spec.tenant,
+                      input=spec.input, k=list(spec.ks), state=job.state,
+                      modeled_bytes=modeled)
+            self._cond.notify_all()
+            return job
+
+    def _probe_num_vertices(self, spec: JobSpec) -> int:
+        from sheep_tpu.io.edgestream import open_input
+
+        try:
+            with open_input(spec.input,
+                            n_vertices=spec.num_vertices) as es:
+                return int(es.num_vertices)
+        except Exception as e:
+            raise protocol.ProtocolError(
+                f"cannot open job input {spec.input!r}: "
+                f"{type(e).__name__}: {str(e)[:200]}") from None
+
+    def _model(self, spec: JobSpec, n: int):
+        """(modeled_bytes, pre-shed dispatch_batch or None, reject
+        reason or None) for admission. Models at the REQUESTED chunk
+        size (clamping only shrinks it — conservative)."""
+        from sheep_tpu.backends.tpu_backend import resolve_dispatch_batch
+        from sheep_tpu.utils import membudget
+
+        cs = spec.chunk_edges
+        batch = resolve_dispatch_batch(spec.dispatch_batch, n, cs)
+        if self.budget is None:
+            return None, None, None
+
+        def total(b):
+            return membudget.build_phase_bytes(
+                n, cs, dispatch_batch=b)["total_bytes"]
+
+        m = total(batch)
+        shed = None
+        while m > self.budget:
+            nxt = membudget.degraded_dispatch(n, cs, batch, 1)
+            if nxt is None:
+                return m, None, (
+                    f"modeled device footprint {m:,} bytes exceeds the "
+                    f"admission budget {self.budget:,} even at "
+                    f"dispatch_batch=1 (V={n:,}, chunk_edges={cs:,}); "
+                    f"shrink the graph/chunk or raise the budget")
+            batch = nxt[0]
+            shed = batch
+            m = total(batch)
+        return m, shed, None
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def cancel(self, job_id: str) -> Optional[str]:
+        """Request cancellation; returns the job's (possibly already
+        terminal) state, or None for an unknown id. A queued job is
+        finalized immediately — cancellation FREES THE QUEUE without
+        waiting for a dispatch cycle. A RUNNING job's cancel is
+        asynchronous (the returned state is still ``running``): the
+        dispatch loop finalizes it before its next step — observe the
+        terminal state with :meth:`wait`."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            if job.state in TERMINAL_STATES:
+                return job.state
+            if job.state == QUEUED:
+                try:
+                    self._pending.remove(job)
+                except ValueError:
+                    pass
+                self._finalize_locked(job, CANCELLED)
+            else:
+                job.cancel_requested = True
+                self._cond.notify_all()
+            return job.state
+
+    def wait(self, job_id: str, timeout_s: Optional[float] = None):
+        """Block until the job is terminal (or timeout); returns the
+        Job, or None for an unknown id."""
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                job = self._jobs.get(job_id)
+                if job is None or job.state in TERMINAL_STATES:
+                    return job
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return job
+                self._cond.wait(timeout=0.1 if remaining is None
+                                else min(0.1, remaining))
+
+    def stats(self) -> dict:
+        with self._lock:
+            by_state: dict = {}
+            for job in self._jobs.values():
+                by_state[job.state] = by_state.get(job.state, 0) + 1
+            reserved = sum(j.modeled_bytes or 0 for j in self._active)
+            return {
+                "uptime_s": round(time.time() - self.started_t, 1),
+                "budget_bytes": self.budget,
+                "reserved_bytes": reserved,
+                "jobs": dict(self.totals),
+                "jobs_by_state": by_state,
+                "queued": len(self._pending),
+                "active": len(self._active),
+                "compile_cache": compile_cache_sizes(),
+                "chunk_caches": len(self._caches),
+            }
+
+    def shutdown(self, drain: bool = False) -> None:
+        """Stop the dispatch loop. ``drain`` finishes the jobs already
+        accepted first; otherwise every non-terminal job is cancelled
+        on the next cycle (their spans close — a clean shutdown leaves
+        ZERO unclosed spans)."""
+        with self._lock:
+            if drain:
+                self._draining = True
+            else:
+                self._stop = True
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # the dispatch loop (one thread)
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        """Round-robin dispatch until shutdown; see module docstring."""
+        while True:
+            to_close: list = []
+            with self._lock:
+                self._expire_locked()
+                if self._stop:
+                    for job in list(self._pending):
+                        self._pending.remove(job)
+                        self._finalize_locked(job, CANCELLED)
+                    for job in list(self._active):
+                        self._finalize_locked(job, CANCELLED)
+                        to_close.append(job)
+            if self._stop:
+                for job in to_close:
+                    self._close_gen(job)
+                return
+            with self._lock:
+                self._admit_locked()
+                if self._draining and not self._pending \
+                        and not self._active:
+                    return
+                if not self._active:
+                    # bounded wait: queued-job deadlines tick while idle
+                    self._cond.wait(timeout=0.1)
+                    continue
+                cycle = list(self._active)
+            for job in cycle:
+                self._step(job)
+
+    def _expire_locked(self) -> None:
+        # reentrant re-acquire (RLock): callers already hold the lock;
+        # taking it here too keeps every mutation lexically guarded
+        with self._lock:
+            now = time.time()
+            for job in [j for j in self._pending
+                        if j.deadline_t is not None
+                        and now >= j.deadline_t]:
+                self._pending.remove(job)
+                self._finalize_locked(job, DEADLINE_EXCEEDED)
+
+    def _admit_locked(self) -> None:
+        with self._lock:
+            while self._pending:
+                job = self._pending[0]
+                if self.budget is not None:
+                    reserved = sum(j.modeled_bytes or 0
+                                   for j in self._active)
+                    if self._active and \
+                            reserved + (job.modeled_bytes or 0) \
+                            > self.budget:
+                        break  # fits the budget, not current headroom
+                self._pending.popleft()
+                self._start_locked(job)
+
+    def _start_locked(self, job: Job) -> None:
+        with self._lock:
+            job.state = RUNNING
+            job.start_t = time.time()
+            job.jit_compiles = 0
+            job.span = obs.begin_detached(
+                f"job:{job.id}", parent=self.root_span_id, job=job.id,
+                tenant=job.spec.tenant, input=job.spec.input,
+                k=list(job.spec.ks))
+            job.span_id = getattr(job.span, "id", None)
+            cache = self._lease_cache_locked(job)
+            job.gen = JobEngine(job, cache=cache).steps()
+            self._active.append(job)
+            obs.event("job_admit", job=job.id, tenant=job.spec.tenant,
+                      modeled_bytes=job.modeled_bytes,
+                      active=len(self._active))
+            self._cond.notify_all()
+
+    def _step(self, job: Job) -> None:
+        cut = None
+        with self._lock:
+            if job.state != RUNNING:
+                return
+            if job.cancel_requested:
+                self._finalize_locked(job, CANCELLED)
+                cut = job
+            elif job.deadline_t is not None \
+                    and time.time() >= job.deadline_t:
+                self._finalize_locked(job, DEADLINE_EXCEEDED)
+                cut = job
+        if cut is not None:
+            # the unwind (prefetch-worker joins) runs OUTSIDE the lock
+            # so a slow close cannot stall ping/status/submit handlers
+            self._close_gen(cut)
+            return
+        # the device work happens OUTSIDE the lock: submits/cancels/
+        # waits from handler threads must never block on a fold. Steps
+        # are serialized on this one thread, so the compile-cache
+        # growth across ONE step belongs to exactly this job — the
+        # exact per-job jit attribution under interleaving.
+        jit0 = sum(compile_cache_sizes().values())
+        try:
+            try:
+                next(job.gen)
+            finally:
+                grew = sum(compile_cache_sizes().values()) - jit0
+                if grew and job.jit_compiles is not None:
+                    job.jit_compiles += grew
+            with self._lock:
+                job.steps += 1
+            return
+        except StopIteration:
+            outcome, error = DONE, None
+        except Exception as exc:  # noqa: BLE001 — job fault, not ours
+            outcome = FAILED
+            error = f"{type(exc).__name__}: {str(exc)[:300]}"
+        with self._lock:
+            self._finalize_locked(job, outcome, error)
+        self._close_gen(job)
+
+    # terminal jobs retained for status/wait queries; beyond this the
+    # oldest are evicted (with their result arrays) — a resident
+    # daemon must not grow host memory monotonically with traffic
+    MAX_TERMINAL_RETAINED = 512
+
+    def _finalize_locked(self, job: Job, state: str,
+                         error: Optional[str] = None) -> None:
+        """Terminal transition: release the reservation + cache lease,
+        end the job span, account, evict old terminal jobs, notify.
+        Does NOT close the step generator — the dispatch thread does
+        that OUTSIDE the lock (:meth:`_close_gen`): the unwind joins
+        prefetch workers and must not stall every handler thread."""
+        with self._lock:
+            if job.state in TERMINAL_STATES:
+                return
+            job.state = state
+            job.error = error
+            job.end_t = time.time()
+            try:
+                self._active.remove(job)
+            except ValueError:
+                pass
+            self._release_cache_locked(job)
+            if state == DONE:
+                self._write_output(job)
+            self.totals[state] = self.totals.get(state, 0) + 1
+            if job.span is not None:
+                cost = {k: job.stats[k]
+                        for k in ("device_rounds", "host_syncs",
+                                  "batch_execs", "dispatch_retries")
+                        if k in job.stats}
+                job.span.end(state=state,
+                             jit_compiles=job.jit_compiles, **cost)
+            obs.event("job_done", job=job.id, tenant=job.spec.tenant,
+                      state=state, error=error,
+                      jit_compiles=job.jit_compiles,
+                      steps=job.steps)
+            terminal = [jid for jid, j in self._jobs.items()
+                        if j.state in TERMINAL_STATES]
+            for jid in terminal[:max(0, len(terminal)
+                                     - self.MAX_TERMINAL_RETAINED)]:
+                del self._jobs[jid]
+            self._cond.notify_all()
+
+    def _close_gen(self, job: Job) -> None:
+        """Unwind a finalized job's step generator (engine finallys:
+        chunk/group iterators close, prefetch workers cancel + join,
+        phase spans end). Dispatch-thread only — generators are never
+        touched from handler threads — and deliberately outside the
+        scheduler lock (a stuck reader's bounded join must not freeze
+        the API)."""
+        gen, job.gen = job.gen, None
+        if gen is None:
+            return
+        try:
+            gen.close()
+        except Exception as e:  # unwind failure: on record, not fatal
+            import sys
+
+            obs.event("job_unwind_error", job=job.id,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
+            print(f"sheepd: unwind of {job.id} raised "
+                  f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
+
+    def _write_output(self, job: Job) -> None:
+        if not job.spec.output or not job.results:
+            return
+        from sheep_tpu.io.formats import write_partition
+
+        try:
+            for r in job.results:
+                path = job.spec.output
+                if len(job.results) > 1:
+                    root, ext = os.path.splitext(path)
+                    path = f"{root}.k{r.k}{ext}"
+                write_partition(path, r.assignment)
+        except Exception as e:
+            job.error = (f"partition finished but output write failed: "
+                         f"{type(e).__name__}: {str(e)[:200]}")
+
+    # ------------------------------------------------------------------
+    # shared device chunk cache (one lease at a time per input)
+    # ------------------------------------------------------------------
+    def _lease_cache_locked(self, job: Job):
+        """The daemon-held device chunk cache for this job's input, or
+        None. One lease at a time per cache: the backends' prefix-fill
+        invariant assumes a single filler, and the dispatch loop
+        interleaves jobs on one thread, so a second simultaneous
+        reader could double-append — the second job just streams.
+        Budget comes from the backends' own rule (0 on cpu-jax, where
+        "device" memory is the host's)."""
+        from sheep_tpu.backends.tpu_backend import (_ChunkCache,
+                                                    _chunk_cache_budget)
+
+        with self._lock:
+            key = (job.spec.input, job.spec.chunk_edges,
+                   job.n_vertices)
+            entry = self._caches.get(key)
+            if entry is None:
+                budget = _chunk_cache_budget(job.n_vertices,
+                                             job.spec.chunk_edges)
+                if budget <= 0:
+                    return None
+                entry = {"cache": _ChunkCache(budget),
+                         "leased_by": None}
+                self._caches[key] = entry
+                # bound resident inputs — but never evict a LEASED
+                # entry: its chunks are pinned by the running engine
+                # anyway, and dropping the entry would orphan the
+                # lease and invite a duplicate cache for the same key
+                evictable = [k for k, e in self._caches.items()
+                             if e["leased_by"] is None and k != key]
+                while len(self._caches) > 4 and evictable:
+                    del self._caches[evictable.pop(0)]
+            if entry["leased_by"] is not None:
+                return None
+            entry["leased_by"] = job.id
+            return entry["cache"]
+
+    def _release_cache_locked(self, job: Job) -> None:
+        with self._lock:
+            for key, entry in list(self._caches.items()):
+                if entry["leased_by"] == job.id:
+                    entry["leased_by"] = None
+                    if job.cache_shed:
+                        # the engine detached under memory pressure:
+                        # drop the entry so the HBM dies with the
+                        # engine's references and the next job on this
+                        # input starts a fresh, freshly-budgeted cache
+                        del self._caches[key]

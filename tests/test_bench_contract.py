@@ -77,6 +77,12 @@ def test_fallback_emits_null_vs_baseline():
     # ALWAYS emitted (0 on a healthy run) so the regression gate can
     # see 0 -> N movement instead of an incomparable missing field
     assert line["dispatch_retries"] == 0
+    # the warm-vs-cold served-request contract (ISSUE 10): warm_up_s
+    # (the cold first-request jit tax bench.py printed for three
+    # rounds but never emitted) and the cold/warm request walls ride
+    # every measured line so bench_regress gates warm-path latency
+    assert line["warm_up_s"] > 0
+    assert line["cold_request_s"] > 0 and line["warm_request_s"] > 0
 
 
 def test_skip_probe_short_circuits():

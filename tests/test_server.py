@@ -1,0 +1,340 @@
+"""sheepd / sheep_tpu.server tests (ISSUE 10).
+
+The acceptance pins, against the in-process Scheduler (the daemon's
+socket layer is exercised end-to-end by tools/obs_smoke.sh leg 6 via
+test_obs_smoke, and the fault legs by tools/served_soak.py):
+
+- a served job's forest bit-equals the cold CLI build of the same
+  input, and a repeat request reuses every compiled program
+  (jit_compiles == 0 — the warm-server guarantee);
+- two concurrently submitted jobs INTERLEAVE on one dispatch chain
+  and each bit-equals its solo run (per-job fixpoint independence);
+- admission: a job over a tiny SHEEP_CACHE_BYTES budget is rejected
+  with a modeled-bytes diagnosis; jobs that fit the budget but not
+  the headroom queue and run serially;
+- cancellation frees the queue (a queued job admits the moment the
+  blocking job is cancelled);
+- a deadline-expired job reports deadline_exceeded without poisoning
+  the dispatch chain (the jobs around it stay bit-identical);
+- the per-job fault layer: an injected OOM and an injected read fault
+  each degrade the job on record, bit-identically, with the daemon
+  (scheduler) still serving afterwards.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from sheep_tpu.server import protocol  # noqa: E402
+from sheep_tpu.server.protocol import JobSpec, ProtocolError  # noqa: E402
+from sheep_tpu.server.scheduler import Scheduler  # noqa: E402
+
+INPUT_A = "rmat:10:8:1"
+INPUT_B = "rmat:10:8:2"
+CHUNK = 1024
+
+
+@contextmanager
+def running_scheduler(**kw):
+    sched = Scheduler(**kw)
+    t = threading.Thread(target=sched.run, daemon=True,
+                         name="test-sheepd-dispatch")
+    t.start()
+    try:
+        yield sched
+    finally:
+        sched.shutdown()
+        t.join(timeout=30)
+        assert not t.is_alive(), "dispatch loop failed to shut down"
+
+
+def spec(input=INPUT_A, ks=(4,), tenant="t", **fields):
+    body = {"input": input, "k": list(ks), "chunk_edges": CHUNK}
+    body.update(fields)
+    return JobSpec.from_request(body, tenant=tenant)
+
+
+def serve_one(sched, sp, timeout=240):
+    job = sched.submit(sp)
+    job = sched.wait(job.id, timeout_s=timeout)
+    return job
+
+
+def solo_assignment(input, k, chunk_edges=CHUNK):
+    import sheep_tpu
+
+    return sheep_tpu.partition(input, k, backend="tpu",
+                               chunk_edges=chunk_edges,
+                               comm_volume=False).assignment
+
+
+def test_served_bit_equals_cli_build(tmp_path):
+    """Acceptance: the served forest is bit-identical to the cold CLI
+    build of the same input, and the scores agree."""
+    out = tmp_path / "cli.parts"
+    from sheep_tpu import cli
+
+    rc = cli.main(["--input", INPUT_A, "--k", "4", "--backend", "tpu",
+                   "--chunk-edges", str(CHUNK), "--no-comm-volume",
+                   "--output", str(out), "--json"])
+    assert rc == 0
+    from sheep_tpu.io.formats import read_partition
+
+    cli_assign = read_partition(str(out))
+    with running_scheduler() as sched:
+        job = serve_one(sched, spec())
+        assert job.state == "done", job.error
+        res = job.results[0]
+        assert np.array_equal(res.assignment, cli_assign)
+        assert res.backend == "sheepd"
+        assert res.edge_cut > 0 and res.total_edges > 0
+
+
+def test_warm_repeat_request_zero_recompiles():
+    """Acceptance: a warm sheepd serves a repeat request with ZERO jit
+    recompilation — the compile-cache counter on the job descriptor
+    proves the fixpoint/degree/order/score programs were reused."""
+    with running_scheduler() as sched:
+        first = serve_one(sched, spec())
+        repeat = serve_one(sched, spec(tenant="again"))
+        assert first.state == "done" and repeat.state == "done"
+        assert repeat.jit_compiles == 0, \
+            f"repeat shape recompiled {repeat.jit_compiles} programs"
+        assert np.array_equal(first.results[0].assignment,
+                              repeat.results[0].assignment)
+
+
+def test_interleaved_jobs_bit_equal_solo_runs():
+    """Acceptance: two concurrently submitted jobs interleave on one
+    dispatch chain and EACH produces the forest of its solo run."""
+    ref_a = solo_assignment(INPUT_A, 4)
+    ref_b = solo_assignment(INPUT_B, 4)
+    with running_scheduler() as sched:
+        ja = sched.submit(spec(INPUT_A, tenant="alice"))
+        jb = sched.submit(spec(INPUT_B, tenant="bob"))
+        ja = sched.wait(ja.id, timeout_s=240)
+        jb = sched.wait(jb.id, timeout_s=240)
+        assert ja.state == "done" and jb.state == "done"
+        # genuinely concurrent: each started before the other finished
+        assert ja.start_t < jb.end_t and jb.start_t < ja.end_t
+        assert np.array_equal(ja.results[0].assignment, ref_a)
+        assert np.array_equal(jb.results[0].assignment, ref_b)
+
+
+def test_multi_k_query_one_shared_tree():
+    """Multi-k from one shared tree is one served query: one build,
+    one scoring pass, per-k results matching the solo builds."""
+    with running_scheduler() as sched:
+        job = serve_one(sched, spec(ks=(4, 8)))
+        assert job.state == "done"
+        assert [r.k for r in job.results] == [4, 8]
+        for r in job.results:
+            assert np.array_equal(r.assignment,
+                                  solo_assignment(INPUT_A, r.k))
+        # one build amortized: the per-k phase walls are shared
+        assert job.results[0].total_edges == job.results[1].total_edges
+
+
+def test_admission_rejects_over_tiny_budget(monkeypatch):
+    """Acceptance: under a tiny SHEEP_CACHE_BYTES budget the job's
+    modeled footprint cannot fit even at dispatch_batch=1 — REJECTED
+    with the modeled-bytes diagnosis, not queued forever."""
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", "10000")
+    with running_scheduler() as sched:
+        assert sched.budget == 10000
+        job = serve_one(sched, spec(), timeout=30)
+        assert job.state == "rejected"
+        assert "admission budget" in (job.error or "")
+        assert "10,000" in job.error
+
+
+def test_admission_queues_on_headroom_then_serializes():
+    """Two jobs that each fit the budget but not together: the second
+    queues and starts only after the first releases its reservation."""
+    from sheep_tpu.utils import membudget
+
+    n = 1 << 10
+    m = membudget.build_phase_bytes(n, CHUNK,
+                                    dispatch_batch=1)["total_bytes"]
+    with running_scheduler(budget_bytes=int(1.5 * m)) as sched:
+        ja = sched.submit(spec(INPUT_A, dispatch_batch=1))
+        jb = sched.submit(spec(INPUT_B, dispatch_batch=1))
+        ja = sched.wait(ja.id, timeout_s=240)
+        jb = sched.wait(jb.id, timeout_s=240)
+        assert ja.state == "done" and jb.state == "done"
+        assert jb.start_t >= ja.end_t, \
+            "second job admitted before the first released its bytes"
+
+
+def test_cancellation_frees_the_queue():
+    """Acceptance: cancelling the running job admits the queued one
+    immediately; cancelling a queued job removes it outright."""
+    from sheep_tpu.utils import membudget
+
+    # budget fits the big victim alone; the small jobs queue behind it
+    mv = membudget.build_phase_bytes(1 << 12, 256,
+                                     dispatch_batch=1)["total_bytes"]
+    with running_scheduler(budget_bytes=int(1.1 * mv)) as sched:
+        victim = sched.submit(JobSpec.from_request(
+            {"input": "rmat:12:8:3", "k": [4], "chunk_edges": 256,
+             "dispatch_batch": 1}, tenant="victim"))
+        jb = sched.submit(spec(INPUT_B, dispatch_batch=1))
+        jc = sched.submit(spec(INPUT_A, dispatch_batch=1))
+        # cancel the queued c first: it must leave the queue now
+        assert sched.cancel(jc.id) == "cancelled"
+        deadline = time.monotonic() + 30
+        while sched.get(victim.id).state == "queued" \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        sched.cancel(victim.id)
+        victim = sched.wait(victim.id, timeout_s=60)
+        jb = sched.wait(jb.id, timeout_s=240)
+        assert victim.state == "cancelled"
+        assert jb.state == "done", jb.error
+        assert np.array_equal(jb.results[0].assignment,
+                              solo_assignment(INPUT_B, 4))
+
+
+def test_deadline_exceeded_does_not_poison_the_chain():
+    """Acceptance: a deadline-expired job reports deadline_exceeded;
+    the jobs interleaved around it finish bit-identical — the dispatch
+    chain is not poisoned."""
+    ref_b = solo_assignment(INPUT_B, 4)
+    with running_scheduler() as sched:
+        doomed = sched.submit(JobSpec.from_request(
+            {"input": "rmat:12:8:3", "k": [4], "chunk_edges": 256,
+             "deadline_s": 0.005}, tenant="doomed"))
+        jb = sched.submit(spec(INPUT_B, tenant="bob"))
+        doomed = sched.wait(doomed.id, timeout_s=120)
+        jb = sched.wait(jb.id, timeout_s=240)
+        assert doomed.state == "deadline_exceeded"
+        assert jb.state == "done", jb.error
+        assert np.array_equal(jb.results[0].assignment, ref_b)
+        # and the daemon keeps serving: one more job end-to-end
+        again = serve_one(sched, spec(INPUT_B))
+        assert again.state == "done"
+        assert np.array_equal(again.results[0].assignment, ref_b)
+
+
+def test_served_job_absorbs_oom_and_read_faults(tmp_path, monkeypatch):
+    """The served mini-soak's tier-1 twin (the full daemon-subprocess
+    version is tools/served_soak.py, pinned @slow below): one injected
+    OOM at the first dispatch and one injected read fault each degrade
+    the JOB on record — bit-identical result, retry trail in the
+    diagnostics — with the scheduler still serving afterwards."""
+    from sheep_tpu.io import formats, generators
+    from sheep_tpu.utils import fault
+
+    graph = str(tmp_path / "soak.bin64")
+    formats.write_edges(graph,
+                        generators.random_graph(512, 4096, seed=7))
+    ref = None
+    with running_scheduler() as sched:
+        clean = serve_one(sched, JobSpec.from_request(
+            {"input": graph, "k": [4], "chunk_edges": 512,
+             "num_vertices": 512}, tenant="clean"))
+        assert clean.state == "done"
+        ref = clean.results[0].assignment
+        for inject, want_retry in (("oom@dispatch:1", True),
+                                   ("read@read:2", False)):
+            monkeypatch.setenv("SHEEP_FAULT_INJECT", inject)
+            monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.01")
+            fault.reset()
+            try:
+                job = serve_one(sched, JobSpec.from_request(
+                    {"input": graph, "k": [4], "chunk_edges": 512,
+                     "num_vertices": 512}, tenant=inject))
+            finally:
+                monkeypatch.delenv("SHEEP_FAULT_INJECT")
+                fault.reset()
+            assert job.state == "done", (inject, job.error)
+            assert np.array_equal(job.results[0].assignment, ref), inject
+            if want_retry:
+                assert job.stats.get("dispatch_retries", 0) >= 1, \
+                    "OOM injection left no retry trail"
+
+
+def test_job_fault_budget_exhaustion_fails_job_not_daemon(monkeypatch):
+    """A fault storm beyond the retry budget fails THAT job; the
+    scheduler answers the next request normally."""
+    from sheep_tpu.utils import fault
+
+    monkeypatch.setenv("SHEEP_FAULT_INJECT", "oom@dispatch:1:99")
+    monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("SHEEP_RETRY_MAX", "2")
+    fault.reset()
+    with running_scheduler() as sched:
+        doomed = serve_one(sched, spec(tenant="doomed"))
+        assert doomed.state == "failed"
+        assert "RESOURCE_EXHAUSTED" in doomed.error
+        monkeypatch.delenv("SHEEP_FAULT_INJECT")
+        fault.reset()
+        ok = serve_one(sched, spec(tenant="after"))
+        assert ok.state == "done", ok.error
+
+
+def test_protocol_validation_and_codec():
+    with pytest.raises(ProtocolError):
+        JobSpec.from_request({"k": [4]})          # no input
+    with pytest.raises(ProtocolError):
+        JobSpec.from_request({"input": "g", "k": []})
+    with pytest.raises(ProtocolError):
+        JobSpec.from_request({"input": "g", "k": [0]})
+    with pytest.raises(ProtocolError):
+        JobSpec.from_request({"input": "g", "k": 4, "bogus": 1})
+    with pytest.raises(ProtocolError):
+        JobSpec.from_request({"input": "g", "k": 4, "deadline_s": -1})
+    sp = JobSpec.from_request({"input": "g", "k": [8, 8, 4]})
+    assert sp.ks == [8, 4]  # dupes dropped, order kept
+    a = np.arange(1000, dtype=np.int32) % 7
+    assert np.array_equal(
+        protocol.decode_assignment(protocol.encode_assignment(a)), a)
+    with pytest.raises(ProtocolError):
+        protocol.parse_request(b'{"op": "frobnicate"}')
+    req = protocol.parse_request(b'{"op": "ping"}')
+    assert req["op"] == "ping"
+
+
+def test_terminal_jobs_evicted_beyond_retention_cap(monkeypatch):
+    """A resident daemon must not grow host memory monotonically with
+    traffic: terminal jobs (and their result arrays) beyond the
+    retention cap are evicted oldest-first (review finding)."""
+    monkeypatch.setattr(Scheduler, "MAX_TERMINAL_RETAINED", 3)
+    with running_scheduler() as sched:
+        ids = [serve_one(sched, spec(tenant=f"t{i}")).id
+               for i in range(5)]
+        assert sched.get(ids[0]) is None and sched.get(ids[1]) is None
+        for jid in ids[2:]:
+            assert sched.get(jid) is not None
+            assert sched.get(jid).state == "done"
+
+
+def test_submit_unopenable_input_is_answered_not_enqueued():
+    with running_scheduler() as sched:
+        with pytest.raises(ProtocolError, match="cannot open"):
+            sched.submit(spec("/nonexistent/graph.bin64"))
+        assert sched.stats()["jobs"]["submitted"] == 0
+
+
+@pytest.mark.slow
+def test_served_soak_tool():
+    """The full daemon-subprocess mini-soak: one oom + one read leg
+    through a real sheepd on a unix socket (see tools/served_soak.py);
+    the tier-1 twin above covers the same faults in-process."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "served_soak.py")],
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                       "PYTHONPATH": REPO},
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    verdicts = [json.loads(line) for line in r.stdout.splitlines()]
+    assert verdicts[-1]["ok"] is True

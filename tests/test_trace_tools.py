@@ -268,6 +268,29 @@ def test_bench_regress_gates_host_blocked_ms(tmp_path):
     assert bench_regress.main([gap, old]) == 0
 
 
+def test_bench_regress_gates_warm_path(tmp_path):
+    """The warm-vs-cold served-request contract (ISSUE 10): warm_up_s
+    (the cold jit tax) and warm_request_s (the warm served wall) gate
+    lower-is-better like host_blocked_ms; cold_request_s rides as info
+    (it is warm_up_s under another name — double-gating one quantity
+    would double-alarm one regression)."""
+    old = _write(tmp_path, "old.json",
+                 {**BASE, "warm_up_s": 10.0, "warm_request_s": 6.0,
+                  "cold_request_s": 10.0})
+    slow_warm = _write(tmp_path, "slow_warm.json",
+                       {**BASE, "warm_up_s": 10.0,
+                        "warm_request_s": 9.0, "cold_request_s": 10.0})
+    assert bench_regress.main([slow_warm, old]) == 2
+    slow_cold = _write(tmp_path, "slow_cold.json",
+                       {**BASE, "warm_up_s": 20.0,
+                        "warm_request_s": 6.0, "cold_request_s": 20.0})
+    assert bench_regress.main([slow_cold, old]) == 2
+    ok = _write(tmp_path, "ok.json",
+                {**BASE, "warm_up_s": 9.0, "warm_request_s": 5.5,
+                 "cold_request_s": 9.0})
+    assert bench_regress.main([ok, old]) == 0
+
+
 def test_bench_regress_rise_from_zero_is_gated(tmp_path):
     """old host_syncs == 0 has no relative change, but 0 -> 500 is a
     real scheduling regression and must not slip through the undefined

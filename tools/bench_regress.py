@@ -15,6 +15,12 @@ each compared only when present in BOTH captures:
                                       beyond --threshold regresses)
     host_syncs, device_rounds,        lower is better (relative rise
     host_blocked_ms,                  beyond --threshold regresses —
+    warm_up_s, warm_request_s,        warm_up_s is the cold-request jit
+                                      tax and warm_request_s the warm
+                                      served-request wall — the pair
+                                      the sheepd server mode amortizes
+                                      (ISSUE 10); a rise in either is a
+                                      warm-path latency regression;
     dispatch_retries                  dispatch counts are deterministic,
                                       so a rise is a real scheduling
                                       change, not noise; host_blocked_ms
@@ -65,7 +71,7 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # the old==0 rule below) means the bench survived faults it used to
 # not have — visible, not silent.
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
-                "dispatch_retries")
+                "dispatch_retries", "warm_up_s", "warm_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory
@@ -73,7 +79,8 @@ LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
 INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
              "inflight_depth", "inflight_discards", "device_gap_ms",
              "degraded_dispatch_batch", "degraded_inflight",
-             "device_loss_recoveries", "checkpoint_degraded")
+             "device_loss_recoveries", "checkpoint_degraded",
+             "cold_request_s")
 
 
 def load_capture(path: str):

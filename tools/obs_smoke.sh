@@ -122,4 +122,77 @@ grep -q '"event": "retry"' "$TRACE5"
 grep -qE '"event": "(dispatch_degraded|device_reinit)"' "$TRACE5" || \
     grep -q '"kind": "read"' "$TRACE5"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5"
+# sixth leg: partition-as-a-service (ISSUE 10) — sheepd on a unix
+# socket, two concurrent tiny jobs from different tenants plus a
+# multi-k query, one job cancelled mid-flight, clean shutdown. The
+# gate: trace_report --check green (zero UNCLOSED spans survive the
+# cancel + shutdown paths), per-job span trees + tenant cost rows in
+# the report, and the repeat-shape job proving warm program reuse
+# (jit_compiles == 0).
+TRACE6="$OUT/trace_served.jsonl"
+SOCK6="$OUT/sheepd.sock"
+rm -f "$TRACE6" "$SOCK6"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK6" --trace "$TRACE6" --heartbeat-secs 0.2 \
+    2> "$OUT/sheepd.err" &
+SHEEPD_PID=$!
+for _ in $(seq 1 100); do [ -S "$SOCK6" ] && break; sleep 0.2; done
+[ -S "$SOCK6" ] || { echo "sheepd never bound $SOCK6" >&2; exit 1; }
+if ! JAX_PLATFORMS=cpu python - "$SOCK6" > "$OUT/served.json" \
+        2> "$OUT/served.err" <<'PYEOF'
+import json
+import sys
+
+from sheep_tpu.server.client import SheepClient
+
+with SheepClient(sys.argv[1]) as c:
+    # two concurrent tenants + a multi-k query on a shared tree
+    a = c.submit("rmat:10:8:1", k=4, tenant="alice", chunk_edges=1024)
+    b = c.submit("rmat:10:8:2", k=[4, 8], tenant="bob",
+                 chunk_edges=1024)
+    # third job with many small chunks: cancelled mid-flight — poll
+    # until the scheduler actually started stepping it so the cancel
+    # exercises the running-job path (generator close -> prefetcher
+    # cancel -> span end), not the cheap queued-job dequeue
+    import time
+
+    v = c.submit("rmat:12:8:3", k=4, tenant="victim", chunk_edges=512)
+    for _ in range(500):
+        st = c.status(v["job_id"])
+        if st["state"] != "queued" or st["steps"]:
+            break
+        time.sleep(0.01)
+    assert st["state"] == "running", st
+    c.cancel(v["job_id"])  # async for running jobs; wait observes it
+    cancelled = c.wait(v["job_id"], timeout_s=60)["state"]
+    ja = c.wait(a["job_id"], timeout_s=120)
+    jb = c.wait(b["job_id"], timeout_s=120)
+    # repeat of job a's shape: must reuse every compiled program
+    w = c.submit("rmat:10:8:1", k=4, tenant="alice", chunk_edges=1024)
+    jw = c.wait(w["job_id"], timeout_s=120)
+    assert ja["state"] == "done", ja
+    assert jb["state"] == "done" and len(jb["results"]) == 2, jb
+    assert cancelled == "cancelled", cancelled
+    assert jw["state"] == "done", jw
+    assert jw.get("jit_compiles") == 0, \
+        f"repeat shape recompiled: {jw.get('jit_compiles')}"
+    print(json.dumps({"a": ja["state"], "b": jb["state"],
+                      "victim": cancelled,
+                      "warm_jit_compiles": jw["jit_compiles"]}))
+    c.shutdown()
+PYEOF
+then
+    echo "served smoke client failed:" >&2
+    cat "$OUT/served.err" >&2
+    kill "$SHEEPD_PID" 2>/dev/null || true
+    exit 1
+fi
+wait "$SHEEPD_PID"
+python tools/trace_report.py "$TRACE6" --check > "$OUT/report_served.txt"
+grep -q "job:j1" "$OUT/report_served.txt"      # per-job span trees
+grep -q "tenant alice:" "$OUT/report_served.txt"   # cost attribution
+grep -q "tenant bob:" "$OUT/report_served.txt"
+grep -q "state=cancelled" "$OUT/report_served.txt" # the mid-flight cancel
+grep -q "jit_compiles=0" "$OUT/report_served.txt"  # warm program reuse
+
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6"

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Served-job mini-soak (ISSUE 10 satellite; chaos_soak's pattern
+applied to sheepd): inject one OOM-class fault and one read fault into
+served jobs and assert the DAEMON survives with the job verdict
+``identical`` or ``degraded_documented``.
+
+    python tools/served_soak.py [--out DIR]
+
+Two legs, each a REAL ``sheepd`` subprocess on a unix socket over a
+real on-disk graph (so the edgestream read points are live):
+
+    oom    SHEEP_FAULT_INJECT=oom@dispatch:1 — RESOURCE_EXHAUSTED at
+           the first issued dispatch of the served build; the per-job
+           retry layer must degrade/re-fold bit-identically and leave
+           the ``dispatch_retries`` trail in the job diagnostics.
+    read   SHEEP_FAULT_INJECT=read@read:2 — a torn physical read; the
+           edgestream's bounded transient retry absorbs it below the
+           scheduler entirely.
+
+Per leg the verdict is exactly chaos_soak's taxonomy:
+
+    identical            served assignment bit-equals the clean oracle
+    degraded_documented  differs, but the job carries a documented
+                         degradation marker (quarantined chunks)
+    wrong_forest         differs with NO documentation — a real bug
+    unhandled_crash      the job failed, the daemon died, or it
+                         stopped answering pings after the fault
+
+After each job the daemon must still answer ``ping`` (the fault
+degraded the JOB, not the service) and must shut down rc=0. Exit 0
+iff every leg is identical/degraded_documented; wired tier-1 by
+tests/test_server.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LEGS = (
+    ("oom", "oom@dispatch:1"),
+    ("read", "read@read:2"),
+)
+
+
+def build_graph(path: str) -> None:
+    from sheep_tpu.io import formats, generators
+
+    formats.write_edges(path, generators.random_graph(512, 4096, seed=7))
+
+
+def clean_oracle(path: str):
+    """The fault-free reference assignment, computed in THIS process
+    (the daemons never see a fault-free run — the oracle must not)."""
+    from sheep_tpu import _partition_stream
+    from sheep_tpu.io.edgestream import open_input
+
+    with open_input(path, n_vertices=512) as es:
+        res = _partition_stream(es, 4, backend="tpu", chunk_edges=512,
+                                comm_volume=False)
+    return res.assignment
+
+
+def run_leg(name: str, inject: str, graph: str, out_dir: str,
+            oracle) -> dict:
+    import numpy as np
+
+    from sheep_tpu.server.client import ServerError, SheepClient
+
+    sock = os.path.join(out_dir, f"soak_{name}.sock")
+    trace = os.path.join(out_dir, f"soak_{name}.jsonl")
+    err_path = os.path.join(out_dir, f"soak_{name}.err")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "SHEEP_FAULT_INJECT": inject, "SHEEP_RETRY_BASE_S": "0.01"}
+    rec = {"leg": name, "inject": inject}
+    with open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sheep_tpu.server.daemon",
+             "--socket", sock, "--trace", trace,
+             "--heartbeat-secs", "0.2"],
+            cwd=REPO, env=env, stderr=err_f)
+    try:
+        for _ in range(150):
+            if os.path.exists(sock) or proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        if not os.path.exists(sock):
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = f"daemon never bound (rc={proc.poll()})"
+            return rec
+        with SheepClient(sock) as c:
+            try:
+                r = c.submit(graph, k=4, tenant="soak",
+                             chunk_edges=512, num_vertices=512,
+                             return_assignment=True)
+                job = c.wait(r["job_id"], timeout_s=120)
+            except ServerError as e:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = f"daemon refused the job: {e}"
+                return rec
+            rec["state"] = job.get("state")
+            diags = (job.get("results") or [{}])[0].get(
+                "diagnostics", {})
+            rec["dispatch_retries"] = diags.get("dispatch_retries")
+            # the daemon must still be serving AFTER the fault
+            try:
+                c.ping()
+            except (ServerError, OSError) as e:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = f"daemon dead after fault: {e}"
+                return rec
+            if job.get("state") != "done":
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = job.get("error", "job not done")
+                return rec
+            served = c.result_assignment(job)
+            if np.array_equal(served, np.asarray(oracle)):
+                rec["verdict"] = "identical"
+            else:
+                # documented degradation = quarantined input (the only
+                # lossy absorb on these paths); anything else is wrong
+                quarantined = False
+                try:
+                    with open(trace) as f:
+                        quarantined = '"chunk_quarantined"' in f.read()
+                except OSError:
+                    pass
+                rec["verdict"] = "degraded_documented" if quarantined \
+                    else "wrong_forest"
+            try:
+                c.shutdown()
+            except (ServerError, OSError):
+                pass
+        proc.wait(timeout=30)
+        rec["daemon_rc"] = proc.returncode
+        if proc.returncode != 0:
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = f"daemon exit rc={proc.returncode}"
+        return rec
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="sheepd fault mini-soak (one oom + one read leg)")
+    ap.add_argument("--out", default=None,
+                    help="artifact dir (default: fresh temp dir)")
+    args = ap.parse_args(argv)
+    out_dir = args.out or tempfile.mkdtemp(prefix="sheep_served_soak.")
+    os.makedirs(out_dir, exist_ok=True)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    graph = os.path.join(out_dir, "soak.bin64")
+    build_graph(graph)
+    oracle = clean_oracle(graph)
+
+    ok = True
+    for name, inject in LEGS:
+        rec = run_leg(name, inject, graph, out_dir, oracle)
+        print(json.dumps(rec), flush=True)
+        if rec["verdict"] not in ("identical", "degraded_documented"):
+            ok = False
+        if name == "oom" and not rec.get("dispatch_retries"):
+            # the injected fault must have been absorbed ON RECORD —
+            # a silently-clean run means the injection missed and the
+            # soak proved nothing
+            print(json.dumps({"leg": name,
+                              "error": "no dispatch_retries trail — "
+                                       "injection never fired"}),
+                  flush=True)
+            ok = False
+    print(json.dumps({"soak": "served", "ok": ok, "out": out_dir}),
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,7 +163,34 @@ def parse_trace(path: str) -> dict:
         "scores": [e for e in events if e.get("event") == "scores"],
         "counters": next((e for e in reversed(events)
                           if e.get("event") == "counters"), None),
+        # served-mode forensics (sheepd): per-job cost rows from the
+        # job span ends — under the interleaving scheduler the span-
+        # DELTA counters mix tenants (the registry is global), so the
+        # authoritative per-job costs are the explicit attrs the
+        # scheduler stamps on each job span's end record
+        "job_spans": [e for e in events
+                      if e.get("event") == "span_end"
+                      and str(e.get("span", "")).startswith("job:")],
     }
+
+
+_JOB_COST_FIELDS = ("device_rounds", "host_syncs", "batch_execs",
+                    "dispatch_retries", "jit_compiles")
+
+
+def tenant_costs(parsed: dict) -> dict:
+    """{tenant: {jobs, secs, <cost sums>}} from the job span ends —
+    the sheepd tenant-level cost attribution table."""
+    out: dict = {}
+    for e in parsed["job_spans"]:
+        t = e.get("tenant", "?")
+        row = out.setdefault(t, {"jobs": 0, "secs": 0.0})
+        row["jobs"] += 1
+        row["secs"] = round(row["secs"] + (e.get("secs") or 0.0), 3)
+        for f in _JOB_COST_FIELDS:
+            if isinstance(e.get(f), (int, float)):
+                row[f] = row.get(f, 0) + e[f]
+    return out
 
 
 def _num(v):
@@ -330,6 +357,16 @@ def print_report(rep: dict, out) -> None:
                   f"in this file)\n")
     for r in parsed["degraded"]:
         out.write(f"checkpoint degraded: {r.get('message')}\n")
+    if parsed["job_spans"]:
+        for e in parsed["job_spans"]:
+            bits = [f"{k}={e[k]}" for k in
+                    ("tenant", "state", "secs") + _JOB_COST_FIELDS
+                    if e.get(k) is not None]
+            out.write(f"job {e.get('span', '?')[4:]}: "
+                      f"{' '.join(bits)}\n")
+        for tenant, row in sorted(tenant_costs(parsed).items()):
+            bits = [f"{k}={v}" for k, v in row.items()]
+            out.write(f"tenant {tenant}: {' '.join(bits)}\n")
     cnt = parsed["counters"]
     if cnt:
         cs = {k: v for k, v in cnt.items() if k not in ("event", "ts")}
@@ -392,6 +429,8 @@ def main(argv=None) -> int:
                 "degraded": rep["parsed"]["degraded"],
                 "unclosed": [n["name"] for n in rep["parsed"]["unclosed"]],
                 "counters": rep["parsed"]["counters"],
+                "jobs": rep["parsed"]["job_spans"],
+                "tenants": tenant_costs(rep["parsed"]),
                 "check_failures": cf,
             })
         doc = {"traces": out}
