@@ -28,8 +28,10 @@ def build_parser() -> argparse.ArgumentParser:
         epilog="server mode: `sheep serve --socket PATH` runs the "
                "resident sheepd daemon (warm compiled programs, "
                "multi-tenant job queue); `sheep submit --server PATH "
-               "--input G --k N` submits to one. See README 'Server "
-               "mode'.",
+               "--input G --k N` submits to one (--watch for live "
+               "progress); `sheep top --server PATH` is the live "
+               "telemetry console. See README 'Server mode' and "
+               "'Live telemetry'.",
     )
     p.add_argument("--input",
                    help="edge list (.edges/.txt text, .bin32/.bin64 "
@@ -249,6 +251,12 @@ def main(argv=None) -> int:
         from sheep_tpu.server.client import main as submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "top":
+        # ISSUE 11: the live telemetry console (also installed as the
+        # standalone `sheeptop` console script)
+        from sheep_tpu.server.sheeptop import main as top_main
+
+        return top_main(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.heartbeat_secs is not None:

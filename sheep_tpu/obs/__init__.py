@@ -12,7 +12,14 @@ One module-level tracer that everything threads through:
   multi-hour soak is observable while running (and a dead run is
   distinguishable from a slow one);
 - **manifest** — config/topology/version/git-SHA provenance on every
-  traced run.
+  traced run;
+- **metrics** (ISSUE 11) — a typed live registry (counters, gauges,
+  fixed-bucket histograms) with Prometheus text rendering, the
+  scrape-able face of the same numbers (``obs/metrics.py``);
+- **flight recorder** (ISSUE 11) — always-on bounded rings of the
+  last N events per job, fed by :func:`event` alongside the tracer
+  and dumped to the trace sink on failure/fault/shutdown
+  (``obs/flightrec.py``).
 
 Instrumentation calls are UNCONDITIONAL at the call sites (backends,
 pipelines, CLI) and near-free when tracing is off: every facade
@@ -37,12 +44,55 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import IO, Optional, Union
 
+from sheep_tpu.obs.flightrec import FlightRecorder  # noqa: F401
 from sheep_tpu.obs.heartbeat import Heartbeat  # noqa: F401
 from sheep_tpu.obs.manifest import collect_manifest, emit_manifest  # noqa: F401
+from sheep_tpu.obs.metrics import MetricRegistry  # noqa: F401
 from sheep_tpu.obs.tracer import (NULL_SPAN, NULL_STATS, CounterRegistry,  # noqa: F401
                                   NullSpan, Span, StatsAccumulator, Tracer)
 
 _TRACER: Optional[Tracer] = None
+_FLIGHT: Optional[FlightRecorder] = None
+
+
+def install_flight(recorder: FlightRecorder) -> FlightRecorder:
+    """Make ``recorder`` the process-wide flight recorder: every
+    :func:`event` also lands in its bounded rings (ISSUE 11). Unlike
+    the tracer this is always-on-capable — it costs one deque append
+    per event and performs no I/O until a dump."""
+    global _FLIGHT
+    _FLIGHT = recorder
+    return recorder
+
+
+def uninstall_flight() -> Optional[FlightRecorder]:
+    global _FLIGHT
+    fr, _FLIGHT = _FLIGHT, None
+    return fr
+
+
+def get_flight() -> Optional[FlightRecorder]:
+    return _FLIGHT
+
+
+def flight_job() -> Optional[str]:
+    """The calling thread's flight-recorder job context (None without
+    a recorder or outside any context) — capture this before spawning
+    a worker thread, then re-enter it there with
+    :func:`flight_job_context`."""
+    f = _FLIGHT
+    return f.current_job() if f is not None else None
+
+
+def flight_job_context(job_id: Optional[str]):
+    """Enter ``job_id`` as the calling thread's flight context (no-op
+    context manager when tracing-by-ring is off or job_id is None)."""
+    from contextlib import nullcontext
+
+    f = _FLIGHT
+    if f is None or job_id is None:
+        return nullcontext()
+    return f.job_context(job_id)
 
 
 def install(tracer: Tracer) -> Tracer:
@@ -147,10 +197,15 @@ def chunk_progress(idx: int, chunk_edges: int, edges_total=None) -> None:
 
 
 def event(name: str, **fields) -> None:
-    """Emit a free-form event through the active tracer (no-op off)."""
+    """Emit a free-form event through the active tracer (no-op off)
+    AND into the installed flight recorder's bounded rings (no-op
+    without one) — the one call site both sinks share."""
     t = _TRACER
     if t is not None:
         t.emit(name, **fields)
+    f = _FLIGHT
+    if f is not None:
+        f.record(name, fields)
 
 
 @contextmanager

@@ -32,10 +32,17 @@ class Heartbeat:
     """Daemon thread emitting ``heartbeat`` events every ``interval_s``
     seconds through ``tracer`` until :meth:`stop`."""
 
-    def __init__(self, tracer, interval_s: float, memory: bool = True):
+    def __init__(self, tracer, interval_s: float, memory: bool = True,
+                 service=None):
         self.tracer = tracer
         self.interval = max(0.05, float(interval_s))
         self._memory = memory
+        # optional service-pressure provider (ISSUE 11): inside sheepd
+        # the daemon passes the scheduler's live queue-depth/active-job
+        # sampler, so soak logs show SERVICE pressure per beat, not
+        # just per-run progress. Must be cheap and non-blocking-ish
+        # (it runs on the heartbeat thread every beat).
+        self._service = service
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="sheep-heartbeat", daemon=True)
@@ -91,6 +98,13 @@ class Heartbeat:
                     if isinstance(total, (int, float)) and total >= edges:
                         rec["eta_s"] = round((total - edges) / rate, 1)
             self._last = (now, edges)
+        if self._service is not None:
+            try:
+                svc = self._service()
+            except Exception:
+                svc = None  # a wedged sampler must not kill the beat
+            if svc:
+                rec.update(svc)
         counters = tr.counters.snapshot()
         if counters:
             rec["counters"] = counters

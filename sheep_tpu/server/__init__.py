@@ -21,7 +21,18 @@ the partitioner a long-lived RESOURCE instead of a batch process:
 - :mod:`~sheep_tpu.server.protocol` — the JSON wire protocol (request/
   response schema, job states, assignment codec);
 - :mod:`~sheep_tpu.server.client` — the thin client +
-  ``sheep-submit`` CLI.
+  ``sheep-submit`` CLI (``--watch`` renders live per-job progress);
+- :mod:`~sheep_tpu.server.sheeptop` — ``sheeptop``, the live console
+  view over the ``metrics`` + ``list`` verbs (ISSUE 11).
+
+Live telemetry (ISSUE 11): the scheduler owns a typed
+:class:`~sheep_tpu.obs.metrics.MetricRegistry` (per-tenant
+request-latency histograms, queue/reservation gauges, admission and
+retry counters) answered by the ``metrics`` verb and the daemon's
+optional HTTP ``GET /metrics`` listener (``--metrics-port``); an
+always-on bounded flight recorder dumps each failed job's last events
+to the trace sink; and the ``profile`` verb arms an on-demand
+``jax.profiler`` capture of the next K dispatch steps.
 
 Served results are bit-identical to the cold CLI build of the same
 input: the forest is the unique fixpoint of the stream's constraint

@@ -19,14 +19,23 @@ CLI::
 
     sheep-submit --server /run/sheepd.sock --input g.edges --k 8,64 \\
         --wait [--output parts.pbin] [--tenant alice] [--deadline 60]
+    sheep-submit --server ... --input g.edges --k 64 --watch
     sheep-submit --server ... --status JOB | --cancel JOB | --stats \\
-        | --ping | --shutdown
+        | --ping | --metrics | --profile DIR | --shutdown
 
-Exit codes: 0 op succeeded (for --wait: job DONE), 1 usage/transport,
-2 daemon answered ok=false, 3 job reached a non-done terminal state
-(failed / cancelled / deadline_exceeded / rejected), 4 --wait's
---timeout elapsed with the job still queued/running (not terminal —
-do not resubmit).
+``--watch`` (ISSUE 11) submits and then POLLS ``status`` instead of
+blocking in ``wait``: live progress lines on stderr (state, phase,
+steps — the descriptor's per-job progress fields), final descriptor
+JSON on stdout, same exit-code contract as ``--wait``. ``--metrics``
+prints the daemon's Prometheus exposition text; ``--profile DIR``
+(with ``--profile-steps K``) arms an on-demand jax.profiler capture
+of the next K dispatch steps into daemon-side DIR.
+
+Exit codes: 0 op succeeded (for --wait/--watch: job DONE), 1 usage/
+transport, 2 daemon answered ok=false, 3 job reached a non-done
+terminal state (failed / cancelled / deadline_exceeded / rejected),
+4 --wait's/--watch's --timeout elapsed with the job still queued/
+running (not terminal — do not resubmit).
 """
 
 from __future__ import annotations
@@ -121,6 +130,18 @@ class SheepClient:
     def stats(self) -> dict:
         return self.request({"op": "stats"})["stats"]
 
+    def metrics(self) -> str:
+        """The daemon's live Prometheus exposition text (same document
+        as HTTP GET /metrics on --metrics-port)."""
+        return self.request({"op": "metrics"})["text"]
+
+    def profile(self, dir: str, steps: int = 8) -> dict:
+        """Arm an on-demand jax.profiler capture of the next ``steps``
+        dispatch steps into daemon-side directory ``dir``; completion
+        is queryable via :meth:`stats`'s ``profile`` field."""
+        return self.request({"op": "profile", "dir": dir,
+                             "steps": steps})["profile"]
+
     def shutdown(self, drain: bool = False) -> dict:
         return self.request({"op": "shutdown", "drain": drain})
 
@@ -167,26 +188,76 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--wait", action="store_true",
                    help="block until the job is terminal; print its "
                         "descriptor; exit 0 only on done")
+    p.add_argument("--watch", action="store_true",
+                   help="like --wait but poll status and render live "
+                        "progress lines (state/phase/steps) on stderr "
+                        "instead of blocking silently")
+    p.add_argument("--poll", type=float, default=0.5, metavar="S",
+                   help="with --watch: poll interval (default 0.5s)")
     p.add_argument("--timeout", type=float, default=None,
-                   help="with --wait: give up after this many seconds")
+                   help="with --wait/--watch: give up after this many "
+                        "seconds")
     p.add_argument("--status", metavar="JOB")
     p.add_argument("--cancel", metavar="JOB")
     p.add_argument("--stats", action="store_true")
     p.add_argument("--ping", action="store_true")
+    p.add_argument("--metrics", action="store_true",
+                   help="print the daemon's live Prometheus text")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="arm an on-demand jax.profiler capture into "
+                        "daemon-side DIR")
+    p.add_argument("--profile-steps", type=int, default=8, metavar="K",
+                   help="with --profile: capture the next K dispatch "
+                        "steps (default 8)")
     p.add_argument("--shutdown", action="store_true")
     p.add_argument("--drain", action="store_true",
                    help="with --shutdown: finish accepted jobs first")
     return p
 
 
+def _watch_job(c: "SheepClient", job_id: str, poll_s: float,
+               timeout_s: Optional[float]) -> dict:
+    """Poll status until terminal (or timeout), rendering one progress
+    line per change on stderr; returns the last descriptor."""
+    import time
+
+    t0 = time.monotonic()
+    deadline = None if timeout_s is None else t0 + timeout_s
+    last_line = None
+    while True:
+        desc = c.status(job_id)
+        state = desc.get("state")
+        bits = [f"{time.monotonic() - t0:7.1f}s", job_id, state]
+        if desc.get("phase"):
+            bits.append(f"phase={desc['phase']}")
+        if desc.get("steps"):
+            bits.append(f"steps={desc['steps']}")
+        if state == "done" and desc.get("results"):
+            r = desc["results"][0]
+            bits.append(f"cut_ratio={r.get('cut_ratio')}")
+        if desc.get("error"):
+            bits.append(f"error={desc['error'][:120]}")
+        line = " ".join(bits)
+        if line != last_line:
+            print(f"sheep-submit: {line}", file=sys.stderr, flush=True)
+            last_line = line
+        if state in protocol.TERMINAL_STATES:
+            return desc
+        if deadline is not None and time.monotonic() >= deadline:
+            return desc
+        time.sleep(max(0.05, poll_s))
+
+
 def main(argv=None) -> int:
     p = build_parser()
     args = p.parse_args(argv)
     modes = [bool(args.input), bool(args.status), bool(args.cancel),
-             args.stats, args.ping, args.shutdown]
+             args.stats, args.ping, args.shutdown, args.metrics,
+             bool(args.profile)]
     if sum(modes) != 1:
         p.error("pass exactly one of --input (submit), --status, "
-                "--cancel, --stats, --ping, --shutdown")
+                "--cancel, --stats, --ping, --metrics, --profile, "
+                "--shutdown")
     try:
         with SheepClient(args.server) as c:
             if args.ping:
@@ -194,6 +265,13 @@ def main(argv=None) -> int:
                 return 0
             if args.stats:
                 print(json.dumps(c.stats(), indent=1))
+                return 0
+            if args.metrics:
+                sys.stdout.write(c.metrics())
+                return 0
+            if args.profile:
+                print(json.dumps(c.profile(args.profile,
+                                           steps=args.profile_steps)))
                 return 0
             if args.shutdown:
                 print(json.dumps(c.shutdown(drain=args.drain)))
@@ -228,10 +306,14 @@ def main(argv=None) -> int:
             if args.comm_volume:
                 job["comm_volume"] = True
             resp = c.submit(args.input, tenant=args.tenant, **job)
-            if not args.wait:
+            if not (args.wait or args.watch):
                 print(json.dumps(resp))
                 return 0
-            desc = c.wait(resp["job_id"], timeout_s=args.timeout)
+            if args.watch:
+                desc = _watch_job(c, resp["job_id"], args.poll,
+                                  args.timeout)
+            else:
+                desc = c.wait(resp["job_id"], timeout_s=args.timeout)
             print(json.dumps(desc))
             if desc.get("state") == "done":
                 return 0

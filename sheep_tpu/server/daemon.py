@@ -2,6 +2,7 @@
 
     sheepd --socket /run/sheepd.sock [--trace t.jsonl] [...]
     sheepd --port 7433 [--host 127.0.0.1]
+    sheepd ... --metrics-port 9090     # + HTTP GET /metrics scraping
     sheep serve ...            # same thing, via the main CLI
 
 One process holds the warm jit caches, the device chunk cache and the
@@ -54,7 +55,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "trees, heartbeats) to FILE")
     p.add_argument("--heartbeat-secs", type=float, default=None,
                    metavar="S",
-                   help="with --trace: periodic progress heartbeats")
+                   help="with --trace: periodic progress heartbeats "
+                        "(inside sheepd they carry queue depth + "
+                        "active-job service pressure)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="N",
+                   help="serve Prometheus text on HTTP GET /metrics "
+                        "at this port (0 = pick a free one; the bound "
+                        "port is printed on stderr)")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   help="metrics HTTP bind address (default "
+                        "127.0.0.1)")
     return p
 
 
@@ -66,6 +77,56 @@ class Daemon:
         self._shutdown_evt = threading.Event()
         self.scheduler = None
         self._root_span = None
+        self._metrics_httpd = None
+        self.metrics_port = None  # actual bound port, once listening
+
+    # -- telemetry HTTP listener (ISSUE 11) ----------------------------
+    def _start_metrics_http(self):
+        """Minimal scrape endpoint: GET /metrics answers the same
+        Prometheus text as the `metrics` protocol verb, so any scraper
+        (or a future replica router) can poll a running sheepd without
+        speaking the line protocol. Serves nothing else; runs on its
+        own daemon threads; never touches the dispatch chain beyond
+        the scheduler's locked render."""
+        import http.server
+
+        from sheep_tpu.obs import metrics as metrics_mod
+
+        daemon = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.rstrip("/") not in ("/metrics", ""):
+                    self.send_error(404, "only /metrics lives here")
+                    return
+                try:
+                    body = daemon.scheduler.render_metrics() \
+                        .encode("utf-8")
+                except Exception as e:  # noqa: BLE001 — answered
+                    self.send_error(
+                        500, f"render failed: {type(e).__name__}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 metrics_mod.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not log traffic
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(
+            (self.args.metrics_host, self.args.metrics_port), Handler)
+        httpd.daemon_threads = True
+        self._metrics_httpd = httpd
+        self.metrics_port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever,
+                             daemon=True, name="sheepd-metrics-http")
+        t.start()
+        print(f"sheepd: metrics on http://{self.args.metrics_host}:"
+              f"{self.metrics_port}/metrics",
+              file=sys.stderr, flush=True)
 
     # -- wire ----------------------------------------------------------
     def _bind(self) -> socket.socket:
@@ -175,6 +236,19 @@ class Daemon:
                     "jobs": [j.descriptor() for j in sched.jobs()]}
         if op == "stats":
             return {"ok": True, "stats": sched.stats()}
+        if op == "metrics":
+            from sheep_tpu.obs import metrics as metrics_mod
+
+            return {"ok": True,
+                    "content_type": metrics_mod.CONTENT_TYPE,
+                    "text": sched.render_metrics()}
+        if op == "profile":
+            pdir = req.get("dir")
+            if not pdir or not isinstance(pdir, str):
+                raise protocol.ProtocolError(
+                    "profile needs a daemon-side directory in 'dir'")
+            info = sched.arm_profile(pdir, steps=req.get("steps", 8))
+            return {"ok": True, "profile": info}
         if op == "shutdown":
             drain = bool(req.get("drain", False))
             sched.shutdown(drain=drain)
@@ -197,15 +271,21 @@ class Daemon:
         if a.trace:
             tracer = obs.install(obs.Tracer(a.trace))
             obs.emit_manifest(tracer, config=vars(a), backend="sheepd")
-            if a.heartbeat_secs:
-                tracer.heartbeat = obs.Heartbeat(
-                    tracer, a.heartbeat_secs).start()
         root_span = obs.begin("serve")
         self._root_span = root_span
         try:
             self.scheduler = Scheduler(
                 budget_bytes=a.budget_bytes,
                 root_span_id=getattr(root_span, "id", None))
+            if tracer is not None and a.heartbeat_secs:
+                # started after the scheduler exists so each beat can
+                # sample its queue depth / active jobs: soak logs show
+                # SERVICE pressure, not just per-run progress
+                tracer.heartbeat = obs.Heartbeat(
+                    tracer, a.heartbeat_secs,
+                    service=self.scheduler.service_pressure).start()
+            if a.metrics_port is not None:
+                self._start_metrics_http()
             self._sock = self._bind()
             addr = a.socket if a.socket is not None \
                 else f"{a.host}:{a.port}"
@@ -230,6 +310,12 @@ class Daemon:
             self._shutdown_evt.set()
             return 0
         finally:
+            if self._metrics_httpd is not None:
+                try:
+                    self._metrics_httpd.shutdown()
+                    self._metrics_httpd.server_close()
+                except OSError:
+                    pass
             if self._sock is not None:
                 try:
                     self._sock.close()

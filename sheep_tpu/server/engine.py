@@ -92,6 +92,14 @@ class JobEngine:
         if nxt is not None:
             self.batch = nxt[0]
 
+    def _enter_phase(self, phase: str) -> None:
+        # live progress signal (ISSUE 11): the job descriptor's phase
+        # field updates at phase ENTRY (the scheduler confirms it from
+        # each step's yield value afterward), and the transition lands
+        # in the trace + the job's flight-recorder ring
+        self.job.phase = phase
+        obs.event("job_phase", job=self.job.id, phase=phase)
+
     def _on_device_loss(self):
         # best-effort in-process runtime reinit (utils/retry, ISSUE 9):
         # THIS job's live device arrays died with the old client, so
@@ -123,6 +131,7 @@ class JobEngine:
 
             # ---- degrees --------------------------------------------
             t0 = time.perf_counter()
+            self._enter_phase("degrees")
             sp = obs.begin_detached("degrees", parent=job.span_id)
             deg_host = np.zeros(n, dtype=np.int64)
             deg = degrees_ops.init_degrees(n)
@@ -149,6 +158,7 @@ class JobEngine:
 
             # ---- sort (one step) ------------------------------------
             t0 = time.perf_counter()
+            self._enter_phase("sort")
             sp = obs.begin_detached("sort", parent=job.span_id)
             try:
                 # the rank clip + flush cadence are SHARED with the tpu
@@ -168,6 +178,7 @@ class JobEngine:
 
             # ---- build: staged batched dispatch ---------------------
             t0 = time.perf_counter()
+            self._enter_phase("build")
             sp = obs.begin_detached("build", parent=job.span_id)
             P = jnp.full(n + 1, n, dtype=jnp.int32)
             total_rounds = 0
@@ -233,6 +244,7 @@ class JobEngine:
 
             # ---- split (host, per k — the multi-k reuse query) ------
             t0 = time.perf_counter()
+            self._enter_phase("split")
             sp = obs.begin_detached("split", parent=job.span_id)
             try:
                 parent = elim_ops.minp_to_parent(minp, order, n)
@@ -250,6 +262,7 @@ class JobEngine:
 
             # ---- score: ONE stream pass for every k -----------------
             t0 = time.perf_counter()
+            self._enter_phase("score")
             sp = obs.begin_detached("score", parent=job.span_id)
             dev_assign = {
                 k: jnp.concatenate([jnp.asarray(a, dtype=jnp.int32),

@@ -15,7 +15,21 @@ Requests (``op`` selects):
     {"op": "cancel", "job_id": "j3"}
     {"op": "list"}
     {"op": "stats"}
+    {"op": "metrics"}
+    {"op": "profile", "dir": "/tmp/prof", "steps": 8}
     {"op": "shutdown", "drain": false}
+
+Telemetry verbs (ISSUE 11): ``metrics`` answers ``{"ok": true,
+"content_type": ..., "text": "<Prometheus exposition>"}`` — the same
+document the daemon's optional HTTP ``GET /metrics`` listener
+(``--metrics-port``) serves, with per-tenant request-latency
+histograms, queue/reservation gauges and per-active-job progress.
+``profile`` arms an on-demand ``jax.profiler`` capture of the next
+``steps`` dispatch steps into ``dir`` (daemon-side path); the answer
+confirms arming, capture progress is queryable under ``stats``'s
+``profile`` field. Job descriptors carry live ``phase`` + ``steps``
+progress fields while running (what ``sheep-submit --watch`` and
+``sheeptop`` poll).
 
 Job lifecycle (:data:`JOB_STATES`)::
 
@@ -63,7 +77,7 @@ JOB_STATES = (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
 TERMINAL_STATES = (DONE, FAILED, CANCELLED, DEADLINE_EXCEEDED, REJECTED)
 
 OPS = ("ping", "submit", "status", "wait", "cancel", "list", "stats",
-       "shutdown")
+       "metrics", "profile", "shutdown")
 
 MAX_REQUEST_BYTES = 1 << 20  # one request line; jobs are specs, not data
 

@@ -48,6 +48,8 @@ from collections import OrderedDict, deque
 from typing import Optional
 
 from sheep_tpu import obs
+from sheep_tpu.obs.flightrec import FlightRecorder
+from sheep_tpu.obs.metrics import MetricRegistry
 from sheep_tpu.server import protocol
 from sheep_tpu.server.engine import JobEngine
 from sheep_tpu.server.protocol import (CANCELLED, DEADLINE_EXCEEDED, DONE,
@@ -141,6 +143,11 @@ class Job:
         self.span_id = None
         self.cancel_requested = False
         self.steps = 0
+        # live phase name (degrees/sort/build/split/score): written by
+        # the engine at phase entry and confirmed by the scheduler from
+        # the step generator's yield values — the per-job progress
+        # signal `sheep-submit --watch` and the job gauges poll
+        self.phase: Optional[str] = None
         # per-step compile-cache delta sum (None until started): the
         # dispatch thread serializes steps, so attributing each step's
         # global cache growth to the job that ran it is EXACT even
@@ -158,6 +165,8 @@ class Job:
              "state": self.state, "submit_t": round(self.submit_t, 3),
              "n_vertices": int(self.n_vertices),
              "modeled_bytes": self.modeled_bytes, "steps": self.steps}
+        if self.phase is not None:
+            d["phase"] = self.phase
         if self.error is not None:
             d["error"] = self.error
         if self.deadline_t is not None:
@@ -205,6 +214,48 @@ class Scheduler:
                        "cancelled": 0, "rejected": 0,
                        "deadline_exceeded": 0}
         self.started_t = time.time()
+        # ---- live telemetry plane (ISSUE 11) -------------------------
+        # Typed metric registry: the `metrics` verb and the daemon's
+        # HTTP /metrics listener render this; the collector absorbs
+        # queue/reservation/cache state, per-active-job progress, the
+        # active tracer's CounterRegistry and device memory as live
+        # gauges at scrape time.
+        self.metrics = MetricRegistry()
+        self._m_submitted = self.metrics.counter(
+            "sheepd_jobs_submitted_total",
+            "jobs accepted at the protocol boundary", ("tenant",))
+        self._m_terminal = self.metrics.counter(
+            "sheepd_jobs_terminal_total",
+            "jobs reaching a terminal state", ("tenant", "state"))
+        self._m_rejected = self.metrics.counter(
+            "sheepd_admission_rejected_total",
+            "jobs the admission budget rejected outright", ("tenant",))
+        self._m_retries = self.metrics.counter(
+            "sheepd_dispatch_retries_total",
+            "dispatch retries absorbed inside served jobs", ("tenant",))
+        self._m_steps = self.metrics.counter(
+            "sheepd_steps_total",
+            "dispatch steps executed (one staged group of device work)",
+            ("tenant",))
+        self._m_latency = self.metrics.histogram(
+            "sheepd_request_latency_seconds",
+            "queued->done request latency (the SLO series)", ("tenant",))
+        self._m_queue_wait = self.metrics.histogram(
+            "sheepd_queue_wait_seconds",
+            "submit->start admission wait", ("tenant",))
+        self._m_step_s = self.metrics.histogram(
+            "sheepd_step_seconds", "one dispatch step", ("phase",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        self.metrics.add_collector(self._collect_live_gauges)
+        # Always-on flight recorder: bounded per-job rings fed by
+        # obs.event, dumped on job failure / fault injection / shutdown
+        # — post-mortem forensics without full tracing on every request
+        self.flight = obs.install_flight(FlightRecorder())
+        # on-demand jax.profiler capture state (the `profile` verb):
+        # armed under the lock, driven by the dispatch thread only
+        self._profile: Optional[dict] = None
+        self.last_profile: Optional[dict] = None
 
     # ------------------------------------------------------------------
     # submit-side API (connection handler threads)
@@ -227,11 +278,14 @@ class Scheduler:
                 job.stats["admission_dispatch_batch"] = batch
             self._jobs[job.id] = job
             self.totals["submitted"] += 1
+            self._m_submitted.inc(tenant=spec.tenant)
             if rejected_why is not None:
                 job.state = REJECTED
                 job.error = rejected_why
                 job.end_t = time.time()
                 self.totals["rejected"] += 1
+                self._m_rejected.inc(tenant=spec.tenant)
+                self._m_terminal.inc(tenant=spec.tenant, state=REJECTED)
             else:
                 self._pending.append(job)
             obs.event("job_submit", job=job.id, tenant=spec.tenant,
@@ -349,6 +403,15 @@ class Scheduler:
                 "active": len(self._active),
                 "compile_cache": compile_cache_sizes(),
                 "chunk_caches": len(self._caches),
+                "flight_dumps": self.flight.dumps,
+                # a COPY, internals stripped: the live dict is mutated
+                # by the dispatch thread while a handler serializes
+                "profile": (None if (self._profile or self.last_profile)
+                            is None else
+                            {k: v for k, v in
+                             (self._profile
+                              or self.last_profile).items()
+                             if k != "remaining"}),
             }
 
     def shutdown(self, drain: bool = False) -> None:
@@ -364,37 +427,217 @@ class Scheduler:
             self._cond.notify_all()
 
     # ------------------------------------------------------------------
+    # live telemetry (ISSUE 11): /metrics exposition + heartbeat feed
+    # ------------------------------------------------------------------
+    def render_metrics(self) -> str:
+        """The Prometheus exposition document the `metrics` verb and
+        the daemon's HTTP listener answer."""
+        return self.metrics.render()
+
+    def service_pressure(self) -> dict:
+        """Cheap live queue-depth/active-job sample — the heartbeat's
+        service-pressure fields when running inside sheepd."""
+        with self._lock:
+            return {"queue_depth": len(self._pending),
+                    "active_jobs": len(self._active)}
+
+    def _collect_live_gauges(self):
+        """Scrape-time collector: queue/reservation/cache state,
+        per-active-job progress, the active tracer's CounterRegistry
+        absorbed as live gauges (not just span-boundary deltas), and
+        device-memory stats. Runs on the scraping thread; everything
+        under the lock is a handful of len()s."""
+        with self._lock:
+            active = list(self._active)
+            samples = [
+                ("sheepd_queue_depth", {}, len(self._pending)),
+                ("sheepd_active_jobs", {}, len(active)),
+                ("sheepd_reserved_bytes", {},
+                 sum(j.modeled_bytes or 0 for j in active)),
+                ("sheepd_chunk_caches", {}, len(self._caches)),
+                ("sheepd_uptime_seconds", {},
+                 round(time.time() - self.started_t, 1)),
+                # no _total suffix: collector samples render as gauges,
+                # and a _total-named gauge trips OpenMetrics linting
+                ("sheepd_flight_dumps", {}, self.flight.dumps),
+            ]
+            if self.budget is not None:
+                reserved = sum(j.modeled_bytes or 0 for j in active)
+                samples.append(("sheepd_budget_bytes", {}, self.budget))
+                samples.append(("sheepd_headroom_bytes", {},
+                                self.budget - reserved))
+            for job in active:
+                labels = {"job": job.id, "tenant": job.spec.tenant}
+                samples.append(("sheepd_job_steps", labels, job.steps))
+        for name, n in compile_cache_sizes().items():
+            samples.append(("sheepd_compile_cache_entries",
+                            {"program": name}, n))
+        tracer = obs.get_tracer()
+        if tracer is not None:
+            for k, v in tracer.counters.snapshot().items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    samples.append(("sheep_run_counter",
+                                    {"name": str(k)}, v))
+        from sheep_tpu.utils.metrics import device_memory_stats
+
+        for k, v in (device_memory_stats() or {}).items():
+            samples.append((f"sheepd_device_{k}", {}, v))
+        return samples
+
+    # ------------------------------------------------------------------
+    # on-demand device profiling (the `profile` verb)
+    # ------------------------------------------------------------------
+    def arm_profile(self, profile_dir: str, steps: int = 8) -> dict:
+        """Arm a jax.profiler capture of the next ``steps`` dispatch
+        steps into ``profile_dir``. Returns the armed descriptor; the
+        capture itself is driven by the dispatch thread (profiling a
+        live daemon must not add a second thread touching the device).
+        One capture at a time — overlapping captures would interleave
+        in one trace directory and attribute nothing."""
+        try:
+            steps = int(steps)
+        except (TypeError, ValueError):
+            raise protocol.ProtocolError(
+                "profile steps must be an integer") from None
+        if steps < 1:
+            raise protocol.ProtocolError("profile steps must be >= 1")
+        with self._lock:
+            if self._stop or self._draining:
+                raise protocol.ProtocolError("daemon is shutting down")
+            if self._profile is not None:
+                raise protocol.ProtocolError(
+                    "a profile capture is already "
+                    f"{self._profile.get('state', 'armed')} "
+                    f"(dir {self._profile.get('dir')!r})")
+            self._profile = {"dir": str(profile_dir), "state": "armed",
+                             "steps_requested": steps,
+                             "remaining": steps}
+            info = {k: v for k, v in self._profile.items()
+                    if k != "remaining"}
+        obs.event("profile_armed", dir=str(profile_dir), steps=steps)
+        return info
+
+    def _profile_tick_begin(self) -> None:
+        # dispatch thread only (the sole state-transitioner once
+        # armed): start the armed capture at a step boundary so the
+        # trace holds WHOLE steps. Dict mutations happen under the
+        # lock — stats() snapshots this dict from handler threads.
+        prof = self._profile
+        if prof is None or prof["state"] != "armed":
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(prof["dir"])
+        except Exception as e:  # profiler unavailable: verb answered,
+            with self._lock:    # daemon unharmed
+                prof["state"] = "error"
+                prof["error"] = f"{type(e).__name__}: {str(e)[:200]}"
+                self.last_profile = {k: v for k, v in prof.items()
+                                     if k != "remaining"}
+                self._profile = None
+            obs.event("profile_error", dir=prof["dir"],
+                      error=prof["error"])
+            return
+        with self._lock:
+            prof["state"] = "capturing"
+        obs.event("profile_start", dir=prof["dir"],
+                  steps=prof["steps_requested"])
+
+    def _profile_tick_end(self) -> None:
+        prof = self._profile
+        if prof is None or prof["state"] != "capturing":
+            return
+        with self._lock:
+            prof["remaining"] -= 1
+            finished = prof["remaining"] <= 0
+        if finished:
+            self._finish_profile()
+
+    def _finish_profile(self, aborted: bool = False) -> None:
+        prof = self._profile
+        if prof is None:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            state = "aborted" if aborted else "done"
+            err = None
+        except Exception as e:
+            state = "error"
+            err = f"{type(e).__name__}: {str(e)[:200]}"
+        with self._lock:
+            prof["state"] = state
+            if err is not None:
+                prof["error"] = err
+            prof["steps_captured"] = \
+                prof["steps_requested"] - max(0, prof["remaining"])
+            self.last_profile = {k: v for k, v in prof.items()
+                                 if k != "remaining"}
+            self._profile = None
+        obs.event("profile_done", dir=prof["dir"], state=state,
+                  steps_captured=prof["steps_captured"])
+
+    # ------------------------------------------------------------------
     # the dispatch loop (one thread)
     # ------------------------------------------------------------------
     def run(self) -> None:
         """Round-robin dispatch until shutdown; see module docstring."""
-        while True:
-            to_close: list = []
-            with self._lock:
-                self._expire_locked()
+        try:
+            while True:
+                to_close: list = []
+                with self._lock:
+                    self._expire_locked()
+                    if self._stop:
+                        for job in list(self._pending):
+                            self._pending.remove(job)
+                            self._finalize_locked(job, CANCELLED)
+                        for job in list(self._active):
+                            self._finalize_locked(job, CANCELLED)
+                            to_close.append(job)
                 if self._stop:
-                    for job in list(self._pending):
-                        self._pending.remove(job)
-                        self._finalize_locked(job, CANCELLED)
-                    for job in list(self._active):
-                        self._finalize_locked(job, CANCELLED)
-                        to_close.append(job)
-            if self._stop:
-                for job in to_close:
-                    self._close_gen(job)
-                return
-            with self._lock:
-                self._admit_locked()
-                if self._draining and not self._pending \
-                        and not self._active:
+                    for job in to_close:
+                        self._close_gen(job)
                     return
-                if not self._active:
-                    # bounded wait: queued-job deadlines tick while idle
-                    self._cond.wait(timeout=0.1)
+                with self._lock:
+                    self._admit_locked()
+                    if self._draining and not self._pending \
+                            and not self._active:
+                        return
+                    idle = not self._active
+                    capturing = self._profile is not None \
+                        and self._profile["state"] == "capturing"
+                    if idle and not capturing:
+                        # bounded wait: queued-job deadlines tick
+                        # while idle
+                        self._cond.wait(timeout=0.1)
+                    cycle = [] if idle else list(self._active)
+                if idle:
+                    if capturing:
+                        # the job set drained mid-capture: there is no
+                        # Kth step coming — stop the profiler now (an
+                        # open capture grows host memory forever and
+                        # blocks every re-arm)
+                        self._finish_profile(aborted=True)
                     continue
-                cycle = list(self._active)
-            for job in cycle:
-                self._step(job)
+                for job in cycle:
+                    self._step(job)
+        finally:
+            self._teardown_telemetry()
+
+    def _teardown_telemetry(self) -> None:
+        """Dispatch-loop exit sweep: stop a mid-flight profiler
+        capture, dump the flight recorder (shutdown is a dump trigger
+        — the daemon's last moments are forensics too), release the
+        process-wide recorder slot."""
+        prof = self._profile
+        if prof is not None and prof.get("state") == "capturing":
+            self._finish_profile(aborted=True)
+        self.flight.dump_all(reason="shutdown")
+        if obs.get_flight() is self.flight:
+            obs.uninstall_flight()
 
     def _expire_locked(self) -> None:
         # reentrant re-acquire (RLock): callers already hold the lock;
@@ -426,6 +669,8 @@ class Scheduler:
             job.state = RUNNING
             job.start_t = time.time()
             job.jit_compiles = 0
+            self._m_queue_wait.observe(job.start_t - job.submit_t,
+                                       tenant=job.spec.tenant)
             job.span = obs.begin_detached(
                 f"job:{job.id}", parent=self.root_span_id, job=job.id,
                 tenant=job.spec.tenant, input=job.spec.input,
@@ -460,17 +705,28 @@ class Scheduler:
         # waits from handler threads must never block on a fold. Steps
         # are serialized on this one thread, so the compile-cache
         # growth across ONE step belongs to exactly this job — the
-        # exact per-job jit attribution under interleaving.
+        # exact per-job jit attribution under interleaving. The same
+        # serialization makes the flight-recorder job context exact:
+        # every event the engine/retry layer emits during THIS next()
+        # lands in THIS job's ring.
+        self._profile_tick_begin()
         jit0 = sum(compile_cache_sizes().values())
+        t_step = time.perf_counter()
         try:
             try:
-                next(job.gen)
+                with self.flight.job_context(job.id):
+                    phase = next(job.gen)
             finally:
                 grew = sum(compile_cache_sizes().values()) - jit0
                 if grew and job.jit_compiles is not None:
                     job.jit_compiles += grew
+                self._profile_tick_end()
+            self._m_step_s.observe(time.perf_counter() - t_step,
+                                   phase=str(phase))
+            self._m_steps.inc(tenant=job.spec.tenant)
             with self._lock:
                 job.steps += 1
+                job.phase = str(phase)
             return
         except StopIteration:
             outcome, error = DONE, None
@@ -479,6 +735,13 @@ class Scheduler:
             error = f"{type(exc).__name__}: {str(exc)[:300]}"
         with self._lock:
             self._finalize_locked(job, outcome, error)
+        if outcome == FAILED:
+            # forensics: the job's last N buffered events (terminal
+            # event included — job_done landed in the ring at
+            # finalize), dumped into the trace sink OUTSIDE the lock:
+            # a slow trace write must not wedge every handler thread
+            self.flight.dump(job.id, reason="job_failed:"
+                             f"{(error or '?')[:120]}")
         self._close_gen(job)
 
     # terminal jobs retained for status/wait queries; beyond this the
@@ -507,6 +770,15 @@ class Scheduler:
             if state == DONE:
                 self._write_output(job)
             self.totals[state] = self.totals.get(state, 0) + 1
+            self._m_terminal.inc(tenant=job.spec.tenant, state=state)
+            if state == DONE:
+                # the SLO series: queued->done, queue wait included —
+                # the client asked for a result at submit, not at start
+                self._m_latency.observe(job.end_t - job.submit_t,
+                                        tenant=job.spec.tenant)
+            retries = job.stats.get("dispatch_retries")
+            if isinstance(retries, (int, float)) and retries:
+                self._m_retries.inc(int(retries), tenant=job.spec.tenant)
             if job.span is not None:
                 cost = {k: job.stats[k]
                         for k in ("device_rounds", "host_syncs",
@@ -518,6 +790,11 @@ class Scheduler:
                       state=state, error=error,
                       jit_compiles=job.jit_compiles,
                       steps=job.steps)
+            if state == DONE:
+                # healthy jobs leave no ring behind: failed/cancelled
+                # rings are worth retaining for the shutdown sweep, a
+                # done job's is just noise
+                self.flight.forget(job.id)
             terminal = [jid for jid, j in self._jobs.items()
                         if j.state in TERMINAL_STATES]
             for jid in terminal[:max(0, len(terminal)

@@ -1,0 +1,220 @@
+"""sheeptop — a live console view over a running sheepd (ISSUE 11).
+
+    sheeptop --server /run/sheepd.sock            # curses refresh view
+    sheeptop --server 127.0.0.1:7433 --plain      # line-mode refresh
+    sheeptop --server ... --once                  # one snapshot, exit 0
+
+Polls the ``metrics`` + ``list`` protocol verbs (no HTTP needed — it
+speaks the same line protocol as sheep-submit) and renders:
+
+- daemon headroom: uptime, queue depth, active jobs, reserved vs
+  budget bytes, device memory, flight-recorder dumps;
+- per-tenant SLO lines: request count and p50/p90/p99 latency
+  estimated from the ``sheepd_request_latency_seconds`` histogram
+  buckets;
+- per-job rows: id, tenant, state, live phase, steps, wall seconds.
+
+Rendering is pure string assembly (:func:`render_lines`) so tests pin
+it without a terminal; curses is a presentation detail that degrades
+to plain line mode on dumb terminals, ``--plain``, or ``--once``.
+The client reconnects per poll — a daemon restart mid-watch shows as
+one unreachable frame, not a dead tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from sheep_tpu.obs import metrics as metrics_mod
+from sheep_tpu.server.client import ServerError, SheepClient
+
+
+def fetch(server: str, timeout_s: float = 10.0) -> dict:
+    """One poll: parsed metrics + job list from a fresh connection."""
+    with SheepClient(server, timeout_s=timeout_s) as c:
+        text = c.metrics()
+        jobs = c.list()
+    return {"metrics": metrics_mod.parse_prometheus(text),
+            "jobs": jobs, "t": time.time()}
+
+
+def _g(parsed: dict, name: str, default=None):
+    rows = parsed.get(name)
+    if not rows:
+        return default
+    return rows[0][1]
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{float(v):.2f}s"
+
+
+def tenant_slo_rows(parsed: dict) -> List[dict]:
+    """Per-tenant request-latency percentiles from the scraped
+    histogram buckets."""
+    buckets = parsed.get("sheepd_request_latency_seconds_bucket", [])
+    counts = parsed.get("sheepd_request_latency_seconds_count", [])
+    tenants = sorted({lb.get("tenant") for lb, _ in counts
+                      if lb.get("tenant") is not None})
+    rows = []
+    for tenant in tenants:
+        match = {"tenant": tenant}
+        n = next((v for lb, v in counts
+                  if lb.get("tenant") == tenant), 0)
+        rows.append({
+            "tenant": tenant, "requests": int(n),
+            "p50": metrics_mod.histogram_series_quantile(
+                buckets, 0.5, match),
+            "p90": metrics_mod.histogram_series_quantile(
+                buckets, 0.9, match),
+            "p99": metrics_mod.histogram_series_quantile(
+                buckets, 0.99, match),
+        })
+    return rows
+
+
+def render_lines(model: dict, width: int = 100) -> List[str]:
+    """The whole screen as plain strings (shared by curses and plain
+    modes, pinned by tests)."""
+    parsed = model["metrics"]
+    jobs = model["jobs"]
+    lines = []
+    up = _g(parsed, "sheepd_uptime_seconds")
+    reserved = _g(parsed, "sheepd_reserved_bytes")
+    budget = _g(parsed, "sheepd_budget_bytes")
+    lines.append(
+        f"sheepd up {up if up is None else int(up)}s  "
+        f"queue={int(_g(parsed, 'sheepd_queue_depth', 0))}  "
+        f"active={int(_g(parsed, 'sheepd_active_jobs', 0))}  "
+        f"reserved={_fmt_bytes(reserved)}"
+        + (f"/{_fmt_bytes(budget)}" if budget is not None else "")
+        + f"  flight_dumps="
+          f"{int(_g(parsed, 'sheepd_flight_dumps', 0))}")
+    mem = _g(parsed, "sheepd_device_bytes_in_use")
+    peak = _g(parsed, "sheepd_device_peak_bytes_in_use")
+    if mem is not None or peak is not None:
+        lines.append(f"device mem: in_use={_fmt_bytes(mem)} "
+                     f"peak={_fmt_bytes(peak)}")
+    slo = tenant_slo_rows(parsed)
+    if slo:
+        lines.append("")
+        lines.append(f"{'tenant':<16}{'requests':>9}{'p50':>10}"
+                     f"{'p90':>10}{'p99':>10}")
+        for row in slo:
+            lines.append(
+                f"{row['tenant'][:15]:<16}{row['requests']:>9}"
+                f"{_fmt_s(row['p50']):>10}{_fmt_s(row['p90']):>10}"
+                f"{_fmt_s(row['p99']):>10}")
+    lines.append("")
+    lines.append(f"{'job':<8}{'tenant':<16}{'state':<19}{'phase':<9}"
+                 f"{'steps':>7}  {'wall':>8}")
+    now = model.get("t", time.time())
+    for j in jobs:
+        start = j.get("start_t")
+        end = j.get("end_t")
+        wall = j.get("wall_s")
+        if wall is None and start is not None:
+            wall = max(0.0, (end or now) - start)
+        lines.append(
+            f"{str(j.get('job_id', '?'))[:7]:<8}"
+            f"{str(j.get('tenant', '?'))[:15]:<16}"
+            f"{str(j.get('state', '?')):<19}"
+            f"{str(j.get('phase', '-')):<9}"
+            f"{int(j.get('steps', 0)):>7}  "
+            f"{'-' if wall is None else f'{wall:8.1f}s'}")
+    if not jobs:
+        lines.append("  (no jobs)")
+    return [ln[:width] for ln in lines]
+
+
+def _loop_plain(args) -> int:
+    while True:
+        try:
+            model = fetch(args.server)
+            out = "\n".join(render_lines(model))
+        except (ServerError, OSError) as e:
+            out = f"sheeptop: daemon unreachable: {e}"
+        print(out, flush=True)
+        if args.once:
+            return 0
+        print("-" * 60, flush=True)
+        time.sleep(max(0.2, args.interval))
+
+
+def _loop_curses(args) -> int:
+    import curses
+
+    def run(scr):
+        curses.curs_set(0)
+        scr.timeout(int(max(0.2, args.interval) * 1000))
+        while True:
+            try:
+                model = fetch(args.server)
+                lines = render_lines(
+                    model, width=max(20, scr.getmaxyx()[1] - 1))
+            except (ServerError, OSError) as e:
+                lines = [f"sheeptop: daemon unreachable: {e}"]
+            scr.erase()
+            maxy = scr.getmaxyx()[0]
+            for i, ln in enumerate(lines[:maxy - 1]):
+                try:
+                    scr.addstr(i, 0, ln)
+                except curses.error:
+                    break  # terminal shrank mid-draw
+            try:
+                scr.addstr(maxy - 1, 0, "q to quit")
+            except curses.error:
+                pass
+            scr.refresh()
+            ch = scr.getch()
+            if ch in (ord("q"), ord("Q")):
+                return 0
+
+    return curses.wrapper(run)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sheeptop",
+        description="live console view over a running sheepd "
+                    "(metrics + list verbs)")
+    p.add_argument("--server", required=True,
+                   help="daemon address: unix socket path or host:port")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="refresh interval (default 2s)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--plain", action="store_true",
+                   help="line mode (no curses) even on a tty")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.once or args.plain or not sys.stdout.isatty():
+            return _loop_plain(args)
+        return _loop_curses(args)
+    except KeyboardInterrupt:
+        return 0
+    except (ServerError, OSError) as e:
+        print(f"sheeptop: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,10 +60,18 @@ class Prefetcher(Iterator[T]):
     def __init__(self, iterable: Iterable[T], depth: int = 2):
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
+        from sheep_tpu import obs
+
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._closed = False
         self._done = False
+        # flight-recorder attribution (ISSUE 11): the worker inherits
+        # the job context of the thread that CREATED it (thread-locals
+        # don't cross threads), so read faults / retries emitted while
+        # pre-reading a served job's chunks land in that job's ring,
+        # not the global one
+        self._flight_job = obs.flight_job()
         self._thread = threading.Thread(
             target=self._worker, args=(iterable,), daemon=True,
             name="sheep-prefetch")
@@ -81,16 +89,19 @@ class Prefetcher(Iterator[T]):
         return False
 
     def _worker(self, iterable) -> None:
-        try:
-            for item in iterable:
-                if not self._put_until_stop(item):
-                    return
-                if self._stop.is_set():
-                    return
-        except BaseException as e:  # delivered to the consumer
-            self._put_until_stop(_Raised(e))
-            return
-        self._put_until_stop(_END)
+        from sheep_tpu import obs
+
+        with obs.flight_job_context(self._flight_job):
+            try:
+                for item in iterable:
+                    if not self._put_until_stop(item):
+                        return
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # delivered to the consumer
+                self._put_until_stop(_Raised(e))
+                return
+            self._put_until_stop(_END)
 
     def __iter__(self) -> "Prefetcher[T]":
         return self

@@ -1,0 +1,351 @@
+"""Live telemetry plane tests (ISSUE 11): histogram bucket math,
+Prometheus rendering pinned by a golden file, the text parser, the
+flight recorder's rings/routing/dump triggers, and the disabled-path
+overhead smoke. Server-side wiring (metrics verb, HTTP scrape, fault
+dumps through a real scheduler) lives in test_server.py; the full
+daemon leg is tools/obs_smoke.sh leg 7."""
+
+import io
+import json
+import math
+import os
+import time
+
+import pytest
+
+from sheep_tpu import obs
+from sheep_tpu.obs import metrics as metrics_mod
+from sheep_tpu.obs.flightrec import FlightRecorder
+from sheep_tpu.obs.metrics import (MetricRegistry, histogram_series_quantile,
+                                   parse_prometheus,
+                                   quantile_from_cumulative)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "metrics_prom.txt")
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket math
+# ---------------------------------------------------------------------------
+
+def test_histogram_boundary_values_use_le_semantics():
+    """An observation EQUAL to a bucket's upper bound lands in that
+    bucket (Prometheus `le`), one epsilon above lands in the next."""
+    r = MetricRegistry()
+    h = r.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)    # le="0.1"
+    h.observe(0.1001)  # le="1"
+    h.observe(10.0)   # le="10"
+    h.observe(10.001)  # +Inf
+    snap = h.snapshot()
+    assert snap["cum"] == [1, 2, 3, 4]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(0.1 + 0.1001 + 10.0 + 10.001)
+
+
+def test_histogram_inf_bucket_and_rendering_is_cumulative():
+    r = MetricRegistry()
+    h = r.histogram("h_seconds", buckets=(1.0,))
+    for v in (0.5, 2.0, 3.0):
+        h.observe(v)
+    text = r.render()
+    assert 'h_seconds_bucket{le="1"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+
+
+def test_histogram_rejects_bad_buckets():
+    r = MetricRegistry()
+    with pytest.raises(ValueError):
+        r.histogram("a", buckets=(1.0, 1.0))      # not ascending
+    with pytest.raises(ValueError):
+        r.histogram("b", buckets=(1.0, 0.5))
+    with pytest.raises(ValueError):
+        r.histogram("c", buckets=(1.0, math.inf))  # +Inf is implicit
+    with pytest.raises(ValueError):
+        r.histogram("d", buckets=())
+
+
+def test_quantile_estimates_interpolate_within_bucket():
+    # 10 observations uniform in (0, 1], bucket uppers 0.5/1.0: the
+    # median rank sits at the upper edge of the first bucket
+    r = MetricRegistry()
+    h = r.histogram("q_seconds", buckets=(0.5, 1.0))
+    for i in range(1, 11):
+        h.observe(i / 10)
+    assert h.quantile(0.5) == pytest.approx(0.5)
+    assert h.quantile(0.25) == pytest.approx(0.25)
+    assert h.quantile(1.0) == pytest.approx(1.0)
+    # empty series: no estimate, not a crash
+    assert h.quantile(0.5, **{}) is not None
+    h2 = r.histogram("q2_seconds", buckets=(0.5,))
+    assert h2.quantile(0.9) is None
+
+
+def test_quantile_landing_in_inf_bucket_returns_last_finite_upper():
+    assert quantile_from_cumulative((0.1, 1.0), [0, 0, 5], 0.5) == 1.0
+    assert quantile_from_cumulative((1.0,), [0, 0], 0.5) is None
+
+
+def test_counter_and_gauge_semantics():
+    r = MetricRegistry()
+    c = r.counter("jobs_total", labelnames=("tenant",))
+    c.inc(tenant="a")
+    c.inc(4, tenant="a")
+    assert c.value(tenant="a") == 5
+    with pytest.raises(ValueError):
+        c.inc(-1, tenant="a")          # counters never decrease
+    with pytest.raises(ValueError):
+        c.inc(tenant="a", bogus="x")   # label mismatch
+    g = r.gauge("depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value() == 5
+    g.remove()
+    assert "depth 5" not in r.render()
+
+
+def test_registry_is_idempotent_but_type_strict():
+    r = MetricRegistry()
+    c1 = r.counter("x_total", labelnames=("tenant",))
+    assert r.counter("x_total", labelnames=("tenant",)) is c1
+    with pytest.raises(ValueError):
+        r.gauge("x_total")                         # kind mismatch
+    with pytest.raises(ValueError):
+        r.counter("x_total", labelnames=("job",))  # label mismatch
+    with pytest.raises(ValueError):
+        r.counter("bad name")
+
+
+# ---------------------------------------------------------------------------
+# rendering, pinned by the golden file
+# ---------------------------------------------------------------------------
+
+def build_golden_registry() -> MetricRegistry:
+    r = MetricRegistry()
+    c = r.counter("sheepd_jobs_submitted_total",
+                  "jobs accepted at the protocol boundary", ("tenant",))
+    c.inc(tenant="alice")
+    c.inc(2, tenant="bob")
+    g = r.gauge("sheepd_queue_depth", "jobs waiting for headroom")
+    g.set(3)
+    h = r.histogram("sheepd_request_latency_seconds",
+                    "queued->done request latency (the SLO series)",
+                    ("tenant",), buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05, tenant="alice")
+    h.observe(1.0, tenant="alice")   # boundary: the le="1" bucket
+    h.observe(25.0, tenant="alice")  # +Inf
+    r.add_collector(lambda: {"sheepd_uptime_seconds": 42})
+    r.add_collector(lambda: [("sheepd_job_steps",
+                              {"job": "j1", "tenant": 'a"b'}, 7)])
+    return r
+
+
+def test_render_matches_golden_file():
+    """The exposition format is a WIRE contract (scrapers, the future
+    replica router): any drift must be a deliberate golden update."""
+    got = build_golden_registry().render()
+    with open(GOLDEN) as f:
+        want = f.read()
+    assert got == want, (
+        "Prometheus rendering drifted from tests/golden/"
+        "metrics_prom.txt — if intentional, regenerate the golden "
+        "file from build_golden_registry()")
+
+
+def test_parse_prometheus_roundtrip_with_escaped_labels():
+    parsed = parse_prometheus(build_golden_registry().render())
+    assert parsed["sheepd_jobs_submitted_total"] == [
+        ({"tenant": "alice"}, 1.0), ({"tenant": "bob"}, 2.0)]
+    assert ({"le": "+Inf", "tenant": "alice"}, 3.0) in \
+        parsed["sheepd_request_latency_seconds_bucket"]
+    # escaped quote survives the round trip
+    (labels, v), = parsed["sheepd_job_steps"]
+    assert labels == {"job": "j1", "tenant": 'a"b'} and v == 7.0
+    # quantile straight from parsed bucket samples (the sheeptop path)
+    q = histogram_series_quantile(
+        parsed["sheepd_request_latency_seconds_bucket"], 0.5,
+        {"tenant": "alice"})
+    assert 0.1 <= q <= 10.0
+
+
+def test_parse_unescapes_backslash_before_n_correctly():
+    """Regression: a label holding a literal backslash followed by
+    'n' must survive the render->parse round trip (chained .replace
+    unescaping ate half the escaped backslash and fabricated a
+    newline)."""
+    r = MetricRegistry()
+    r.counter("c_total", labelnames=("tenant",)).inc(
+        tenant="ops\\nightly")
+    (labels, v), = parse_prometheus(r.render())["c_total"]
+    assert labels == {"tenant": "ops\\nightly"} and v == 1.0
+    r2 = MetricRegistry()
+    r2.counter("d_total", labelnames=("t",)).inc(t="a\nb")
+    (labels2, _), = parse_prometheus(r2.render())["d_total"]
+    assert labels2 == {"t": "a\nb"}
+
+
+def test_collector_failure_does_not_kill_the_scrape():
+    r = MetricRegistry()
+    r.gauge("ok").set(1)
+    r.add_collector(lambda: 1 / 0)
+    r.add_collector(lambda: {"fine": 2, "skipped": "not-a-number"})
+    text = r.render()
+    assert "ok 1" in text and "fine 2" in text
+    assert "skipped" not in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_rings_bounded_and_routed():
+    fr = FlightRecorder(per_job=3, max_jobs=2, global_events=4)
+    for i in range(5):
+        fr.record("e", {"job": "j1", "i": i})
+    evs = fr.events("j1")
+    assert [e["i"] for e in evs] == [2, 3, 4]  # last 3 only
+    fr.record("g", {})                          # global ring
+    assert fr.events()[-1]["ev"] == "g"
+    # a third job ring evicts the oldest wholesale
+    fr.record("e", {"job": "j2"})
+    fr.record("e", {"job": "j3"})
+    assert fr.jobs() == ["j2", "j3"]
+    fr.forget("j2")
+    assert fr.jobs() == ["j3"]
+
+
+def test_flight_recorder_thread_context_routes_unlabeled_events():
+    fr = FlightRecorder()
+    with fr.job_context("j9"):
+        fr.record("engine_event", {"detail": 1})
+    fr.record("after", {})
+    assert [e["ev"] for e in fr.events("j9")] == ["engine_event"]
+    assert [e["ev"] for e in fr.events()] == ["after"]
+
+
+def test_fault_event_triggers_dump_into_trace():
+    """Recording a fault_inject/chaos_inject event dumps the owning
+    ring to the active tracer immediately — the ring's tail AT the
+    moment of injection is preserved even if retries later succeed."""
+    buf = io.StringIO()
+    fr = FlightRecorder()
+    obs.install_flight(fr)
+    try:
+        with obs.tracing(buf):
+            obs.event("retry", job="j1", fault_class="resource")
+            obs.event("fault_inject", job="j1", kind="oom",
+                      phase="dispatch")
+    finally:
+        obs.uninstall_flight()
+    dumps = [json.loads(line) for line in buf.getvalue().splitlines()
+             if '"flight_dump"' in line]
+    assert len(dumps) == 1 and dumps[0]["job"] == "j1"
+    assert "fault_inject" in dumps[0]["reason"]
+    kinds = [e["ev"] for e in dumps[0]["events"]]
+    assert kinds == ["retry", "fault_inject"]
+    assert fr.dumps == 1
+
+
+def test_prefetch_worker_inherits_flight_job_context():
+    """Regression: events emitted on a prefetch WORKER thread (read
+    faults/retries while pre-reading a served job's chunks) must land
+    in the ring of the job whose step created the prefetcher —
+    thread-locals don't cross threads, so the worker re-enters the
+    creating thread's context explicitly."""
+    from sheep_tpu.utils.prefetch import prefetch
+
+    fr = FlightRecorder()
+    obs.install_flight(fr)
+    try:
+        def reader():
+            obs.event("retry", fault_class="transient", kind="read")
+            yield 1
+
+        with fr.job_context("j7"):
+            pf = prefetch(reader(), depth=1)
+        assert next(pf) == 1
+        pf.close()
+        assert [e["ev"] for e in fr.events("j7")] == ["retry"]
+        assert fr.events() == []
+    finally:
+        obs.uninstall_flight()
+
+
+def test_dump_never_records_itself():
+    fr = FlightRecorder()
+    obs.install_flight(fr)
+    try:
+        fr.record("a", {"job": "j1"})
+        fr.dump("j1", reason="manual")   # untraced: stderr fallback
+        assert [e["ev"] for e in fr.events("j1")] == ["a"]
+    finally:
+        obs.uninstall_flight()
+
+
+def test_dump_all_sweeps_global_and_job_rings():
+    buf = io.StringIO()
+    fr = FlightRecorder()
+    fr.record("g", {})
+    fr.record("x", {"job": "j1"})
+    with obs.tracing(buf):
+        assert fr.dump_all(reason="shutdown") == 2
+    jobs = sorted(json.loads(line)["job"]
+                  for line in buf.getvalue().splitlines()
+                  if '"flight_dump"' in line)
+    assert jobs == ["_daemon", "j1"]
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead
+# ---------------------------------------------------------------------------
+
+def test_disabled_and_flight_only_paths_are_cheap():
+    """obs.event with NOTHING installed is two global reads; with only
+    the flight recorder it is one dict build + one deque append. The
+    bounds are deliberately loose (shared CI boxes) — they catch a
+    path that accidentally grew I/O or locks-per-call, not scheduler
+    jitter."""
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        obs.event("tick", i=i)
+    disabled_s = time.perf_counter() - t0
+    assert disabled_s < 0.5, f"disabled obs.event path: {disabled_s}s"
+
+    obs.install_flight(FlightRecorder())
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            obs.event("tick", i=i)
+        flight_s = time.perf_counter() - t0
+    finally:
+        obs.uninstall_flight()
+    assert flight_s < 2.0, f"flight-recorder path: {flight_s}s"
+
+
+# ---------------------------------------------------------------------------
+# sheeptop rendering (pure string assembly — no daemon needed)
+# ---------------------------------------------------------------------------
+
+def test_sheeptop_render_lines_from_model():
+    from sheep_tpu.server import sheeptop
+
+    text = build_golden_registry().render() + (
+        "sheepd_active_jobs 1\nsheepd_reserved_bytes 1048576\n"
+        "sheepd_budget_bytes 4194304\nsheepd_flight_dumps 0\n"
+        "sheepd_uptime_seconds 42\n")
+    model = {"metrics": metrics_mod.parse_prometheus(text),
+             "jobs": [{"job_id": "j1", "tenant": "alice",
+                       "state": "running", "phase": "build",
+                       "steps": 12, "start_t": 100.0}],
+             "t": 110.0}
+    lines = sheeptop.render_lines(model)
+    joined = "\n".join(lines)
+    assert "queue=3" in joined and "active=1" in joined
+    assert "1.0MiB/4.0MiB" in joined
+    assert "alice" in joined and "p99" in joined
+    assert "build" in joined and "10.0s" in joined
+    rows = sheeptop.tenant_slo_rows(model["metrics"])
+    assert rows and rows[0]["tenant"] == "alice" \
+        and rows[0]["requests"] == 3

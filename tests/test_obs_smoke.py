@@ -14,7 +14,7 @@ def test_obs_smoke_script(tmp_path):
     r = subprocess.run(
         ["bash", os.path.join(REPO, "tools", "obs_smoke.sh"),
          str(tmp_path)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=480)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "obs smoke OK" in r.stdout
     report = open(tmp_path / "report.txt").read()

@@ -325,6 +325,199 @@ def test_submit_unopenable_input_is_answered_not_enqueued():
         assert sched.stats()["jobs"]["submitted"] == 0
 
 
+# ---------------------------------------------------------------------------
+# live telemetry plane (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_metrics_expose_per_tenant_latency_under_two_jobs():
+    """Acceptance: with two concurrent tenant jobs served, the
+    scheduler's Prometheus rendering carries per-tenant request-latency
+    histograms, live queue/reservation gauges, and the submitted/
+    terminal counters — the series a replica router would route on."""
+    from sheep_tpu.obs.metrics import parse_prometheus
+
+    with running_scheduler() as sched:
+        ja = sched.submit(spec(INPUT_A, tenant="alice"))
+        jb = sched.submit(spec(INPUT_B, tenant="bob"))
+        ja = sched.wait(ja.id, timeout_s=240)
+        jb = sched.wait(jb.id, timeout_s=240)
+        assert ja.state == "done" and jb.state == "done"
+        assert ja.start_t < jb.end_t and jb.start_t < ja.end_t
+        parsed = parse_prometheus(sched.render_metrics())
+    counts = dict()
+    for labels, v in parsed["sheepd_request_latency_seconds_count"]:
+        counts[labels["tenant"]] = v
+    assert counts == {"alice": 1.0, "bob": 1.0}
+    assert ({"le": "+Inf", "tenant": "alice"}, 1.0) in \
+        parsed["sheepd_request_latency_seconds_bucket"]
+    assert parsed["sheepd_queue_depth"][0][1] == 0.0
+    assert parsed["sheepd_active_jobs"][0][1] == 0.0
+    submitted = {lb["tenant"]: v
+                 for lb, v in parsed["sheepd_jobs_submitted_total"]}
+    assert submitted == {"alice": 1.0, "bob": 1.0}
+    done = {(lb["tenant"], lb["state"]): v
+            for lb, v in parsed["sheepd_jobs_terminal_total"]}
+    assert done[("alice", "done")] == 1.0
+    # queue-wait observed for both admissions
+    qw = {lb["tenant"]: v
+          for lb, v in parsed["sheepd_queue_wait_seconds_count"]}
+    assert qw == {"alice": 1.0, "bob": 1.0}
+    # live progress surfaced while running: phase/steps on descriptors
+    assert ja.phase == "score" and ja.steps > 0
+    assert ja.descriptor()["phase"] == "score"
+
+
+def test_active_job_progress_gauges_live_mid_build():
+    """Mid-build scrape shows the per-active-job progress gauges and a
+    nonzero active count; the gauges leave the scrape once the job is
+    terminal (no frozen series)."""
+    from sheep_tpu.obs.metrics import parse_prometheus
+
+    with running_scheduler() as sched:
+        job = sched.submit(JobSpec.from_request(
+            {"input": "rmat:12:8:3", "k": [4], "chunk_edges": 256},
+            tenant="alice"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sched.get(job.id).steps > 0:
+                break
+            time.sleep(0.01)
+        parsed = parse_prometheus(sched.render_metrics())
+        assert parsed["sheepd_active_jobs"][0][1] >= 1.0
+        rows = parsed.get("sheepd_job_steps", [])
+        assert any(lb == {"job": job.id, "tenant": "alice"} and v >= 1
+                   for lb, v in rows), rows
+        job = sched.wait(job.id, timeout_s=240)
+        assert job.state == "done"
+        parsed = parse_prometheus(sched.render_metrics())
+        assert not parsed.get("sheepd_job_steps")
+
+
+def test_failed_job_leaves_flight_dump_with_fault_event(tmp_path,
+                                                        monkeypatch):
+    """Acceptance: a job failed by an injected fault leaves a
+    flight-recorder dump in the trace containing the fault event —
+    and trace_report --last-errors renders it."""
+    from sheep_tpu import obs
+    from sheep_tpu.utils import fault
+
+    trace = tmp_path / "served.jsonl"
+    monkeypatch.setenv("SHEEP_FAULT_INJECT", "oom@dispatch:1:99")
+    monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("SHEEP_RETRY_MAX", "2")
+    fault.reset()
+    try:
+        with obs.tracing(str(trace)):
+            with running_scheduler() as sched:
+                doomed = serve_one(sched, spec(tenant="doomed"))
+                assert doomed.state == "failed"
+    finally:
+        monkeypatch.delenv("SHEEP_FAULT_INJECT")
+        fault.reset()
+    dumps = [json.loads(line) for line in
+             trace.read_text().splitlines()
+             if '"flight_dump"' in line]
+    failed = [d for d in dumps if d["job"] == doomed.id
+              and d["reason"].startswith("job_failed")]
+    assert failed, [d.get("reason") for d in dumps]
+    kinds = [e["ev"] for e in failed[-1]["events"]]
+    assert "fault_inject" in kinds and "retry" in kinds
+    assert "job_done" in kinds  # the terminal event made the ring
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"),
+         str(trace), "--last-errors", "6"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    assert "job_failed" in r.stdout and "fault_inject" in r.stdout
+
+
+def test_daemon_metrics_verb_http_scrape_and_profile(tmp_path):
+    """The daemon end of the tentpole, in-process: the `metrics` verb
+    and HTTP GET /metrics answer the same exposition, and the
+    `profile` verb captures the next K dispatch steps into the
+    requested directory."""
+    import urllib.request
+
+    from sheep_tpu.server.client import SheepClient, ServerError
+    from sheep_tpu.server.daemon import Daemon, build_parser
+
+    sock = str(tmp_path / "d.sock")
+    prof_dir = str(tmp_path / "prof")
+    args = build_parser().parse_args(
+        ["--socket", sock, "--metrics-port", "0"])
+    d = Daemon(args)
+    t = threading.Thread(target=d.serve, daemon=True,
+                         name="test-sheepd")
+    t.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(sock) and d.metrics_port:
+            break
+        time.sleep(0.05)
+    assert os.path.exists(sock), "daemon never bound its socket"
+    try:
+        with SheepClient(sock) as c:
+            prof = c.profile(prof_dir, steps=2)
+            assert prof["state"] == "armed"
+            with pytest.raises(ServerError, match="already"):
+                c.profile(prof_dir, steps=2)
+            jid = c.submit(INPUT_A, k=4, tenant="alice",
+                           chunk_edges=CHUNK)["job_id"]
+            job = c.wait(jid, timeout_s=240)
+            assert job["state"] == "done"
+            verb_text = c.metrics()
+            http_text = urllib.request.urlopen(
+                f"http://127.0.0.1:{d.metrics_port}/metrics",
+                timeout=10).read().decode()
+            for text in (verb_text, http_text):
+                assert 'sheepd_request_latency_seconds_count' \
+                       '{tenant="alice"} 1' in text
+                assert "sheepd_queue_depth" in text
+            assert c.stats()["profile"]["state"] == "done"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{d.metrics_port}/nope",
+                    timeout=10)
+            c.shutdown()
+    finally:
+        t.join(timeout=60)
+    assert not t.is_alive(), "daemon failed to shut down"
+    captured = [f for _, _, fs in os.walk(prof_dir) for f in fs]
+    assert captured, "profile verb captured nothing into the dir"
+
+
+def test_profile_arm_validation():
+    with running_scheduler() as sched:
+        with pytest.raises(ProtocolError):
+            sched.arm_profile("/tmp/x", steps=0)
+        with pytest.raises(ProtocolError):
+            sched.arm_profile("/tmp/x", steps="nope")
+
+
+def test_profile_capture_stops_when_jobs_drain(tmp_path):
+    """Regression: a capture armed for more steps than the job set
+    will ever take must STOP when the daemon goes idle (an open
+    jax.profiler capture grows host memory forever and blocks every
+    re-arm) — and the next arm succeeds."""
+    with running_scheduler() as sched:
+        sched.arm_profile(str(tmp_path / "p1"), steps=10_000)
+        job = serve_one(sched, spec())
+        assert job.state == "done"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            prof = sched.stats()["profile"]
+            if prof and prof.get("state") in ("aborted", "done",
+                                              "error"):
+                break
+            time.sleep(0.05)
+        assert prof["state"] == "aborted", prof
+        assert prof["steps_captured"] >= 1
+        assert "remaining" not in prof  # internals stay internal
+        # the slot is free again
+        assert sched.arm_profile(str(tmp_path / "p2"),
+                                 steps=5)["state"] == "armed"
+
+
 @pytest.mark.slow
 def test_served_soak_tool():
     """The full daemon-subprocess mini-soak: one oom + one read leg

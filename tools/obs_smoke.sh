@@ -195,4 +195,148 @@ grep -q "tenant bob:" "$OUT/report_served.txt"
 grep -q "state=cancelled" "$OUT/report_served.txt" # the mid-flight cancel
 grep -q "jit_compiles=0" "$OUT/report_served.txt"  # warm program reuse
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6"
+# seventh leg: the live telemetry plane (ISSUE 11) — sheepd with
+# --metrics-port under an admission budget sized so the second job
+# QUEUES: mid-build the HTTP scrape must show a non-zero queue-depth
+# gauge and live per-job progress gauges; after both jobs finish, the
+# per-tenant request-latency histogram series; one on-demand `profile`
+# capture must land files in the requested directory; and a
+# `sheep-submit --watch` submission must render live progress lines.
+# Part B: a fault-storm daemon whose job FAILS must leave a
+# flight-recorder dump in the trace, rendered by --last-errors.
+# Finally sheeplint stays at zero over sheep_tpu + tools (the new
+# telemetry modules included).
+TRACE7="$OUT/trace_telemetry.jsonl"
+SOCK7="$OUT/sheepd_tele.sock"
+PROF7="$OUT/profile_capture"
+rm -f "$TRACE7" "$SOCK7"
+rm -rf "$PROF7"
+# budget: 1.1x the BIG job's modeled footprint at dispatch_batch=1 —
+# the big job reserves almost all of it, so the small job (~25% of the
+# big one's model) queues behind the reservation until release
+BUDGET7=$(JAX_PLATFORMS=cpu python -c \
+    "from sheep_tpu.utils import membudget; \
+     print(int(1.1 * membudget.build_phase_bytes( \
+         1 << 12, 512, dispatch_batch=1)['total_bytes']))")
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK7" --trace "$TRACE7" --heartbeat-secs 0.2 \
+    --metrics-port 0 --budget-bytes "$BUDGET7" \
+    2> "$OUT/sheepd_tele.err" &
+SHEEPD7_PID=$!
+SHEEPD7B_PID=""
+# any failure below must not leak a resident daemon holding the
+# harness's pipes open (a leaked sheepd turns one failed assert into
+# a hung CI job)
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do [ -S "$SOCK7" ] && break; sleep 0.2; done
+[ -S "$SOCK7" ] || { echo "telemetry sheepd never bound $SOCK7" >&2; exit 1; }
+MPORT7=$(grep -oE 'metrics on http://[^/]+' "$OUT/sheepd_tele.err" \
+    | grep -oE '[0-9]+$')
+[ -n "$MPORT7" ] || { echo "no metrics port in sheepd stderr" >&2; exit 1; }
+if ! JAX_PLATFORMS=cpu python - "$SOCK7" "$MPORT7" "$PROF7" \
+        > "$OUT/telemetry.json" 2> "$OUT/telemetry.err" <<'PYEOF'
+import json
+import sys
+import time
+import urllib.request
+
+from sheep_tpu.obs.metrics import parse_prometheus
+from sheep_tpu.server.client import SheepClient
+
+sock, port, prof_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+
+
+def scrape():
+    url = f"http://127.0.0.1:{port}/metrics"
+    return parse_prometheus(
+        urllib.request.urlopen(url, timeout=10).read().decode())
+
+
+with SheepClient(sock) as c:
+    # big job fills the budget; small job must queue behind it
+    a = c.submit("rmat:12:8:3", k=4, tenant="alice", chunk_edges=512,
+                 dispatch_batch=1)
+    b = c.submit("rmat:10:8:2", k=4, tenant="bob", chunk_edges=512,
+                 dispatch_batch=1)
+    for _ in range(500):
+        st = c.status(a["job_id"])
+        if st["state"] == "running" and st["steps"]:
+            break
+        time.sleep(0.01)
+    mid = scrape()
+    assert mid["sheepd_queue_depth"][0][1] >= 1, \
+        f"queued job not visible: {mid.get('sheepd_queue_depth')}"
+    assert any(lb.get("job") == a["job_id"] and v >= 1
+               for lb, v in mid.get("sheepd_job_steps", [])), \
+        "no live per-job progress gauge mid-build"
+    prof = c.profile(prof_dir, steps=2)
+    assert prof["state"] == "armed", prof
+    ja = c.wait(a["job_id"], timeout_s=240)
+    jb = c.wait(b["job_id"], timeout_s=240)
+    assert ja["state"] == "done" and jb["state"] == "done", (ja, jb)
+    done = scrape()
+    lat = {lb["tenant"]: v for lb, v in
+           done.get("sheepd_request_latency_seconds_count", [])}
+    assert lat.get("alice") == 1 and lat.get("bob") == 1, lat
+    # the metrics VERB answers the same families as the HTTP scrape
+    verb = parse_prometheus(c.metrics())
+    assert "sheepd_request_latency_seconds_bucket" in verb
+    assert c.stats()["profile"]["state"] == "done"
+    print(json.dumps({"mid_queue_depth": mid["sheepd_queue_depth"][0][1],
+                      "latency_counts": lat}))
+PYEOF
+then
+    echo "telemetry smoke client failed:" >&2
+    cat "$OUT/telemetry.err" >&2
+    kill "$SHEEPD7_PID" 2>/dev/null || true
+    exit 1
+fi
+# --watch renders live progress lines on stderr, descriptor on stdout
+# (small chunk/batch: the job must FIT the deliberately tiny budget)
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK7" --input rmat:10:8:1 --k 4 --tenant carol \
+    --chunk-edges 512 --dispatch-batch 1 \
+    --watch --poll 0.1 > "$OUT/watch.json" 2> "$OUT/watch.err"
+grep -qE "running|done" "$OUT/watch.err"
+python -c "import json,sys; d=json.load(open(sys.argv[1])); \
+    assert d['state']=='done', d" "$OUT/watch.json"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK7" --shutdown > /dev/null
+wait "$SHEEPD7_PID"
+[ -n "$(find "$PROF7" -type f 2>/dev/null)" ] || {
+    echo "profile capture left no files in $PROF7" >&2; exit 1; }
+python tools/trace_report.py "$TRACE7" --check > "$OUT/report_tele.txt"
+grep -q '"queue_depth"' "$TRACE7"   # heartbeat carries service pressure
+
+# part B: a failed job's flight-recorder dump, rendered by --last-errors
+TRACE7B="$OUT/trace_flight.jsonl"
+SOCK7B="$OUT/sheepd_flight.sock"
+rm -f "$TRACE7B" "$SOCK7B"
+JAX_PLATFORMS=cpu SHEEP_FAULT_INJECT=oom@dispatch:1:99 \
+    SHEEP_RETRY_MAX=2 SHEEP_RETRY_BASE_S=0.01 \
+    python -m sheep_tpu.server.daemon \
+    --socket "$SOCK7B" --trace "$TRACE7B" --heartbeat-secs 0.2 \
+    2> "$OUT/sheepd_flight.err" &
+SHEEPD7B_PID=$!
+for _ in $(seq 1 100); do [ -S "$SOCK7B" ] && break; sleep 0.2; done
+[ -S "$SOCK7B" ] || { echo "flight sheepd never bound $SOCK7B" >&2; exit 1; }
+if JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK7B" --input rmat:10:8:1 --k 4 --tenant doomed \
+    --wait > "$OUT/flight_job.json" 2>&1; then
+    echo "fault-storm served job unexpectedly succeeded" >&2
+    kill "$SHEEPD7B_PID" 2>/dev/null || true
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK7B" --shutdown > /dev/null
+wait "$SHEEPD7B_PID"
+grep -q '"event": "flight_dump"' "$TRACE7B"
+python tools/trace_report.py "$TRACE7B" --last-errors 8 \
+    > "$OUT/report_flight.txt"
+grep -q "job_failed" "$OUT/report_flight.txt"
+grep -q "fault_inject" "$OUT/report_flight.txt"
+
+# and the static gate stays at zero with the new telemetry modules in
+python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
+
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7"

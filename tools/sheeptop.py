@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Repo-checkout shim for sheeptop (the installed console script maps
+to the same entry point): a live console view over a running sheepd —
+per-job progress, per-tenant latency percentiles, daemon headroom.
+
+    python tools/sheeptop.py --server /run/sheepd.sock [--once|--plain]
+
+Implementation lives in sheep_tpu/server/sheeptop.py (importable =
+unit-testable; this file exists so every tool is runnable straight
+from a checkout like the rest of tools/).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheep_tpu.server.sheeptop import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@
 per-span self/total-time tree with counter deltas.
 
     python tools/trace_report.py out.jsonl [--check] [--json]
+    python tools/trace_report.py out.jsonl --last-errors [N]
     python tools/trace_report.py run_a.jsonl run_b.jsonl   # + attribution
 
 One trace: manifest summary, the span tree (spans with the same name
@@ -12,7 +13,10 @@ deltas net of children, heartbeat summary, final counters, scores.
 Spans that STARTED but never ENDED are flagged ``UNCLOSED`` — the
 signature of a run that died mid-flight (the round-5 s30 soak's
 failure mode), with the elapsed time from span start to the last
-record in the file as the lower-bound duration.
+record in the file as the lower-bound duration. ``--last-errors``
+renders the flight-recorder dumps (ISSUE 11) beside them: the final N
+buffered events before each failed job / fault injection / daemon
+shutdown, captured even when full tracing was off.
 
 Two traces: additionally solves the count x round-cost dispatch
 attribution (sheep_tpu.utils.metrics.solve_dispatch_attribution) from
@@ -171,6 +175,12 @@ def parse_trace(path: str) -> dict:
         "job_spans": [e for e in events
                       if e.get("event") == "span_end"
                       and str(e.get("span", "")).startswith("job:")],
+        # flight-recorder dumps (ISSUE 11): each carries the last N
+        # buffered events around a job failure / fault injection /
+        # daemon shutdown — the untraced-path forensics rendered by
+        # --last-errors next to the UNCLOSED-span flags
+        "flight_dumps": [e for e in events
+                         if e.get("event") == "flight_dump"],
     }
 
 
@@ -357,6 +367,11 @@ def print_report(rep: dict, out) -> None:
                   f"in this file)\n")
     for r in parsed["degraded"]:
         out.write(f"checkpoint degraded: {r.get('message')}\n")
+    for d in parsed["flight_dumps"]:
+        out.write(f"flight dump: job={d.get('job')} "
+                  f"reason={d.get('reason')} "
+                  f"events={d.get('n_events', len(d.get('events') or []))}"
+                  f"  (render with --last-errors)\n")
     if parsed["job_spans"]:
         for e in parsed["job_spans"]:
             bits = [f"{k}={e[k]}" for k in
@@ -381,6 +396,43 @@ def print_report(rep: dict, out) -> None:
         out.write(f"warning: {p}\n")
 
 
+def _fmt_flight_event(e: dict, t0: float) -> str:
+    bits = [f"+{max(0.0, e.get('t', t0) - t0):7.3f}s",
+            str(e.get("ev", "?"))]
+    for k, v in e.items():
+        if k in ("t", "ev", "events"):
+            continue
+        bits.append(f"{k}={str(v)[:80]}")
+    return " ".join(bits)
+
+
+def print_last_errors(reports: list, n: int, out) -> int:
+    """--last-errors: for every flight dump, render the final N
+    buffered events (fault/retry/span trail) before the failure —
+    the 'what were its last moments' question answered without full
+    tracing. Returns how many dumps were rendered."""
+    shown = 0
+    for rep in reports:
+        dumps = rep["parsed"]["flight_dumps"]
+        if not dumps:
+            continue
+        out.write(f"last-errors [{rep['path']}]:\n")
+        for d in dumps:
+            evs = d.get("events") or []
+            tail = evs[-n:]
+            out.write(f"  {d.get('job')}  reason={d.get('reason')}  "
+                      f"({len(evs)} buffered, last {len(tail)}):\n")
+            t0 = tail[0].get("t", 0.0) if tail else 0.0
+            for e in tail:
+                out.write(f"    {_fmt_flight_event(e, t0)}\n")
+            shown += 1
+    if not shown:
+        out.write("no flight-recorder dumps in the trace(s) — nothing "
+                  "failed, nothing was injected, and no daemon shut "
+                  "down while holding buffered events\n")
+    return shown
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Render obs trace JSONL as a span tree; two traces "
@@ -394,6 +446,11 @@ def main(argv=None) -> int:
                          "complete span tree + >= 1 heartbeat")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ap.add_argument("--last-errors", type=int, nargs="?", const=8,
+                    default=None, metavar="N",
+                    help="render the final N (default 8) flight-"
+                         "recorder events buffered before each failed "
+                         "job / fault / shutdown dump")
     args = ap.parse_args(argv)
 
     reports = []
@@ -431,6 +488,7 @@ def main(argv=None) -> int:
                 "counters": rep["parsed"]["counters"],
                 "jobs": rep["parsed"]["job_spans"],
                 "tenants": tenant_costs(rep["parsed"]),
+                "flight_dumps": rep["parsed"]["flight_dumps"],
                 "check_failures": cf,
             })
         doc = {"traces": out}
@@ -438,6 +496,8 @@ def main(argv=None) -> int:
             doc["attribution"] = attribution
         json.dump(doc, sys.stdout, indent=1, default=str)
         print()
+    elif args.last_errors is not None:
+        print_last_errors(reports, args.last_errors, sys.stdout)
     else:
         for i, rep in enumerate(reports):
             if i:
