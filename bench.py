@@ -320,7 +320,9 @@ def measure(scale: int, platform: str) -> dict:
                       "dispatch_batch", "inflight_depth",
                       "inflight_discards", "dispatch_retries",
                       "degraded_dispatch_batch", "degraded_inflight",
-                      "device_loss_recoveries", "checkpoint_degraded")
+                      "degraded_h2d_ring", "device_loss_recoveries",
+                      "checkpoint_degraded", "h2d_staged_bytes",
+                      "device_stream_chunks", "h2d_ring_depth")
             if k in res_tpu.diagnostics}
     # fault-tolerance contract fields (ISSUE 9): ALWAYS emit
     # dispatch_retries so the regression gate can see 0 -> N movement
@@ -330,12 +332,17 @@ def measure(scale: int, platform: str) -> dict:
     if disp:
         log(f"dispatch counts (count x round-cost attribution): {disp}")
         out.update(disp)
-    # dispatch-overlap contract fields (ISSUE 4): host wall blocked in
-    # stats pulls + device idle between executions — the pair the
-    # in-flight pipeline exists to shrink, gated by bench_regress
-    # (host_blocked_ms is higher-is-worse like host_syncs)
+    # dispatch-overlap contract fields (ISSUE 4) + the ingest pair
+    # (ISSUE 12): host wall blocked in stats pulls, device idle between
+    # executions, and the H2D staging/underrun walls — the timed leg
+    # runs the device-stream path for its rmat-hash input, so
+    # h2d_blocked_ms/h2d_staged_bytes SHOULD be 0 there (zero host
+    # bytes per chunk); a file-backed capture reports the ring's
+    # numbers instead. h2d_blocked_ms is gated lower-is-better by
+    # bench_regress like host_blocked_ms.
     overlap = {k: round(float(res_tpu.diagnostics[k]), 1)
-               for k in ("host_blocked_ms", "device_gap_ms")
+               for k in ("host_blocked_ms", "device_gap_ms",
+                         "h2d_staged_ms", "h2d_blocked_ms")
                if k in res_tpu.diagnostics}
     if overlap:
         log(f"dispatch overlap: {overlap}")
@@ -510,8 +517,11 @@ def main():
     for f in ("rtt_ms", "h2d_mbs", "d2h_mbs", "r_colo_est", "host_syncs",
               "device_rounds", "dispatch_batch", "inflight_depth",
               "inflight_discards", "host_blocked_ms", "device_gap_ms",
+              "h2d_staged_ms", "h2d_blocked_ms", "h2d_staged_bytes",
+              "h2d_ring_depth", "device_stream_chunks",
               "dispatch_retries", "degraded_dispatch_batch",
-              "degraded_inflight", "device_loss_recoveries",
+              "degraded_inflight", "degraded_h2d_ring",
+              "device_loss_recoveries",
               "checkpoint_degraded", "warm_up_s", "cold_request_s",
               "warm_request_s"):
         if f in result:

@@ -36,6 +36,11 @@ RULES = {
                 "close, span begun without an end, counters mutated "
                 "outside a CounterRegistry",
     "lock": "thread-shared attribute written outside the owning lock",
+    "h2d": "blocking host->device staging (jnp.asarray/jnp.array/"
+           "jax.device_put of a host value) inside a loop — the "
+           "per-chunk hot-path shape the staged H2D ring exists to "
+           "replace; stage through utils/prefetch.H2DRing or annotate "
+           "a designed window with '# sheeplint: h2d-ok'",
 }
 
 SEVERITY_RANK = {"error": 2, "warning": 1}
@@ -60,7 +65,9 @@ class Finding:
         return (self.rule, self.path, self.line)
 
 
-_PRAGMA_RE = re.compile(r"#\s*sheeplint:\s*([a-z-]+(?:\s*,\s*[a-z-]+)*)")
+# rule ids may carry digits (h2d), so the token class is [a-z0-9-]
+_PRAGMA_RE = re.compile(
+    r"#\s*sheeplint:\s*([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*)")
 
 
 def pragma_lines(source: str) -> dict:

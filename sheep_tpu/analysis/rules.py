@@ -1,4 +1,4 @@
-"""The five sheeplint rule classes (ISSUE 6).
+"""The sheeplint rule classes (ISSUE 6; ``h2d`` added by ISSUE 12).
 
 Each rule is an AST pass over one file, sharing the cross-file
 :class:`~sheep_tpu.analysis.index.PackageIndex`. The analyses are
@@ -40,6 +40,10 @@ Rules:
   ``threading.Lock``, attributes written under the lock somewhere must
   be written under it everywhere (the MetricsWriter/heartbeat
   precedent).
+- **h2d** — blocking host->device staging on per-chunk hot paths:
+  ``jnp.asarray``/``jnp.array``/``jax.device_put`` of a host value
+  inside a loop (the synchronous-upload shape the staged H2D ring
+  removed, ISSUE 12); designed windows carry ``# sheeplint: h2d-ok``.
 """
 
 from __future__ import annotations
@@ -637,6 +641,71 @@ def check_resources(ctx: RuleContext) -> None:
 
 
 # ---------------------------------------------------------------------------
+# h2d staging (ISSUE 12): blocking host->device uploads on per-chunk
+# hot paths
+# ---------------------------------------------------------------------------
+
+class _H2DStaging(ast.NodeVisitor):
+    """Flag ``jnp.asarray``/``jnp.array``/``jax.device_put`` calls
+    lexically inside a ``for``/``while`` loop — the per-chunk hot-path
+    shape whose synchronous H2D transfer the staged ring
+    (utils/prefetch.H2DRing) replaced, and the regression class this
+    rule keeps from creeping back. Device-valued arguments move no host
+    bytes (a jnp call on a jnp/lax result is the *sync* rule's domain,
+    not this one's), so the obvious ones are skipped; the designated
+    windows — the ring's own issue point, per-attempt resume uploads,
+    measurement probes — carry ``# sheeplint: h2d-ok``, the same
+    reviewed-whitelist convention as ``sync-ok``."""
+
+    def __init__(self, ctx: RuleContext):
+        self.ctx = ctx
+        self.loop_depth = 0
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def _def(self, node):
+        # a nested function's body does not execute per iteration of
+        # the enclosing loop; it gets its own scan at depth 0
+        depth, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = depth
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _def
+
+    @staticmethod
+    def _device_valued(arg) -> bool:
+        return isinstance(arg, ast.Call) and _root(arg.func) in DEVICE_MODULES
+
+    def visit_Call(self, node):
+        if self.loop_depth > 0:
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                root = _root(fn)
+                h2d = (fn.attr in ("asarray", "array") and root == "jnp") \
+                    or (fn.attr == "device_put" and root == "jax")
+                if h2d and node.args \
+                        and not self._device_valued(node.args[0]):
+                    self.ctx.add(
+                        "h2d", "error", node,
+                        f"{root}.{fn.attr}() inside a loop issues a "
+                        "host->device transfer on the hot path at the "
+                        "moment the value is needed — stage it ahead "
+                        "through utils/prefetch.H2DRing (or a device "
+                        "stream), or annotate a designed window with "
+                        "'# sheeplint: h2d-ok'")
+        self.generic_visit(node)
+
+
+def check_h2d(ctx: RuleContext) -> None:
+    _H2DStaging(ctx).visit(ctx.tree)
+
+
+# ---------------------------------------------------------------------------
 # lock discipline
 # ---------------------------------------------------------------------------
 
@@ -712,7 +781,7 @@ def check_locks(ctx: RuleContext) -> None:
 # ---------------------------------------------------------------------------
 
 ALL_CHECKS = (check_sync_donate, check_jit_hygiene, check_resources,
-              check_locks)
+              check_locks, check_h2d)
 
 
 def check_file(path: str, source: str, tree: ast.Module,

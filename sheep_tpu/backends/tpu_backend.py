@@ -28,13 +28,14 @@ import jax.numpy as jnp
 from sheep_tpu import obs
 from sheep_tpu.analysis import sanitize
 from sheep_tpu.backends.base import Partitioner, register
+from sheep_tpu.io.devicestream import is_device_stream, note_device_chunks
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
 from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
-from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
+from sheep_tpu.utils.prefetch import H2DRing, prefetch, prefetch_batched
 
 
 def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
@@ -76,41 +77,51 @@ class _ChunkCache:
         self.complete = False
 
 
-def _upload_chunks(stream, cs: int, n: int, start_chunk: int):
+def _upload_chunks(stream, cs: int, n: int, start_chunk: int,
+                   ring: int = 1, stats=None):
     """Padded (cs, 2) int32 DEVICE chunks from ``start_chunk`` on.
 
-    Streams with a ``device_chunk`` method (synthetic counter-based
-    generators, e.g. :class:`~sheep_tpu.io.generators.RmatHashStream`)
-    materialize each chunk directly in device memory — no host
-    generation, no host->device upload (measured 92 s of a 254 s
-    RMAT-22 bench through a degraded tunnel link). File/memory streams
-    take the host path: read + parse + pad of chunk i+1 overlaps the
-    device work on chunk i via :func:`prefetch`, and jnp.asarray issues
-    the (async) upload."""
-    dev = getattr(stream, "device_chunk", None)
-    if dev is not None:
+    Device streams (:mod:`sheep_tpu.io.devicestream` protocol —
+    counter-based generators like
+    :class:`~sheep_tpu.io.generators.RmatHashStream`) materialize each
+    chunk directly in device memory — no host generation, no
+    host->device upload, zero host bytes per chunk (measured 92 s of a
+    254 s RMAT-22 bench through a degraded tunnel link). File/memory
+    streams take the staged path: read + parse + pad of upcoming
+    chunks on the prefetch worker, with up to ``ring`` pre-padded
+    blocks' device_put transfers issued ahead of the dispatch chain
+    (:class:`~sheep_tpu.utils.prefetch.H2DRing`) — the synchronous
+    ``jnp.asarray`` this replaces serialized every transfer into the
+    dispatch critical path. ``stats`` collects the ingest counters
+    (``h2d_staged_ms`` / ``h2d_blocked_ms`` / ``h2d_staged_bytes`` /
+    ``device_stream_chunks``)."""
+    if is_device_stream(stream):
         for i in range(start_chunk, stream.num_device_chunks(cs)):
-            yield dev(i, cs, n)
+            note_device_chunks(stats)
+            yield stream.device_chunk(i, cs, n)
         return
     with prefetch(pad_chunk(c, cs, n)
-                  for c in stream.chunks(cs, start_chunk=start_chunk)) as pf:
+                  for c in stream.chunks(cs, start_chunk=start_chunk)) as pf, \
+            H2DRing(pf, depth=max(1, ring), stats=stats) as staged:
         # with-scope = the structural close the resource rule checks:
-        # a consumer abandoning this generator closes pf deterministically
-        for padded in pf:
-            yield jnp.asarray(padded)
+        # a consumer abandoning this generator closes the ring (its
+        # staged HBM drains) and pf deterministically
+        for dev in staged:
+            yield dev
 
 
-def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int):
+def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int,
+                   ring: int = 1, stats=None):
     """Yield padded (cs, 2) int32 chunks as DEVICE arrays, serving and
     filling ``cache`` when iterating from the stream head."""
     if cache is None or start_chunk != 0:
-        yield from _upload_chunks(stream, cs, n, start_chunk)
+        yield from _upload_chunks(stream, cs, n, start_chunk, ring, stats)
         return
     yield from cache.chunks
     if cache.complete:
         return
     grow = True
-    for d in _upload_chunks(stream, cs, n, len(cache.chunks)):
+    for d in _upload_chunks(stream, cs, n, len(cache.chunks), ring, stats):
         nb = int(d.size) * 4
         if grow and cache.used + nb <= cache.budget:
             cache.chunks.append(d)
@@ -161,7 +172,7 @@ def _device_hbm_bytes(purpose: str = "the chunk cache") -> int:
 
 def _chunk_cache_budget(n: int, chunk_edges: int,
                         dispatch_batch: int = 1, inflight: int = 1,
-                        donate: bool = False) -> int:
+                        donate: bool = False, h2d_ring: int = 0) -> int:
     """Bytes of HBM safely spendable on cached chunks: the device limit
     minus the build phase's modeled peak (including the batched
     dispatch's [N, C] staging blocks) and a safety margin.
@@ -180,21 +191,24 @@ def _chunk_cache_budget(n: int, chunk_edges: int,
     hbm = _device_hbm_bytes()
     reserve = build_phase_bytes(
         n, chunk_edges, dispatch_batch=dispatch_batch,
-        inflight=inflight, donate=donate)["total_bytes"] + (1 << 30)
+        inflight=inflight, donate=donate,
+        h2d_ring=h2d_ring)["total_bytes"] + (1 << 30)
     return max(0, int(0.9 * hbm) - reserve)
 
 
 def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int,
                            inflight: int = 1,
-                           donate: bool = False) -> int:
+                           donate: bool = False,
+                           h2d_ring: int = 0) -> int:
     """The one auto-sizing rule for ``dispatch_batch`` (shared by the
     single-device and sharded backends): explicit N passes through,
     0 (auto) resolves to per-segment on cpu-jax — host dispatch is
     cheap there and the adaptive driver's compaction/host-tail schedule
     wins — and otherwise to the largest N whose O(N*C) staging fits the
-    HBM model (utils/membudget.dispatch_batch_for). ``inflight`` and
-    ``donate`` thread the in-flight pipeline's D-deep staging and the
-    donation credit into that model."""
+    HBM model (utils/membudget.dispatch_batch_for). ``inflight``,
+    ``donate`` and ``h2d_ring`` thread the in-flight pipeline's D-deep
+    staging, the donation credit and the staged-ring blocks into that
+    model."""
     if dispatch_batch != 0:
         return max(1, int(dispatch_batch))
     if jax.default_backend() == "cpu":
@@ -205,7 +219,7 @@ def resolve_dispatch_batch(dispatch_batch: int, n: int, cs: int,
     from sheep_tpu.utils.membudget import dispatch_batch_for
 
     return dispatch_batch_for(int(0.9 * hbm), n, cs, inflight=inflight,
-                              donate=donate)
+                              donate=donate, h2d_ring=h2d_ring)
 
 
 def resolve_inflight(inflight: int) -> int:
@@ -220,36 +234,55 @@ def resolve_inflight(inflight: int) -> int:
     return 1 if jax.default_backend() == "cpu" else 2
 
 
+def resolve_h2d_ring(h2d_ring: int) -> int:
+    """Auto-sizing rule for the staged H2D ring depth (shared by the
+    tpu driver and the served engine): explicit D >= 1 passes through;
+    0 (auto) resolves to 2 on accelerators — the transfer of block i+2
+    is in flight while block i folds, so ``h2d_blocked_ms`` collapses
+    toward 0 the way ``device_gap_ms`` does at inflight >= 2 — and 1
+    on cpu-jax, where device_put is a host-memory copy with no link to
+    hide (depth 1 still stages one block ahead, and is bit-identical
+    at every depth). Device streams never stage, whatever this says."""
+    if h2d_ring != 0:
+        return max(1, int(h2d_ring))
+    return 1 if jax.default_backend() == "cpu" else 2
+
+
 def _device_chunk_groups(stream, cs: int, n: int, cache, start_chunk: int,
-                         batch: int):
+                         batch: int, ring: int = 1, stats=None):
     """Yield lists of up to ``batch`` padded (cs, 2) int32 DEVICE chunks
     — the staged groups of the batched segment dispatch.
 
     Host-format streams stage a FULL group of parsed + padded chunks on
-    the prefetch worker (:func:`prefetch_batched`) before the uploads
-    are issued, so all N host reads of the next batched program overlap
-    the current enlarged device execution; device-materializing
-    (``device_chunk``) and cache-served chunks group over the plain
-    per-chunk iterator (no host I/O to overlap, and the cache's
-    prefix-fill invariant stays in one place)."""
+    the prefetch worker (:func:`prefetch_batched`) and feed the whole
+    group through the staged H2D ring — the transfers for ``ring``
+    upcoming groups are in flight while the current enlarged device
+    execution runs, so neither the N host reads NOR the N uploads of
+    the next batched program sit in the dispatch chain;
+    device-synthesizing (:func:`is_device_stream`) and cache-served
+    chunks group over the plain per-chunk iterator (no host bytes to
+    stage, and the cache's prefix-fill invariant stays in one place)."""
     if batch <= 1:
-        for d in _device_chunks(stream, cs, n, cache, start_chunk):
+        for d in _device_chunks(stream, cs, n, cache, start_chunk,
+                                ring, stats):
             yield [d]
         return
-    if cache is None and getattr(stream, "device_chunk", None) is None:
+    if cache is None and not is_device_stream(stream):
         # with-exit is the deterministic worker cancel on abandonment
         # (the in-flight pipeline's discard/backstop paths close this
-        # generator mid-stream): drain + join instead of waiting for
-        # the GC
+        # generator mid-stream): drain + join — and drop the ring's
+        # staged HBM — instead of waiting for the GC
         with prefetch_batched(
                 (pad_chunk(c, cs, n)
                  for c in stream.chunks(cs, start_chunk=start_chunk)),
-                batch) as pf:
-            for host_group in pf:
-                yield [jnp.asarray(p) for p in host_group]
+                batch) as pf, \
+                H2DRing(pf, depth=max(1, ring), stats=stats) as staged:
+            for dev_group in staged:
+                yield list(dev_group)
         return
     group: list = []
-    for d in _device_chunks(stream, cs, n, cache, start_chunk):
+    for d in _device_chunks(stream, cs, n, cache, start_chunk,
+                            ring, stats):
         group.append(d)
         if len(group) == batch:
             yield group
@@ -273,7 +306,8 @@ class TpuBackend(Partitioner):
                  stale_reuse: int = 1,
                  dispatch_batch: int = 0,
                  inflight: int = 0,
-                 donate_buffers: Optional[bool] = None):
+                 donate_buffers: Optional[bool] = None,
+                 h2d_ring: int = 0):
         self.chunk_edges = chunk_edges
         self.lift_levels = lift_levels
         self.alpha = alpha
@@ -349,6 +383,16 @@ class TpuBackend(Partitioner):
         # whenever the batched/pipelined dispatch runs; results are
         # identical either way — donation is pure buffer aliasing)
         self.donate_buffers = donate_buffers
+        # staged H2D ring depth (utils/prefetch.H2DRing): keep up to D
+        # pre-padded host blocks' device_put transfers issued ahead of
+        # the dispatch chain so the upload of block i+D overlaps the
+        # fold of block i. 0 = auto (2 on accelerators, 1 on cpu-jax);
+        # bit-identical at every depth (the ring changes WHEN transfers
+        # are issued, never what bits arrive). Device streams
+        # (io/devicestream.py) skip staging entirely.
+        if h2d_ring < 0:
+            raise ValueError("h2d_ring must be >= 0 (0 = auto)")
+        self.h2d_ring = h2d_ring
         if dispatch_batch > 1 and (carry_tail or tail_overlap):
             raise ValueError("dispatch_batch > 1 folds whole segments on "
                              "device; it excludes the per-chunk tail "
@@ -368,12 +412,14 @@ class TpuBackend(Partitioner):
 
     def _resolve_dispatch_batch(self, n: int, cs: int,
                                 inflight: int = 1,
-                                donate: bool = False) -> int:
+                                donate: bool = False,
+                                h2d_ring: int = 0) -> int:
         if self.dispatch_batch == 0 and (self.carry_tail or
                                          self.tail_overlap):
             return 1  # auto defers to an explicit per-chunk tail strategy
         return resolve_dispatch_batch(self.dispatch_batch, n, cs,
-                                      inflight=inflight, donate=donate)
+                                      inflight=inflight, donate=donate,
+                                      h2d_ring=h2d_ring)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -412,9 +458,15 @@ class TpuBackend(Partitioner):
         else:
             deg_host = np.zeros(n, dtype=np.int64)
         inflight_n = self._resolve_inflight()
+        ring_n = resolve_h2d_ring(self.h2d_ring)
+        # the membudget model counts ring staging only for streams that
+        # actually stage — a device stream synthesizes in place and
+        # holds no pre-transferred blocks
+        ring_model = 0 if is_device_stream(stream) else ring_n
         donate = True if self.donate_buffers is None else self.donate_buffers
         batch_n = self._resolve_dispatch_batch(n, cs, inflight=inflight_n,
-                                               donate=donate)
+                                               donate=donate,
+                                               h2d_ring=ring_model)
         # the donating fold only runs on the pipelined/batched branch
         # (batch_n == 1 == inflight_n selects the adaptive per-segment
         # driver below); crediting donation to the HBM model on a path
@@ -423,9 +475,15 @@ class TpuBackend(Partitioner):
             donate = False
         cache_budget = _chunk_cache_budget(n, cs, dispatch_batch=batch_n,
                                            inflight=inflight_n,
-                                           donate=donate) \
+                                           donate=donate,
+                                           h2d_ring=ring_model) \
             if self.cache_chunks else 0
         cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
+        # ONE stats dict across all three streaming passes: the ingest
+        # counters (h2d_* / device_stream_chunks) accumulate wherever
+        # chunks cross (or don't cross) the link, and the build phase
+        # adds the dispatch counters to the same record
+        build_stats: dict = {}
         sp = obs.begin("degrees")
         obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         if from_phase == 0:
@@ -433,8 +491,10 @@ class TpuBackend(Partitioner):
             deg = degrees_ops.init_degrees(n)
             since_flush = 0
             idx = start
-            # read+parse+pad of chunk i+1 overlaps the device fold of i
-            for padded in _device_chunks(stream, cs, n, cache, start):
+            # read+parse+pad of chunk i+1 overlaps the device fold of i;
+            # the staged ring keeps its H2D transfer off the chain too
+            for padded in _device_chunks(stream, cs, n, cache, start,
+                                         ring_n, build_stats):
                 deg = degrees_ops.degree_chunk(deg, padded, n)
                 since_flush += 1
                 idx += 1
@@ -473,7 +533,6 @@ class TpuBackend(Partitioner):
         t0 = time.perf_counter()
         sp = obs.begin("build")
         obs.progress(phase="build", chunks_done=0, edges_done=0)
-        build_stats: dict = {}
         total_rounds = 0
         if state and from_phase >= 2:
             minp = jnp.asarray(state.arrays["minp"])
@@ -515,7 +574,7 @@ class TpuBackend(Partitioner):
                     snap["carry"] = (state.arrays["carry_lo"],
                                      state.arrays["carry_hi"])
             cfg = {"batch": batch_n, "inflight": inflight_n,
-                   "donate": donate}
+                   "donate": donate, "ring": ring_n}
 
             def _build_attempt():
                 nonlocal total_rounds
@@ -531,6 +590,7 @@ class TpuBackend(Partitioner):
                              jnp.asarray(snap["carry"][1]))
                 batch_n = cfg["batch"]
                 inflight_n = cfg["inflight"]
+                ring_n = cfg["ring"]
                 donate = cfg["donate"] and (batch_n > 1 or inflight_n > 1)
                 overlap = (bool(self.tail_overlap) and not carry_mode
                            and native_mod.available())
@@ -578,7 +638,8 @@ class TpuBackend(Partitioner):
                         build_stats["inflight_depth"] = inflight_n
                         groups = _device_chunk_groups(stream, cs, n,
                                                       cache, start,
-                                                      batch_n)
+                                                      batch_n, ring_n,
+                                                      build_stats)
 
                         def staged_groups():
                             sentinel_chunk = None
@@ -672,7 +733,8 @@ class TpuBackend(Partitioner):
                         stats_acc.absorb(build_stats)
                     else:
                         for padded in _device_chunks(stream, cs, n,
-                                                     cache, start):
+                                                     cache, start,
+                                                     ring_n, build_stats):
                             seg_sp = obs.begin("segment", i=idx)
                             try:
                                 if overlap:
@@ -769,9 +831,12 @@ class TpuBackend(Partitioner):
                     cache = None
                 nxt = retry_mod.degrade_dispatch(
                     n, cs, cfg["batch"], cfg["inflight"], cfg["donate"],
-                    build_stats, snap["idx"])
+                    build_stats, snap["idx"],
+                    h2d_ring=None if ring_model == 0 else cfg["ring"])
                 if nxt is not None:
-                    cfg["batch"], cfg["inflight"] = nxt
+                    cfg["batch"], cfg["inflight"] = nxt[0], nxt[1]
+                    if len(nxt) > 2:
+                        cfg["ring"] = nxt[2]
 
             def _save_snapshot():
                 if checkpointer is not None and snap["minp"] is not None:
@@ -800,6 +865,11 @@ class TpuBackend(Partitioner):
                         on_resource=_on_resource,
                         on_device_loss=_on_device_loss)
                     stats_acc.absorb(build_stats)
+            # an OOM-degraded ring depth carries forward to the score
+            # pass: it runs outside the retry harness, so re-staging at
+            # the pre-degrade depth on a device that just proved too
+            # small would re-OOM unrecovered
+            ring_n = cfg["ring"]
             minp = P[pos]
             # real completion barrier (see above)
             np.asarray(minp[:1])  # sheeplint: sync-ok
@@ -834,7 +904,8 @@ class TpuBackend(Partitioner):
             if comm_volume:
                 cv_chunks.append(state.arrays["cv_keys"])
         idx = start
-        for padded in _device_chunks(stream, cs, n, cache, start):
+        for padded in _device_chunks(stream, cs, n, cache, start,
+                                     ring_n, build_stats):
             c, tt = score_ops.score_chunk(padded, assign, n)
             # designed per-chunk score pull (two scalars, one chunk)
             cut += int(c)  # sheeplint: sync-ok

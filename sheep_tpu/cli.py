@@ -147,6 +147,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "accelerators, 1 on cpu-jax; 1 = synchronous "
                         "dispatch; the forest is bit-identical at every "
                         "depth). Excludes --carry-tail/--tail-overlap")
+    p.add_argument("--h2d-ring", type=int, default=None, metavar="D",
+                   help="tpu backend: staged host->device ring depth — "
+                        "keep up to D pre-padded chunk blocks' "
+                        "device_put transfers issued ahead of the "
+                        "dispatch chain, so the upload of block i+D "
+                        "overlaps the fold of block i (0 = auto: 2 on "
+                        "accelerators, 1 on cpu-jax; bit-identical at "
+                        "every depth). Device-generated synthetic "
+                        "streams (rmat-hash:/sbm-hash:) synthesize "
+                        "chunks in accelerator memory and skip staging "
+                        "entirely")
     p.add_argument("--lift-levels", type=int, default=None,
                    help="binary-lifting depth of the fixpoint climb "
                         "(0 = auto; tpu and tpu-bigv backends)")
@@ -443,6 +454,7 @@ def _run(parser, args) -> int:
             ("--stale-reuse", args.stale_reuse),
             ("--dispatch-batch", args.dispatch_batch),
             ("--inflight", args.inflight),
+            ("--h2d-ring", args.h2d_ring),
             ("--lift-levels", args.lift_levels),
             ("--jumps", args.jumps),
             ("--hoist-bytes", args.hoist_bytes),
@@ -654,6 +666,10 @@ def _run(parser, args) -> int:
                              "executions; it excludes --carry-tail/"
                              "--tail-overlap")
             ctor["inflight"] = args.inflight
+        if args.h2d_ring is not None:
+            if args.h2d_ring < 0:
+                parser.error("--h2d-ring must be >= 0 (0 = auto)")
+            ctor["h2d_ring"] = args.h2d_ring
         if args.lift_levels is not None:
             if args.lift_levels < 0:
                 parser.error("--lift-levels must be >= 0")

@@ -11,6 +11,8 @@ from functools import partial
 
 import numpy as np
 
+from sheep_tpu.io.devicestream import DeviceStream
+
 # Zachary karate club, 34 vertices / 78 undirected edges (0-indexed).
 # Standard public edge list (W. W. Zachary, 1977; same set shipped by
 # networkx as karate_club_graph).
@@ -384,13 +386,14 @@ class _CounterHashStream:
             np.ascontiguousarray(sample).tobytes()).hexdigest()
 
 
-class RmatHashStream(_CounterHashStream):
-    """Counter-based R-MAT stream (:func:`rmat_hash_range`), with a
-    DEVICE fast path: ``device_chunk(idx, cs, n)`` materializes the padded
-    chunk directly in accelerator memory (:func:`rmat_hash_chunk_device`),
+class RmatHashStream(DeviceStream, _CounterHashStream):
+    """Counter-based R-MAT stream (:func:`rmat_hash_range`), a
+    :class:`~sheep_tpu.io.devicestream.DeviceStream`:
+    ``device_chunk(idx, cs, n)`` materializes the padded chunk directly
+    in accelerator memory (:func:`rmat_hash_chunk_device`),
     bit-identical to the host chunks every other backend reads — so
-    cross-backend equality holds while the TPU path skips the
-    host->device upload entirely.
+    cross-backend equality holds while the device-recognizing drivers
+    skip host generation AND the host->device upload entirely.
     """
 
     def __init__(self, scale: int, edge_factor: int = 16, a: float = 0.57,
@@ -553,7 +556,7 @@ def _sbm_device_chunk_fn():
     return _SBM_DEVICE_CHUNK_FN
 
 
-class SbmHashStream(_CounterHashStream):
+class SbmHashStream(DeviceStream, _CounterHashStream):
     """Planted-partition (stochastic block model) counter-hash stream:
     2**scale vertices in ``n_blocks`` equal contiguous blocks, each edge
     inter-block with probability ``p_out``. Ground truth is
@@ -561,8 +564,8 @@ class SbmHashStream(_CounterHashStream):
     cross rate, so a partitioner that recovers the blocks at
     k = n_blocks scores cut_ratio ~= p_out.
 
-    Device fast path like :class:`RmatHashStream` (bit-identical host
-    and device chunks).
+    A :class:`~sheep_tpu.io.devicestream.DeviceStream` like
+    :class:`RmatHashStream` (bit-identical host and device chunks).
     """
 
     def __init__(self, scale: int, n_blocks: int = 64,

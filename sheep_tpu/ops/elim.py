@@ -568,12 +568,17 @@ def _t_ms(stats: dict, key: str, dt_s: float) -> None:
 
 
 def _seed_ms_counters(stats: dict) -> None:
-    """Pre-seed the overlap counters so every driver run emits both —
-    a fold that converges before its second execution would otherwise
-    never touch ``device_gap_ms``, and the bench contract / regression
-    gate treat a missing field as incomparable rather than zero."""
+    """Pre-seed the overlap counters so every driver run emits all of
+    them — a fold that converges before its second execution would
+    otherwise never touch ``device_gap_ms``, and the bench contract /
+    regression gate treat a missing field as incomparable rather than
+    zero. The H2D ingest pair (ISSUE 12) seeds here too: a
+    device-stream build stages nothing, and its 0.0s are the
+    zero-host-bytes evidence, not an absent measurement."""
     stats.setdefault("host_blocked_ms", 0.0)
     stats.setdefault("device_gap_ms", 0.0)
+    stats.setdefault("h2d_staged_ms", 0.0)
+    stats.setdefault("h2d_blocked_ms", 0.0)
 
 
 def fold_segments_batch(

@@ -327,7 +327,8 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
         cuts = []
         for c in stream.chunks(chunk_edges):
             cc, _ = score_ops.score_chunk(
-                jnp.asarray(pad_chunk(c, chunk_edges, n)), a_try, n)
+                jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                                      chunk_edges, n)), a_try, n)
             cuts.append(cc)
         return sum(int(c) for c in cuts)
 
@@ -343,7 +344,8 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
             hist = jnp.zeros((n + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
                 hist, cc, _ = neighbor_hist_chunk(
-                    hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
+                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                                               chunk_edges, n)),
                     a_try, n, k)
                 cuts.append(cc)
             b, bv, cur = hist_stats(hist, a_try)
@@ -354,7 +356,8 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
             hist = jnp.zeros((vb + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
                 hist = neighbor_hist_block(
-                    hist, jnp.asarray(pad_chunk(c, chunk_edges, n)),
+                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                                               chunk_edges, n)),
                     a_try, jnp.int32(base), n, k, vb)
             rows = a_try[base:base + vb]
             pad = vb - rows.shape[0]

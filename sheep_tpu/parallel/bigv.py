@@ -73,6 +73,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheep_tpu import obs
 from sheep_tpu.analysis import sanitize
+from sheep_tpu.io.devicestream import is_device_stream
 from sheep_tpu.ops.elim import pow2_at_least
 from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
 
@@ -590,9 +591,14 @@ class BigVPipeline:
                         stats["compactions"] = stats.get("compactions", 0) + 1
 
     # ---- host-side helpers ----------------------------------------------
-    def _put(self, sharding, arr: np.ndarray):
+    def _put(self, sharding, arr):
         """Single process: plain device_put. Multi-host: every process
-        passes its process-local rows and JAX assembles the global array."""
+        passes its process-local rows and JAX assembles the global
+        array. A batch already materialized on device (device-stream
+        synthesis, single-process — see ``run``'s ingest) relays
+        without a host crossing."""
+        if isinstance(arr, jax.Array):
+            return jax.device_put(arr, sharding)
         if self.procs == 1:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
@@ -683,6 +689,21 @@ class BigVPipeline:
             return out
 
         def batches(start_chunk=0):
+            # device-stream ingest (ISSUE 12): a counter-hash input
+            # (the bigv soak generator class) synthesizes every
+            # (rows, C, 2) batch directly in device memory — zero host
+            # bytes per chunk; _put relays the pre-placed global array.
+            # Pass-through, not prefetch: a worker queue of global
+            # device batches would hold unmodeled HBM, and there is no
+            # host I/O to overlap. Multi-host keeps the host lockstep
+            # path (per-process assembly takes host rows).
+            if self.procs == 1 and is_device_stream(stream):
+                from sheep_tpu.parallel.pipeline import (
+                    _PassThrough, device_lockstep_batches)
+
+                return _PassThrough(device_lockstep_batches(
+                    stream, cs, self.n_local, n, self.batch_sharding,
+                    start_chunk=start_chunk, stats=build_stats))
             return prefetch(iter_batches_lockstep(
                 stream, cs, self.n_local, n, self.proc, self.procs,
                 start_chunk=start_chunk,
@@ -712,6 +733,10 @@ class BigVPipeline:
         m_cheap = stream.num_edges_cheap
         obs.progress(backend="tpu-bigv", k=int(k), edges_total=m_cheap)
 
+        # ONE build-stats record across the streaming passes so the
+        # ingest counters (device_stream_chunks, ISSUE 12) accumulate
+        # wherever batches are synthesized
+        build_stats: dict = {}
         # pass 1: degrees (block-sharded int32 accumulator + host fold of
         # the LOCAL block, int32 when the edge bound proves no overflow;
         # resets are jitted on-device zeros, no
@@ -783,7 +808,6 @@ class BigVPipeline:
         sp = obs.begin("build")
         obs.progress(phase="build", chunks_done=0, edges_done=0)
         total_rounds = 0
-        build_stats: dict = {}
         if state and from_phase >= 2:
             P_sh = self._put(self.shard, state.arrays["ptable_local"])
         else:

@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheep_tpu import obs
 from sheep_tpu.analysis import sanitize
+from sheep_tpu.io.devicestream import is_device_stream, note_device_chunks
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
 from sheep_tpu.ops import order as order_ops
@@ -120,6 +121,101 @@ def iter_batches_lockstep(stream, cs: int, rows: int, n: int, proc: int,
     empty = np.full((rows, cs, 2), n, np.int32)
     for _ in range(nb - produced):
         yield empty
+
+
+def device_lockstep_batches(stream, cs: int, rows: int, n: int, sharding,
+                            start_chunk: int = 0, stats=None):
+    """(rows, C, 2) int32 GLOBAL device batches synthesized ON DEVICE
+    from a :func:`~sheep_tpu.io.devicestream.is_device_stream` input —
+    the single-process device twin of :func:`iter_batches_lockstep`:
+    batch b row j carries global chunk ``start_chunk + b*rows + j``,
+    chunk indices past the stream end synthesize the inert all-sentinel
+    chunk, so the batch sequence is bit-identical to the host path's
+    padded batches while paying ZERO host bytes per chunk (ISSUE 12;
+    the sharded/bigv soak ingest this replaces generated on host and
+    re-crossed the link every pass).
+
+    Each row is synthesized via the stream's jitted device kernel and
+    placed on its owning device (``device_chunk_on`` semantics — a
+    device-to-device move on a real mesh, never a host crossing), then
+    the global array assembles with
+    ``jax.make_array_from_single_device_arrays``. Multi-host callers
+    keep the host lockstep path: per-process assembly goes through
+    ``make_array_from_process_local_data``, which takes host rows."""
+    shape = (rows, cs, 2)
+    # device -> owned row index, from the sharding itself (robust to
+    # device enumeration order)
+    owners = sorted(
+        ((idx[0].start or 0, dev)
+         for dev, idx in sharding.addressable_devices_indices_map(
+             shape).items()),
+        key=lambda t: t[0])
+    total = stream.num_device_chunks(cs)
+    n_batches = max(0, -(-(total - start_chunk) // rows))
+
+    def place(dev, idx):
+        # device_chunk_on = the protocol's placement hook (default:
+        # synthesize on the default device, move device-to-device —
+        # zero host bytes; a stream may override it to synthesize on
+        # the target directly). Duck-typed streams without the hook
+        # get the default move.
+        if hasattr(stream, "device_chunk_on"):
+            return stream.device_chunk_on(dev, idx, cs, n)
+        return jax.device_put(stream.device_chunk(idx, cs, n), dev)
+
+    for b in range(n_batches):
+        shards = []
+        for j, dev in owners:
+            chunk = place(dev, start_chunk + b * rows + j)
+            shards.append(chunk[None])
+        # count only the REAL chunks of a partial final batch (pad rows
+        # are inert sentinels, and the tpu driver's count is exact —
+        # the two drivers must report the same ingest telemetry for
+        # the same input)
+        note_device_chunks(stats,
+                           min(rows, total - (start_chunk + b * rows)))
+        yield jax.make_array_from_single_device_arrays(
+            shape, sharding, shards)
+
+
+class _PassThrough:
+    """The prefetch surface (with/iter/close) over a plain generator,
+    for DEVICE-SYNTH batch streams: a worker thread buffering global
+    device arrays would hold queue-depth x batch HBM the membudget
+    model never counts, and there is no host I/O to overlap anyway
+    (synthesis is already-async device work). Host-format streams keep
+    the real :func:`~sheep_tpu.utils.prefetch.prefetch`."""
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __iter__(self):
+        return iter(self._gen)
+
+    def close(self) -> None:
+        close = getattr(self._gen, "close", None)
+        if close is not None:
+            close()
+
+    def __enter__(self) -> "_PassThrough":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def _grouped(iterable, batch: int):
+    """Plain (worker-less) grouping into lists of up to ``batch`` items
+    — the device-synth twin of prefetch_batched's inner generator."""
+    buf: list = []
+    for item in iterable:
+        buf.append(item)
+        if len(buf) == batch:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
 
 
 class ShardedPipeline:
@@ -646,10 +742,16 @@ class ShardedPipeline:
         return self._fold_actives(P_all, lo_all, hi_all)
 
     # -- host->device placement (multi-host aware) -------------------------
-    def _put(self, sharding, arr: np.ndarray):
+    def _put(self, sharding, arr):
         """Single process: plain device_put. Multi-host: every process
         passes its process-local rows (or the full array for replicated
-        shardings) and JAX assembles the global array."""
+        shardings) and JAX assembles the global array. A batch that is
+        ALREADY a device array (device-stream synthesis,
+        :func:`device_lockstep_batches` — single-process only) relays
+        through a device-side device_put: a no-op at the right
+        sharding, a D2D re-lay otherwise, never a host crossing."""
+        if isinstance(arr, jax.Array):
+            return jax.device_put(arr, sharding)
         if self.procs == 1:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
@@ -736,11 +838,44 @@ class ShardedPipeline:
         return use_byte_range(stream, self.procs)
 
     # -- lockstep batch iteration ------------------------------------------
-    def iter_batches(self, stream, start_chunk: int = 0):
-        """Process-local lockstep batches (see iter_batches_lockstep)."""
+    def _device_synth(self, stream) -> bool:
+        """True when this run ingests by on-device synthesis (ISSUE 12):
+        a device stream under a single process. Multi-host keeps the
+        host lockstep path (per-process global-array assembly takes
+        host rows, and every process must agree on the ingest mode)."""
+        return self.procs == 1 and is_device_stream(stream)
+
+    def iter_batches(self, stream, start_chunk: int = 0, stats=None):
+        """Process-local lockstep batches (see iter_batches_lockstep):
+        host (rows, C, 2) arrays, or pre-placed GLOBAL device batches
+        when the input is a device stream (``_put`` relays those
+        without a host crossing)."""
+        if self._device_synth(stream):
+            yield from device_lockstep_batches(
+                stream, self.cs, self.n_local, self.n,
+                self.batch_sharding, start_chunk=start_chunk,
+                stats=stats)
+            return
         yield from iter_batches_lockstep(
             stream, self.cs, self.n_local, self.n, self.proc, self.procs,
             start_chunk=start_chunk, byte_range=self._use_byte_range(stream))
+
+    def _staged_batches(self, stream, start_chunk: int = 0, stats=None,
+                        group: int = 0):
+        """Context-managed batch supplier for the streaming loops:
+        prefetch for host-format streams (read/parse/pad overlaps
+        device work on a worker thread), :class:`_PassThrough` for
+        device-synth streams (buffering global device arrays in a
+        worker queue would hold unmodeled HBM, and there is no host
+        I/O to overlap). ``group`` > 0 yields lists of up to that many
+        batches (the batched dispatch's staging unit)."""
+        from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
+
+        it = self.iter_batches(stream, start_chunk=start_chunk,
+                               stats=stats)
+        if self._device_synth(stream):
+            return _PassThrough(_grouped(it, group) if group else it)
+        return prefetch_batched(it, group) if group else prefetch(it)
 
     # -- full run (single process; multi-host callers drive the steps) -----
     def run(self, stream, k: int, alpha: float = 1.0,
@@ -763,7 +898,6 @@ class ShardedPipeline:
         from sheep_tpu.utils import retry as retry_mod
         from sheep_tpu.utils import watchdog as wd_mod
         from sheep_tpu.utils.fault import maybe_fail
-        from sheep_tpu.utils.prefetch import prefetch, prefetch_batched
 
         t = timings if timings is not None else {}
         n, cs, d = self.n, self.cs, self.n_devices
@@ -793,6 +927,10 @@ class ShardedPipeline:
         m_cheap = stream.num_edges_cheap
         obs.progress(backend="tpu-sharded", k=int(k), edges_total=m_cheap)
 
+        # ONE build-stats record across the streaming passes, so the
+        # ingest counters (device_stream_chunks / h2d_staged_bytes,
+        # ISSUE 12) accumulate wherever batches are synthesized
+        build_stats: dict = {}
         # pass 1: degrees, int32 on device with int64 host flushes so no
         # per-vertex endpoint count can reach 2^31 between flushes
         t0 = time.perf_counter()
@@ -809,8 +947,8 @@ class ShardedPipeline:
             since = batches = 0
             with wd_mod.watched(self.procs, "sharded-degrees",
                                 self.proc) as wd, \
-                    prefetch(self.iter_batches(stream,
-                                               start_chunk=start)) as pf:
+                    self._staged_batches(stream, start,
+                                         build_stats) as pf:
                 # with-exit = deterministic worker cancel on exception
                 # unwind (fault injection, checkpoint IO)
                 for batch in pf:
@@ -858,7 +996,6 @@ class ShardedPipeline:
         sp = obs.begin("build+merge")
         obs.progress(phase="build", chunks_done=0, edges_done=0)
         merge_stats: dict = {}
-        build_stats: dict = {}
         # fault kinds the per-batch injection points can absorb: the
         # in-process retry below only runs single-process (a one-rank
         # retry would desynchronize the collective schedules), so chaos
@@ -909,22 +1046,30 @@ class ShardedPipeline:
                         build_stats["dispatch_batch"] = nb
                         build_stats["inflight_depth"] = self.inflight
                         empty = None
+                        devsynth = self._device_synth(stream)
                         # with-exit = deterministic worker cancel on an
                         # exception unwind (fault injection, checkpoint
                         # IO), as in _device_chunk_groups
-                        with prefetch_batched(
-                                self.iter_batches(stream,
-                                                  start_chunk=start),
-                                nb) as pf:
+                        with self._staged_batches(stream, start,
+                                                  build_stats,
+                                                  group=nb) as pf:
                             for group in pf:
                                 gl = len(group)
                                 if gl < nb:
                                     if empty is None:
-                                        empty = np.full(
+                                        # device-synth groups pad with a
+                                        # device-resident sentinel batch
+                                        # (no host block to upload)
+                                        empty = jnp.full(
                                             (self.n_local, cs, 2), n,
-                                            np.int32)
+                                            jnp.int32) if devsynth \
+                                            else np.full(
+                                                (self.n_local, cs, 2),
+                                                n, np.int32)
                                     group = group + [empty] * (nb - gl)
-                                blocks = np.stack(group, axis=1)
+                                blocks = jnp.stack(group, axis=1) \
+                                    if devsynth \
+                                    else np.stack(group, axis=1)
                                 before = batches
                                 dsp = obs.begin("dispatch", i=before,
                                                 batches=gl)
@@ -959,8 +1104,8 @@ class ShardedPipeline:
                                          "merged_partial": partial},
                                         meta)
                     else:
-                        with prefetch(self.iter_batches(
-                                stream, start_chunk=start)) as pf:
+                        with self._staged_batches(stream, start,
+                                                  build_stats) as pf:
                             for batch in pf:
                                 seg_sp = obs.begin("segment", i=batches)
                                 try:
@@ -1070,8 +1215,7 @@ class ShardedPipeline:
         batches = 0
         with wd_mod.watched(self.procs, "sharded-score",
                             self.proc) as wd, \
-                prefetch(self.iter_batches(stream,
-                                           start_chunk=start)) as pf:
+                self._staged_batches(stream, start, build_stats) as pf:
             for batch in pf:
                 dev_batch = self.put_batch(batch)
                 c, tt = np.asarray(  # sheeplint: sync-ok

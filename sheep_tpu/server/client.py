@@ -176,6 +176,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tenant", default="default")
     p.add_argument("--chunk-edges", type=int, default=None)
     p.add_argument("--dispatch-batch", type=int, default=None)
+    p.add_argument("--h2d-ring", type=int, default=None,
+                   help="staged H2D ring depth for host-format inputs "
+                        "(0 = auto; device-generated specs skip "
+                        "staging)")
     p.add_argument("--alpha", type=float, default=None)
     p.add_argument("--weights", choices=["unit", "degree"], default=None)
     p.add_argument("--comm-volume", action="store_true")
@@ -296,6 +300,7 @@ def main(argv=None) -> int:
             job = {"k": ks}
             for field, val in (("chunk_edges", args.chunk_edges),
                                ("dispatch_batch", args.dispatch_batch),
+                               ("h2d_ring", args.h2d_ring),
                                ("alpha", args.alpha),
                                ("weights", args.weights),
                                ("num_vertices", args.num_vertices),

@@ -47,7 +47,9 @@ import jax.numpy as jnp
 from sheep_tpu import obs
 from sheep_tpu.backends.tpu_backend import (_device_chunk_groups,
                                             _device_chunks,
-                                            resolve_dispatch_batch)
+                                            resolve_dispatch_batch,
+                                            resolve_h2d_ring)
+from sheep_tpu.io.devicestream import is_device_stream
 from sheep_tpu.io.edgestream import open_input
 from sheep_tpu.ops import degrees as degrees_ops
 from sheep_tpu.ops import elim as elim_ops
@@ -69,9 +71,11 @@ class JobEngine:
         # live dispatch knobs — the retry layer's degrade hook halves
         # these mid-build; the staging loop restages at the new shape
         self.batch: Optional[int] = None
+        self.ring: int = 1
         self._n = 0
         self._cs = 0
         self._build_idx = 0
+        self._dev_stream = False
 
     # -- fault hooks (per job; the daemon survives, the job degrades) --
     def _on_resource(self):
@@ -88,9 +92,12 @@ class JobEngine:
             self.job.cache_shed = True
         nxt = retry_mod.degrade_dispatch(
             self._n, self._cs, self.batch or 1, 1, False,
-            self.job.stats, self._build_idx)
+            self.job.stats, self._build_idx,
+            h2d_ring=None if self._dev_stream else self.ring)
         if nxt is not None:
             self.batch = nxt[0]
+            if len(nxt) > 2:
+                self.ring = nxt[2]
 
     def _enter_phase(self, phase: str) -> None:
         # live progress signal (ISSUE 11): the job descriptor's phase
@@ -124,8 +131,19 @@ class JobEngine:
             check_tpu_vertex_range(n, "sheepd")
             cs = es.clamp_chunk_edges(job.spec.chunk_edges)
             self._n, self._cs = n, cs
-            self.batch = resolve_dispatch_batch(job.spec.dispatch_batch,
-                                                n, cs)
+            # staged H2D ring (ISSUE 12): device-stream inputs
+            # (rmat-hash:/sbm-hash: specs) synthesize chunks in
+            # accelerator memory — zero host bytes per served chunk;
+            # host-format inputs stage through the ring exactly as the
+            # CLI's tpu driver does (same _device_chunks supplier).
+            # The ring resolves BEFORE the batch so the auto batch
+            # sizing reserves the staged blocks in the HBM model (the
+            # tpu backend's ring_model rule)
+            self._dev_stream = is_device_stream(es)
+            self.ring = resolve_h2d_ring(job.spec.h2d_ring)
+            self.batch = resolve_dispatch_batch(
+                job.spec.dispatch_batch, n, cs,
+                h2d_ring=0 if self._dev_stream else self.ring)
             stats["dispatch_batch"] = self.batch
             job.n_vertices = n
 
@@ -137,7 +155,8 @@ class JobEngine:
             deg = degrees_ops.init_degrees(n)
             flush_every = degrees_ops.flush_every_for(cs)
             since = 0
-            chunks = _device_chunks(es, cs, n, self.cache, 0)
+            chunks = _device_chunks(es, cs, n, self.cache, 0,
+                                    self.ring, stats)
             try:
                 for padded in chunks:
                     deg = degrees_ops.degree_chunk(deg, padded, n)
@@ -187,8 +206,10 @@ class JobEngine:
             try:
                 while True:
                     batch = self.batch
+                    ring = self.ring
                     groups = _device_chunk_groups(
-                        es, cs, n, self.cache, self._build_idx, batch)
+                        es, cs, n, self.cache, self._build_idx, batch,
+                        ring, stats)
                     restage = False
                     try:
                         for group in groups:
@@ -226,9 +247,11 @@ class JobEngine:
                             self._build_idx += gl
                             stats_acc.absorb(stats)
                             yield "build"
-                            if self.batch != batch:
+                            if self.batch != batch or self.ring != ring:
                                 # degraded mid-stream: restage the
-                                # remainder at the new shape
+                                # remainder at the new shape (and the
+                                # abandoned supplier's finally drains
+                                # its staged ring blocks)
                                 restage = True
                                 break
                     finally:
@@ -271,7 +294,8 @@ class JobEngine:
             cut = {k: 0 for k in assigns}
             cv_chunks: dict = {k: [] for k in assigns}
             total = 0
-            chunks = _device_chunks(es, cs, n, self.cache, 0)
+            chunks = _device_chunks(es, cs, n, self.cache, 0,
+                                    self.ring, stats)
             try:
                 for padded in chunks:
                     first = True

@@ -96,6 +96,7 @@ class JobSpec:
     tenant: str = "default"
     chunk_edges: int = 1 << 22
     dispatch_batch: int = 0        # 0 = auto (membudget-sized)
+    h2d_ring: int = 0              # 0 = auto (staged H2D ring depth)
     segment_rounds: int = 2
     alpha: float = 1.0
     weights: str = "unit"
@@ -121,8 +122,8 @@ class JobSpec:
                                "non-empty list of them")
         ks = list(dict.fromkeys(ks))  # dupes would alias result rows
         known = {"input", "k", "ks", "chunk_edges", "dispatch_batch",
-                 "segment_rounds", "alpha", "weights", "comm_volume",
-                 "num_vertices", "deadline_s", "output",
+                 "h2d_ring", "segment_rounds", "alpha", "weights",
+                 "comm_volume", "num_vertices", "deadline_s", "output",
                  "return_assignment"}
         unknown = set(body) - known
         if unknown:
@@ -131,6 +132,7 @@ class JobSpec:
             input=str(body["input"]), ks=ks, tenant=str(tenant),
             chunk_edges=int(body.get("chunk_edges", 1 << 22)),
             dispatch_batch=int(body.get("dispatch_batch", 0)),
+            h2d_ring=int(body.get("h2d_ring", 0)),
             segment_rounds=int(body.get("segment_rounds", 2)),
             alpha=float(body.get("alpha", 1.0)),
             weights=str(body.get("weights", "unit")),
@@ -148,6 +150,8 @@ class JobSpec:
         if spec.dispatch_batch < 0:
             raise ProtocolError("job.dispatch_batch must be >= 0 "
                                "(0 = auto)")
+        if spec.h2d_ring < 0:
+            raise ProtocolError("job.h2d_ring must be >= 0 (0 = auto)")
         if spec.weights not in ("unit", "degree"):
             raise ProtocolError("job.weights must be 'unit' or 'degree'")
         if spec.deadline_s is not None and spec.deadline_s <= 0:

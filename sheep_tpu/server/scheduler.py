@@ -309,18 +309,34 @@ class Scheduler:
     def _model(self, spec: JobSpec, n: int):
         """(modeled_bytes, pre-shed dispatch_batch or None, reject
         reason or None) for admission. Models at the REQUESTED chunk
-        size (clamping only shrinks it — conservative)."""
-        from sheep_tpu.backends.tpu_backend import resolve_dispatch_batch
+        size (clamping only shrinks it — conservative), with the same
+        staged-H2D-ring term the engine will actually run
+        (ISSUE 12): device-stream inputs stage nothing, host-format
+        ones hold ring x batch blocks in HBM — reserving without that
+        term would admit jobs whose real footprint exceeds the budget
+        and re-create the OOM churn admission exists to prevent."""
+        from sheep_tpu.backends.tpu_backend import (resolve_dispatch_batch,
+                                                    resolve_h2d_ring)
+        from sheep_tpu.io.devicestream import is_device_stream
+        from sheep_tpu.io.edgestream import open_input
         from sheep_tpu.utils import membudget
 
         cs = spec.chunk_edges
-        batch = resolve_dispatch_batch(spec.dispatch_batch, n, cs)
+        try:
+            with open_input(spec.input,
+                            n_vertices=spec.num_vertices) as es:
+                dev_stream = is_device_stream(es)
+        except Exception:
+            dev_stream = False  # _probe_num_vertices already rejected
+        ring = 0 if dev_stream else resolve_h2d_ring(spec.h2d_ring)
+        batch = resolve_dispatch_batch(spec.dispatch_batch, n, cs,
+                                       h2d_ring=ring)
         if self.budget is None:
             return None, None, None
 
         def total(b):
             return membudget.build_phase_bytes(
-                n, cs, dispatch_batch=b)["total_bytes"]
+                n, cs, dispatch_batch=b, h2d_ring=ring)["total_bytes"]
 
         m = total(batch)
         shed = None

@@ -17,7 +17,8 @@ from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
 
 def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
                       descent: str = "auto", dispatch_batch: int = 1,
-                      inflight: int = 1, donate: bool = False) -> dict:
+                      inflight: int = 1, donate: bool = False,
+                      h2d_ring: int = 0) -> dict:
     """Estimated peak device bytes for one build_chunk_step.
 
     The displacement fixpoint (ops/elim.py fold_edges) keeps the carried
@@ -42,6 +43,14 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     table's and each staging block's buffers for the execution outputs
     instead of double-buffering them across the call boundary — it
     credits back one minp table and one staging block's oriented half.
+
+    ``h2d_ring`` (the staged H2D ring, utils/prefetch.H2DRing —
+    ISSUE 12) holds up to that many pre-transferred padded blocks in
+    device memory awaiting dispatch — ``dispatch_batch`` chunks of
+    (C, 2) int32 each per block, so like ``inflight`` it is a
+    depth x staging-bytes product. 0 = ring off (device-stream inputs
+    synthesize on device and stage nothing; the synchronous path
+    uploads in place).
     """
     if lift_levels <= 0:
         lift_levels = max(1, int(n).bit_length())
@@ -70,11 +79,16 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
         # threads through
         persistent -= table
         staging -= staging_unit // 2
-    total = persistent + transient + staging + lift_bytes
+    # staged H2D ring: D pre-uploaded (C, 2) int32 blocks (x batch
+    # chunks each) live in HBM between transfer and dispatch
+    ring_bytes = 4 * 2 * chunk_edges * max(1, dispatch_batch) \
+        * max(0, h2d_ring)
+    total = persistent + transient + staging + ring_bytes + lift_bytes
     return {
         "persistent_bytes": persistent,
         "transient_bytes": transient,
         "staging_bytes": staging,
+        "h2d_ring_bytes": ring_bytes,
         "lift_bytes": lift_bytes,
         "descent": descent,
         "total_bytes": total,
@@ -83,20 +97,21 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
 
 def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
                        cap: int = 16, inflight: int = 1,
-                       donate: bool = False) -> int:
+                       donate: bool = False, h2d_ring: int = 0) -> int:
     """Largest power-of-two dispatch batch N in [1, cap] whose staged
     build phase fits ``hbm_bytes`` — the ``--dispatch-batch 0`` (auto)
     sizing rule. Power-of-two N keeps the set of compiled batch-program
     shapes logarithmic, like every other buffer-sizing rule here.
-    ``inflight``/``donate`` thread the in-flight pipeline's staging
-    multiplier and the donation credit into the model, so a deeper
-    pipeline auto-sizes to a proportionally smaller N."""
+    ``inflight``/``donate``/``h2d_ring`` thread the in-flight
+    pipeline's staging multiplier, the donation credit and the staged
+    H2D ring into the model, so a deeper pipeline (or ring) auto-sizes
+    to a proportionally smaller N."""
     best = 1
     nb = 2
     while nb <= cap:
         if build_phase_bytes(n, chunk_edges, dispatch_batch=nb,
-                             inflight=inflight,
-                             donate=donate)["total_bytes"] > hbm_bytes:
+                             inflight=inflight, donate=donate,
+                             h2d_ring=h2d_ring)["total_bytes"] > hbm_bytes:
             break
         best = nb
         nb *= 2
@@ -104,35 +119,49 @@ def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
 
 
 def degraded_dispatch(n: int, chunk_edges: int, dispatch_batch: int,
-                      inflight: int, donate: bool = False):
+                      inflight: int, donate: bool = False,
+                      h2d_ring=None):
     """One RESOURCE_EXHAUSTED degradation step for the dispatch drivers
-    (ISSUE 9): halve ``dispatch_batch`` or ``inflight`` — whichever
-    frees MORE modeled bytes per the build-phase HBM model above — and
-    return the new ``(dispatch_batch, inflight)`` pair, or ``None`` when
-    both are already 1 (nothing left to shed; the caller falls back to
-    a plain retry, then to the checkpoint/kill+resume contract).
+    (ISSUE 9): halve ``dispatch_batch``, ``inflight`` — or, when the
+    caller runs a staged H2D ring (``h2d_ring`` given as an int >= 1,
+    ISSUE 12), the ring depth — whichever frees MORE modeled bytes per
+    the build-phase HBM model above. Returns the new
+    ``(dispatch_batch, inflight)`` pair (legacy callers, ``h2d_ring``
+    omitted) or the ``(dispatch_batch, inflight, h2d_ring)`` triple,
+    or ``None`` when every knob is already 1 (nothing left to shed;
+    the caller falls back to a plain retry, then to the
+    checkpoint/kill+resume contract).
 
     Reusing :func:`build_phase_bytes` instead of a fixed halving order
     keeps the degrade schedule consistent with the auto-sizing rule
     (:func:`dispatch_batch_for`): the knob that the model says holds the
     most staging is the knob an OOM most plausibly indicts."""
     batch, depth = max(1, int(dispatch_batch)), max(1, int(inflight))
-    if batch <= 1 and depth <= 1:
+    ring = None if h2d_ring is None else max(1, int(h2d_ring))
+    if batch <= 1 and depth <= 1 and (ring is None or ring <= 1):
         return None
 
-    def total(b, d):
+    def total(b, d, r):
         return build_phase_bytes(n, chunk_edges, dispatch_batch=b,
-                                 inflight=d, donate=donate)["total_bytes"]
+                                 inflight=d, donate=donate,
+                                 h2d_ring=r or 0)["total_bytes"]
 
+    r0 = ring or 0
     cand = []
     if batch > 1:
-        cand.append((total(batch // 2, depth), (batch // 2, depth)))
+        cand.append((total(batch // 2, depth, r0),
+                     (batch // 2, depth, r0)))
     if depth > 1:
-        cand.append((total(batch, depth // 2), (batch, depth // 2)))
+        cand.append((total(batch, depth // 2, r0),
+                     (batch, depth // 2, r0)))
+    if ring is not None and ring > 1:
+        cand.append((total(batch, depth, ring // 2),
+                     (batch, depth, ring // 2)))
     # smallest modeled footprint wins; ties prefer halving the batch
     # (listed first), which keeps the pipeline depth — and its overlap —
     # alive longest
-    return min(cand, key=lambda c: c[0])[1]
+    best = min(cand, key=lambda c: c[0])[1]
+    return best if ring is not None else best[:2]
 
 
 def max_vertices_for(hbm_bytes: int, chunk_edges: int) -> int:

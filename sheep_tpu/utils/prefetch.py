@@ -36,11 +36,17 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+from collections import deque
 from typing import Iterable, Iterator, TypeVar
 
 T = TypeVar("T")
 
 _END = object()
+
+#: :meth:`Prefetcher.poll_nowait` return when nothing is queued yet —
+#: distinct from every real item AND from stream end (StopIteration)
+NOT_READY = object()
 
 
 class _Raised:
@@ -150,6 +156,30 @@ class Prefetcher(Iterator[T]):
             raise item.exc.with_traceback(item.tb)
         return item
 
+    def poll_nowait(self):
+        """Non-blocking probe: the next item when one is already
+        queued, the module sentinel :data:`NOT_READY` otherwise.
+        Stream end and worker exceptions surface exactly as in
+        :meth:`__next__` (StopIteration / the original error). This is
+        the opportunistic-refill hook of :class:`H2DRing`: the ring
+        tops itself up with whatever the worker has ready without ever
+        blocking the consumer on the producer thread."""
+        if self._closed or self._done:
+            raise StopIteration
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            return NOT_READY
+        if item is _END:
+            self._done = True
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, _Raised):
+            self._done = True
+            self._stop.set()
+            raise item.exc.with_traceback(item.tb)
+        return item
+
     def close(self, timeout: float = 5.0) -> None:
         """Cancel the worker: signal stop, drain the queue (a worker
         blocked on the full bounded queue wakes within one put poll),
@@ -203,6 +233,151 @@ def prefetch(iterable: Iterable[T], depth: int = 2) -> Prefetcher[T]:
     otherwise stops the worker on the GC backstop only.
     """
     return Prefetcher(iterable, depth=depth)
+
+
+# ---------------------------------------------------------------------------
+# staged H2D ring (ISSUE 12 tentpole, leg b). The prefetcher above hides
+# host READ latency; the host->device transfer itself still ran
+# synchronously in the dispatch chain (`jnp.asarray(padded)` issued at
+# the exact moment the driver needed the block). jax transfers are
+# asynchronous once ISSUED, so the only thing needed to take H2D off the
+# critical path is issuing each block's device_put D blocks ahead of its
+# consumption — while the device folds block i, the transfers for blocks
+# i+1..i+D are already in flight.
+# ---------------------------------------------------------------------------
+
+
+def _block_bytes(block) -> int:
+    """Host bytes of one staged block (a single array or a list/tuple of
+    them — the grouped staging of the batched dispatch)."""
+    if isinstance(block, (list, tuple)):
+        return sum(_block_bytes(b) for b in block)
+    return int(getattr(block, "nbytes", 0))
+
+
+class H2DRing:
+    """Bounded ring of staged host->device transfers over an iterator of
+    PRE-PADDED host blocks (a ``(C, 2)`` chunk, or a list of them — any
+    pytree ``jax.device_put`` accepts).
+
+    Keeps up to ``depth`` blocks' transfers issued AHEAD of the
+    consumer, preserving order exactly; each yielded device array is
+    bit-identical to what ``jnp.asarray`` of the same host block yields,
+    so every consumer stays on the fixpoint-uniqueness contract.
+    Refills are OPPORTUNISTIC when the source is a :class:`Prefetcher`
+    (:meth:`Prefetcher.poll_nowait` — the ring never blocks the consumer
+    on the producer thread while it still holds staged blocks); plain
+    iterables refill eagerly.
+
+    Counters, accumulated UNROUNDED into ``stats`` (read-time rounding,
+    like every ``*_ms`` counter):
+
+    - ``h2d_staged_ms``   wall spent *issuing* ahead-of-need transfers
+      (async issue cost — the transfer itself overlaps device compute)
+    - ``h2d_blocked_ms``  wall the consumer spent waiting for a block
+      the ring did not have staged (mid-stream underrun — exactly the
+      synchronous-upload tax this class removes; ~0 at depth >= 2 with
+      a keeping-up producer, the ``device_gap_ms`` pattern). The
+      startup fill is attributed to staged, not blocked: before the
+      first block there is no device work to overlap, the same
+      convention ``device_gap_ms`` uses for the first dispatch.
+    - ``h2d_staged_bytes``  host bytes that crossed through the ring
+    - ``h2d_ring_depth``    the resolved depth (gauge)
+
+    Lifecycle mirrors :class:`Prefetcher`: ``close()`` drops the staged
+    device references (releasing their HBM — the drain the
+    checkpoint/fault contract needs when a driver abandons the stream
+    mid-flight) and closes a closeable source; idempotent, ``with``
+    supported, iteration after close raises StopIteration.
+    """
+
+    def __init__(self, source, depth: int = 2, stats=None):
+        if depth < 1:
+            raise ValueError("h2d ring depth must be >= 1")
+        self.depth = int(depth)
+        self._src = source if hasattr(source, "__next__") \
+            else iter(source)
+        self._poll = getattr(self._src, "poll_nowait", None)
+        self._ring: deque = deque()
+        self._stats = stats if stats is not None else {}
+        self._stats.setdefault("h2d_staged_ms", 0.0)
+        self._stats.setdefault("h2d_blocked_ms", 0.0)
+        self._stats.setdefault("h2d_staged_bytes", 0)
+        self._stats["h2d_ring_depth"] = self.depth
+        self._exhausted = False
+        self._closed = False
+        self._started = False
+
+    def _issue(self, block) -> None:
+        """Issue one block's (async) transfer and append it."""
+        import jax
+
+        self._stats["h2d_staged_bytes"] += _block_bytes(block)
+        self._ring.append(jax.device_put(block))
+
+    def _fill(self, want: int, may_block: bool) -> None:
+        """Stage transfers until the ring holds ``want`` blocks or the
+        source has nothing (ready, when non-blocking) left."""
+        while len(self._ring) < want and not self._exhausted:
+            try:
+                if self._poll is not None and not may_block:
+                    block = self._poll()
+                    if block is NOT_READY:
+                        return
+                else:
+                    block = next(self._src)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._issue(block)
+            may_block = False  # at most one blocking pull per fill
+
+    def __iter__(self) -> "H2DRing":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if not self._ring and not self._exhausted:
+            # underrun (or startup): the consumer waits for host + issue
+            # in its critical path — the tax the ring exists to hide
+            t0 = time.perf_counter()
+            self._fill(1, may_block=True)
+            key = "h2d_blocked_ms" if self._started else "h2d_staged_ms"
+            self._stats[key] += (time.perf_counter() - t0) * 1e3
+        if not self._ring:
+            raise StopIteration
+        self._started = True
+        out = self._ring.popleft()
+        # top back up to depth off the critical path: transfers are
+        # issued (async) now, so they run under the consumer's device
+        # work on `out`; only the issue cost lands in staged_ms
+        t0 = time.perf_counter()
+        self._fill(self.depth, may_block=self._poll is None)
+        self._stats["h2d_staged_ms"] += (time.perf_counter() - t0) * 1e3
+        return out
+
+    def close(self) -> None:
+        """Drop staged device references and close a closeable source.
+        Idempotent; safe from ``finally`` blocks."""
+        if self._closed:
+            return
+        self._closed = True
+        self._ring.clear()
+        close = getattr(self._src, "close", None)
+        if close is not None:
+            close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "H2DRing":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 def prefetch_batched(iterable: Iterable[T], batch: int,

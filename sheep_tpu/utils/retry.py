@@ -223,21 +223,30 @@ def handle_build_fault(policy: RetryPolicy, exc: BaseException,
 
 
 def degrade_dispatch(n: int, chunk_edges: int, batch: int, inflight: int,
-                     donate: bool, stats: dict, resume_chunk: int):
+                     donate: bool, stats: dict, resume_chunk: int,
+                     h2d_ring=None):
     """Shared RESOURCE recovery step: pick the membudget-modeled
-    halving of (dispatch_batch, inflight), record the degraded-knob
-    counters + the ``dispatch_degraded`` trace event. Returns the new
-    pair, or None when nothing is left to shed (the caller then plain-
-    retries and ultimately falls back to the kill+resume contract)."""
+    halving of (dispatch_batch, inflight) — plus the staged H2D ring
+    depth when the caller runs one (``h2d_ring`` an int, ISSUE 12) —
+    record the degraded-knob counters + the ``dispatch_degraded`` trace
+    event. Returns the new pair (or triple, mirroring
+    ``membudget.degraded_dispatch``), or None when nothing is left to
+    shed (the caller then plain-retries and ultimately falls back to
+    the kill+resume contract)."""
     from sheep_tpu import obs
     from sheep_tpu.utils import membudget
 
     nxt = membudget.degraded_dispatch(n, chunk_edges, batch, inflight,
-                                      donate)
+                                      donate, h2d_ring=h2d_ring)
     if nxt is not None:
-        stats["degraded_dispatch_batch"], stats["degraded_inflight"] = nxt
-        obs.event("dispatch_degraded", dispatch_batch=nxt[0],
-                  inflight=nxt[1], resume_chunk=int(resume_chunk))
+        stats["degraded_dispatch_batch"] = nxt[0]
+        stats["degraded_inflight"] = nxt[1]
+        event = {"dispatch_batch": nxt[0], "inflight": nxt[1]}
+        if len(nxt) > 2:
+            stats["degraded_h2d_ring"] = nxt[2]
+            event["h2d_ring"] = nxt[2]
+        obs.event("dispatch_degraded", resume_chunk=int(resume_chunk),
+                  **event)
     return nxt
 
 

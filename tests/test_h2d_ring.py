@@ -1,0 +1,465 @@
+"""Zero-copy ingest (ISSUE 12): device-side stream synthesis and the
+staged H2D ring.
+
+The acceptance properties, all assertable on the CPU mesh:
+
+  (a) device-stream bit-identity — a counter-hash DeviceStream build
+      equals the host-stream build of the same edges across the
+      backend, sharded, bigv, CLI and served entry points, with ZERO
+      per-chunk host staging bytes on the record;
+  (b) ring bit-identity — the staged H2D ring at depth D in {1, 2, 3}
+      produces the identical result to the synchronous path (the ring
+      changes WHEN transfers are issued, never what bits arrive),
+      including kill+resume through a partially-staged stream;
+  (c) degradation — an OOM-class fault shrinks the ring depth through
+      membudget.degraded_dispatch like dispatch_batch/inflight, and
+      the HBM model counts ring staging (depth x blocks);
+  (d) counters — h2d_staged_ms / h2d_blocked_ms / h2d_staged_bytes /
+      device_stream_chunks flow from the ring (or its absence) into
+      backend diagnostics, and the new sheeplint ``h2d`` rule keeps
+      the synchronous-upload regression class out of the drivers.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sheep_tpu.analysis.runner import lint_source
+from sheep_tpu.backends.tpu_backend import TpuBackend, resolve_h2d_ring
+from sheep_tpu.io import generators
+from sheep_tpu.io.devicestream import DeviceStream, is_device_stream
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.utils.membudget import build_phase_bytes, degraded_dispatch
+from sheep_tpu.utils.prefetch import H2DRing, prefetch
+
+CHUNK = 512
+
+
+def _streams(scale=10, ef=8, seed=3):
+    """(device_stream, host_stream) over the IDENTICAL edge set."""
+    dev = generators.RmatHashStream(scale, ef, seed=seed)
+    es = EdgeStream.from_array(dev.read_all(), n_vertices=1 << scale)
+    return dev, es
+
+
+# -- H2DRing unit behavior --------------------------------------------------
+
+
+def _blocks(k=6, c=16):
+    return [np.full((c, 2), i, np.int32) for i in range(k)]
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_ring_preserves_order_and_bits(depth):
+    stats: dict = {}
+    out = list(H2DRing(iter(_blocks()), depth=depth, stats=stats))
+    assert len(out) == 6
+    for i, dev in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(dev), _blocks()[i])
+    assert stats["h2d_ring_depth"] == depth
+    assert stats["h2d_staged_bytes"] == sum(b.nbytes for b in _blocks())
+    # an always-ready source never underruns the ring: the startup fill
+    # is staged (the device_gap_ms convention), so blocked is EXACTLY 0
+    assert stats["h2d_blocked_ms"] == 0.0
+    assert stats["h2d_staged_ms"] > 0.0
+
+
+def test_ring_stages_block_groups():
+    """Group staging (the batched dispatch's unit): a list of host
+    chunks is one ring block, transferred as one staged pytree."""
+    groups = [[np.full((8, 2), 3 * i + j, np.int32) for j in range(3)]
+              for i in range(4)]
+    stats: dict = {}
+    out = list(H2DRing(iter(groups), depth=2, stats=stats))
+    assert [len(g) for g in out] == [3, 3, 3, 3]
+    np.testing.assert_array_equal(np.asarray(out[2][1]), groups[2][1])
+    assert stats["h2d_staged_bytes"] == 12 * 8 * 2 * 4
+
+
+def test_ring_blocked_counts_mid_stream_underrun():
+    """A producer that stalls mid-stream shows up as h2d_blocked_ms —
+    the underrun tax — while the startup fill stays attributed to
+    staged."""
+    gate = threading.Event()
+
+    def slow():
+        yield np.zeros((4, 2), np.int32)
+        gate.wait(10.0)
+        yield np.ones((4, 2), np.int32)
+
+    stats: dict = {}
+    with prefetch(slow(), depth=2) as pf:
+        ring = H2DRing(pf, depth=2, stats=stats)
+        next(ring)  # startup fill: staged, not blocked
+        assert stats["h2d_blocked_ms"] == 0.0
+        threading.Timer(0.05, gate.set).start()
+        next(ring)  # ring empty, producer gated: a real underrun
+        assert stats["h2d_blocked_ms"] > 0.0
+        ring.close()
+
+
+def test_ring_close_contract():
+    ring = H2DRing(iter(_blocks()), depth=2)
+    next(ring)
+    ring.close()
+    ring.close()  # idempotent
+    with pytest.raises(StopIteration):
+        next(ring)
+    # with-support closes, and closing the ring closes a closeable
+    # source (the prefetch worker drains instead of leaking)
+    pf = prefetch(iter(_blocks()), depth=2)
+    with H2DRing(pf, depth=2) as r2:
+        next(r2)
+    assert r2.closed and pf.closed
+
+
+def test_ring_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        H2DRing(iter(()), depth=0)
+
+
+def test_ring_propagates_worker_exceptions():
+    def bad():
+        yield np.zeros((4, 2), np.int32)
+        raise RuntimeError("reader died")
+
+    with prefetch(bad(), depth=2) as pf:
+        with H2DRing(pf, depth=1) as ring:
+            # the worker error may surface on the very first next()
+            # (opportunistic refill already polled it) or on a later
+            # one — either way it reaches the consumer with the
+            # original traceback, never a hang
+            with pytest.raises(RuntimeError, match="reader died"):
+                for _ in ring:
+                    pass
+
+
+# -- DeviceStream protocol --------------------------------------------------
+
+
+def test_is_device_stream_recognition():
+    dev, es = _streams()
+    assert is_device_stream(dev)
+    assert isinstance(dev, DeviceStream)
+    assert is_device_stream(generators.SbmHashStream(8, 4, 0.05))
+    assert not is_device_stream(es)
+
+
+def test_device_chunk_bit_equals_host_pad():
+    from sheep_tpu.backends.tpu_backend import pad_chunk
+
+    dev, _ = _streams()
+    n = dev.num_vertices
+    host = list(dev.chunks(CHUNK))
+    for i in range(dev.num_device_chunks(CHUNK)):
+        np.testing.assert_array_equal(
+            np.asarray(dev.device_chunk(i, CHUNK, n)),
+            pad_chunk(host[i], CHUNK, n))
+    # past-the-end chunks are inert all-sentinel (the lockstep padding
+    # contract of device_lockstep_batches)
+    np.testing.assert_array_equal(
+        np.asarray(dev.device_chunk(10_000, CHUNK, n)),
+        np.full((CHUNK, 2), n, np.int32))
+
+
+def test_resolve_h2d_ring_auto():
+    assert resolve_h2d_ring(0) == 1  # cpu-jax auto
+    assert resolve_h2d_ring(3) == 3
+
+
+# -- backend equality: device stream + ring depths --------------------------
+
+
+def test_backend_device_stream_bit_equals_host_stream():
+    dev, es = _streams()
+    base = TpuBackend(chunk_edges=CHUNK).partition(es, 8)
+    got = TpuBackend(chunk_edges=CHUNK, dispatch_batch=2,
+                     inflight=2).partition(dev, 8)
+    np.testing.assert_array_equal(got.assignment, base.assignment)
+    assert got.edge_cut == base.edge_cut
+    assert got.comm_volume == base.comm_volume
+    # the zero-host-bytes record: chunks were synthesized on device
+    assert got.diagnostics["h2d_staged_bytes"] == 0
+    assert got.diagnostics["device_stream_chunks"] > 0
+    assert got.diagnostics["h2d_blocked_ms"] == 0.0
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_backend_ring_depth_sweep_oracle_equality(depth):
+    dev, es = _streams()
+    base = TpuBackend(chunk_edges=CHUNK).partition(es, 8)
+    got = TpuBackend(chunk_edges=CHUNK, dispatch_batch=2, inflight=2,
+                     h2d_ring=depth).partition(es, 8)
+    np.testing.assert_array_equal(got.assignment, base.assignment)
+    assert got.edge_cut == base.edge_cut
+    assert got.diagnostics["h2d_ring_depth"] == depth
+    assert got.diagnostics["h2d_staged_bytes"] > 0
+    assert got.diagnostics["h2d_staged_ms"] >= 0.0
+    assert got.diagnostics["h2d_blocked_ms"] >= 0.0
+
+
+def test_rmat14_device_and_ringed_builds_match_host_oracle():
+    """The acceptance shape by name: at RMAT-14, the device-stream
+    build and the ringed file-stream build at every depth D in
+    {1, 2, 3} are bit-identical to the host-path oracle."""
+    dev = generators.RmatHashStream(14, 4, seed=7)
+    es = EdgeStream.from_array(dev.read_all(), n_vertices=1 << 14)
+    oracle = TpuBackend(chunk_edges=1 << 13).partition(es, 8,
+                                                       comm_volume=False)
+    got = TpuBackend(chunk_edges=1 << 13, dispatch_batch=2,
+                     inflight=2).partition(dev, 8, comm_volume=False)
+    np.testing.assert_array_equal(got.assignment, oracle.assignment)
+    assert got.edge_cut == oracle.edge_cut
+    assert got.diagnostics["h2d_staged_bytes"] == 0
+    for depth in (1, 2, 3):
+        ringed = TpuBackend(chunk_edges=1 << 13, dispatch_batch=2,
+                            inflight=2, h2d_ring=depth).partition(
+            es, 8, comm_volume=False)
+        np.testing.assert_array_equal(ringed.assignment,
+                                      oracle.assignment)
+        assert ringed.edge_cut == oracle.edge_cut
+
+
+def test_backend_ring_on_adaptive_driver():
+    """The ring also feeds the per-segment adaptive driver (no
+    batching/pipelining) — ingestion staging is orthogonal to the
+    dispatch shape."""
+    dev, es = _streams()
+    base = TpuBackend(chunk_edges=CHUNK).partition(es, 8)
+    got = TpuBackend(chunk_edges=CHUNK, h2d_ring=3).partition(es, 8)
+    np.testing.assert_array_equal(got.assignment, base.assignment)
+    assert got.diagnostics["h2d_ring_depth"] == 3
+
+
+@pytest.mark.parametrize("inflight", [2, 3])
+def test_checkpoint_resume_through_partially_staged_ring(tmp_path,
+                                                         monkeypatch,
+                                                         inflight):
+    """Kill mid-build with ring blocks staged ahead; the abandoned
+    suppliers drain their staged HBM on unwind, and resume lands on the
+    oracle forest (the checkpoint cut never includes un-dispatched
+    staged blocks — they restream)."""
+    from sheep_tpu.utils.checkpoint import Checkpointer
+    from sheep_tpu.utils.fault import InjectedFault
+
+    dev, es = _streams(scale=11, ef=8, seed=9)
+    base = TpuBackend(chunk_edges=256).partition(es, 8)
+    ck_dir = str(tmp_path / f"ck{inflight}")
+    monkeypatch.setenv("SHEEP_FAULT_INJECT", "build:9")
+    with pytest.raises(InjectedFault):
+        TpuBackend(chunk_edges=256, dispatch_batch=2, segment_rounds=1,
+                   inflight=inflight, h2d_ring=2).partition(
+            es, 8, checkpointer=Checkpointer(ck_dir, every=4))
+    monkeypatch.delenv("SHEEP_FAULT_INJECT")
+    res = TpuBackend(chunk_edges=256, dispatch_batch=2, segment_rounds=1,
+                     inflight=inflight, h2d_ring=2).partition(
+        es, 8, checkpointer=Checkpointer(ck_dir, every=4), resume=True)
+    np.testing.assert_array_equal(res.assignment, base.assignment)
+    assert res.edge_cut == base.edge_cut
+
+
+# -- membudget + degradation ------------------------------------------------
+
+
+def test_membudget_counts_ring_staging():
+    n, cs = 1 << 20, 1 << 16
+    off = build_phase_bytes(n, cs, dispatch_batch=4)
+    two = build_phase_bytes(n, cs, dispatch_batch=4, h2d_ring=2)
+    three = build_phase_bytes(n, cs, dispatch_batch=4, h2d_ring=3)
+    assert off["h2d_ring_bytes"] == 0
+    # depth x (batch chunks x 8 bytes/edge-pair) staging, linear in D
+    assert two["h2d_ring_bytes"] == 2 * 4 * 8 * cs
+    assert three["total_bytes"] - two["total_bytes"] == 4 * 8 * cs
+    assert two["total_bytes"] == off["total_bytes"] + two["h2d_ring_bytes"]
+
+
+def test_degraded_dispatch_shrinks_ring():
+    n, cs = 1 << 20, 1 << 18
+    # nothing but the ring left to shed: it halves
+    assert degraded_dispatch(n, cs, 1, 1, h2d_ring=4) == (1, 1, 2)
+    # fully degraded: nothing left
+    assert degraded_dispatch(n, cs, 1, 1, h2d_ring=1) is None
+    # legacy pair-callers are unchanged
+    assert degraded_dispatch(n, cs, 1, 1) is None
+    assert degraded_dispatch(n, cs, 4, 2) == (2, 2)
+    # the biggest modeled term goes first: at batch 4 the staging block
+    # dwarfs a depth-2 ring, so the batch halves and the ring survives
+    nxt = degraded_dispatch(n, cs, 4, 2, h2d_ring=2)
+    assert nxt == (2, 2, 2)
+
+
+def test_backend_oom_degrades_ring(monkeypatch):
+    """An injected RESOURCE fault with batch == inflight == 1 leaves
+    only the ring to shed: the retry degrades its depth, re-folds
+    bit-identically, and the degraded knob lands in diagnostics."""
+    dev, es = _streams(scale=11, ef=8, seed=9)
+    base = TpuBackend(chunk_edges=256).partition(es, 8)
+    monkeypatch.setenv("SHEEP_FAULT_INJECT", "oom@dispatch:2")
+    monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.01")
+    res = TpuBackend(chunk_edges=256, dispatch_batch=1, inflight=2,
+                     h2d_ring=4).partition(es, 8)
+    np.testing.assert_array_equal(res.assignment, base.assignment)
+    assert res.diagnostics["dispatch_retries"] >= 1
+    assert res.diagnostics["degraded_h2d_ring"] < 4
+
+
+# -- sharded / bigv / CLI / served entry points -----------------------------
+
+
+def test_sharded_device_stream_bit_equals_host():
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if "tpu-sharded" not in list_backends():
+        pytest.skip("sharded backend unavailable")
+    dev, es = _streams(scale=11, ef=8, seed=9)
+    base = get_backend("tpu-sharded", chunk_edges=256).partition(
+        es, 8, comm_volume=False)
+    for kw in ({}, {"dispatch_batch": 2, "inflight": 2}):
+        got = get_backend("tpu-sharded", chunk_edges=256, **kw).partition(
+            dev, 8, comm_volume=False)
+        np.testing.assert_array_equal(got.assignment, base.assignment)
+        assert got.edge_cut == base.edge_cut
+        assert got.diagnostics["device_stream_chunks"] > 0
+
+
+def test_bigv_device_stream_bit_equals_host():
+    from sheep_tpu.backends.base import get_backend, list_backends
+
+    if "tpu-bigv" not in list_backends():
+        pytest.skip("bigv backend unavailable")
+    dev, es = _streams(scale=11, ef=8, seed=9)
+    base = get_backend("tpu-bigv", chunk_edges=256).partition(
+        es, 8, comm_volume=False)
+    got = get_backend("tpu-bigv", chunk_edges=256).partition(
+        dev, 8, comm_volume=False)
+    np.testing.assert_array_equal(got.assignment, base.assignment)
+    assert got.edge_cut == base.edge_cut
+    assert got.diagnostics["device_stream_chunks"] > 0
+
+
+def test_cli_device_stream_and_ring_flag(tmp_path, capsys):
+    """rmat-hash: input (device stream) and the same edges from a file
+    through --h2d-ring score identically; --h2d-ring validates."""
+    import json
+
+    from sheep_tpu.cli import main as cli_main
+    from sheep_tpu.io import formats
+
+    dev = generators.RmatHashStream(9, 4, seed=1)
+    p = tmp_path / "g.bin64"
+    formats.write_edges(str(p), dev.read_all())
+    assert cli_main(["--input", str(p), "--num-vertices", str(1 << 9),
+                     "--k", "4", "--backend", "tpu", "--json",
+                     "--chunk-edges", "128", "--dispatch-batch", "2",
+                     "--inflight", "2", "--h2d-ring", "2"]) == 0
+    ringed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert cli_main(["--input", "rmat-hash:9:4:1", "--k", "4",
+                     "--backend", "tpu", "--json",
+                     "--chunk-edges", "128", "--dispatch-batch", "2",
+                     "--inflight", "2"]) == 0
+    devline = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert devline["edge_cut"] == ringed["edge_cut"]
+    assert devline["comm_volume"] == ringed["comm_volume"]
+    with pytest.raises(SystemExit):
+        cli_main(["--input", str(p), "--k", "4", "--h2d-ring", "-1"])
+
+
+def test_served_device_stream_bit_equals_host(tmp_path):
+    """The served engine recognizes device streams: an rmat-hash job
+    equals the file-backed job of the same edges, with zero host
+    staging bytes on its stats."""
+    import threading as _threading
+    from contextlib import contextmanager
+
+    from sheep_tpu.io import formats
+    from sheep_tpu.server.protocol import JobSpec
+    from sheep_tpu.server.scheduler import Scheduler
+
+    @contextmanager
+    def running_scheduler():
+        sched = Scheduler()
+        t = _threading.Thread(target=sched.run, daemon=True)
+        t.start()
+        try:
+            yield sched
+        finally:
+            sched.shutdown()
+            t.join(timeout=30)
+
+    dev = generators.RmatHashStream(10, 8, seed=1)
+    p = tmp_path / "g.bin64"
+    formats.write_edges(str(p), dev.read_all())
+    with running_scheduler() as sched:
+        a = sched.submit(JobSpec.from_request(
+            {"input": "rmat-hash:10:8:1", "k": 4, "chunk_edges": 1024}))
+        b = sched.submit(JobSpec.from_request(
+            {"input": str(p), "k": 4, "chunk_edges": 1024,
+             "num_vertices": 1 << 10, "h2d_ring": 2}))
+        ja = sched.wait(a.id, timeout_s=240)
+        jb = sched.wait(b.id, timeout_s=240)
+    assert ja.state == "done", ja.error
+    assert jb.state == "done", jb.error
+    np.testing.assert_array_equal(ja.results[0].assignment,
+                                  jb.results[0].assignment)
+    assert ja.results[0].edge_cut == jb.results[0].edge_cut
+    assert ja.stats["h2d_staged_bytes"] == 0
+    assert ja.stats["device_stream_chunks"] > 0
+    assert jb.stats["h2d_staged_bytes"] > 0
+
+
+def test_jobspec_validates_h2d_ring():
+    from sheep_tpu.server.protocol import JobSpec, ProtocolError
+
+    with pytest.raises(ProtocolError, match="h2d_ring"):
+        JobSpec.from_request({"input": "x", "k": 4, "h2d_ring": -1})
+
+
+# -- sheeplint h2d rule -----------------------------------------------------
+
+
+_H2D_BAD = """
+import jax.numpy as jnp
+
+def f(chunks, n):
+    for c in chunks:
+        yield jnp.asarray(c)
+"""
+
+_H2D_PUT = """
+import jax
+
+def f(chunks):
+    while chunks:
+        jax.device_put(chunks.pop())
+"""
+
+
+def test_sheeplint_h2d_flags_loop_uploads():
+    assert any(f.rule == "h2d" for f in lint_source(_H2D_BAD))
+    assert any(f.rule == "h2d" for f in lint_source(_H2D_PUT))
+
+
+def test_sheeplint_h2d_pragma_and_non_loop_clean():
+    ok = _H2D_BAD.replace("jnp.asarray(c)",
+                          "jnp.asarray(c)  # sheeplint: h2d-ok")
+    assert not any(f.rule == "h2d" for f in lint_source(ok))
+    outside = """
+import jax.numpy as jnp
+
+def f(c):
+    return jnp.asarray(c)
+"""
+    assert not any(f.rule == "h2d" for f in lint_source(outside))
+    # a jnp-valued operand moves no host bytes (the sync rule's domain)
+    device_valued = """
+import jax.numpy as jnp
+
+def f(n):
+    for _ in range(n):
+        x = jnp.asarray(jnp.zeros(4))
+    return x
+"""
+    assert not any(f.rule == "h2d" for f in lint_source(device_valued))

@@ -14,7 +14,7 @@ each compared only when present in BOTH captures:
     value, vs_baseline, r_colo_est    higher is better (relative drop
                                       beyond --threshold regresses)
     host_syncs, device_rounds,        lower is better (relative rise
-    host_blocked_ms,                  beyond --threshold regresses —
+    host_blocked_ms, h2d_blocked_ms,  beyond --threshold regresses —
     warm_up_s, warm_request_s,        warm_up_s is the cold-request jit
                                       tax and warm_request_s the warm
                                       served-request wall — the pair
@@ -70,15 +70,24 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # capture retries 0 times, so ANY rise (0 -> N is gated absolutely by
 # the old==0 rule below) means the bench survived faults it used to
 # not have — visible, not silent.
+# h2d_blocked_ms (ISSUE 12) is the staged-ring underrun wall — the
+# synchronous-upload tax the ring removed; a healthy depth>=2 capture
+# holds it near 0, and the old==0 absolute rule below gates any
+# reappearance. On the timed leg's device-stream input it is exactly 0
+# (zero host bytes per chunk).
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
-                "dispatch_retries", "warm_up_s", "warm_request_s")
+                "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
+                "warm_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory
 # while only the retry count itself gates
 INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
              "inflight_depth", "inflight_discards", "device_gap_ms",
+             "h2d_staged_ms", "h2d_staged_bytes", "h2d_ring_depth",
+             "device_stream_chunks",
              "degraded_dispatch_batch", "degraded_inflight",
+             "degraded_h2d_ring",
              "device_loss_recoveries", "checkpoint_degraded",
              "cold_request_s")
 

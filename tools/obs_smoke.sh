@@ -336,7 +336,52 @@ python tools/trace_report.py "$TRACE7B" --last-errors 8 \
 grep -q "job_failed" "$OUT/report_flight.txt"
 grep -q "fault_inject" "$OUT/report_flight.txt"
 
+# eighth leg: zero-copy ingest (ISSUE 12) — (a) a host-format stream
+# through the pipelined tpu backend with an explicit staged H2D ring:
+# the trace's diagnostics must carry the new ingest counters and the
+# ringed result must bit-equal the unringed pipelined run (leg 2's
+# result, same rmat: input); (b) a device-generated rmat-hash stream
+# (a DIFFERENT generator — no cross-leg score compare; device==host
+# bit-equality is pinned by tests/test_h2d_ring.py): zero per-chunk
+# host staging bytes on the record.
+TRACE8="$OUT/trace_ring.jsonl"
+rm -f "$TRACE8"
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input rmat:10:8:1 --k 4 --backend tpu \
+    --dispatch-batch 2 --inflight 2 --h2d-ring 2 --chunk-edges 1024 \
+    --trace "$TRACE8" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_ring.json"
+python tools/trace_report.py "$TRACE8" --check > "$OUT/report_ring.txt"
+grep -q '"h2d_staged_ms"' "$TRACE8"
+grep -q '"h2d_blocked_ms"' "$TRACE8"
+grep -q '"h2d_ring_depth"' "$TRACE8"
+TRACE8B="$OUT/trace_devstream.jsonl"
+rm -f "$TRACE8B"
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input rmat-hash:10:8:1 --k 4 --backend tpu \
+    --dispatch-batch 2 --inflight 2 --chunk-edges 1024 \
+    --trace "$TRACE8B" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_devstream.json"
+python tools/trace_report.py "$TRACE8B" --check > "$OUT/report_devstream.txt"
+grep -q '"device_stream_chunks"' "$TRACE8B"
+python - "$OUT/result_inflight.json" "$OUT/result_ring.json" "$TRACE8B" <<'PYEOF'
+import json
+import sys
+
+ringed = json.load(open(sys.argv[2]))
+base = json.load(open(sys.argv[1]))
+assert ringed["edge_cut"] == base["edge_cut"], (base, ringed)
+for line in open(sys.argv[3]):
+    rec = json.loads(line)
+    if rec.get("event") == "diagnostics":
+        assert rec.get("h2d_staged_bytes") == 0, rec
+        assert rec.get("device_stream_chunks", 0) > 0, rec
+        break
+else:
+    raise SystemExit("no diagnostics record in the device-stream trace")
+PYEOF
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8"
