@@ -102,6 +102,9 @@ class CpuBackend(Partitioner):
         sp = obs.begin("split")
         w = deg.astype(np.float64) if weights == "degree" else None
         assignment = native.tree_split(parent, pos, k, weights=w, alpha=self.alpha)
+        from sheep_tpu.ops.split import account_split
+
+        account_split(assignment, k, w, self.alpha)
         t["split"] = time.perf_counter() - t0
         sp.end()
 

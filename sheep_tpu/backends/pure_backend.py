@@ -73,6 +73,9 @@ class PureBackend(Partitioner):
         sp = obs.begin("split")
         w = deg if weights == "degree" else None
         assignment = pure.tree_split(tree, k, w, alpha=self.alpha)
+        from sheep_tpu.ops.split import account_split
+
+        account_split(assignment, k, w, self.alpha)
         t["split"] = time.perf_counter() - t0
         sp.end()
 

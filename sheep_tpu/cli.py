@@ -57,11 +57,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "level 0, level-boundary for the recursion) and "
                         "with multi-host flags (level 0 is an ordinary "
                         "flat partition)")
-    p.add_argument("--final-refine", type=int, default=0, metavar="N",
-                   help="with --k-levels: N warm-start LP rounds at the "
-                        "FULL k after hierarchical assembly (level-1 "
-                        "leakage repair; the LP signal objection applies "
-                        "to cold starts only)")
+    p.add_argument("--final-refine", type=int, default=None, metavar="N",
+                   help="with --k-levels (or --auto-recipe): N "
+                        "warm-start LP rounds at the FULL k after "
+                        "hierarchical assembly (level-1 leakage repair; "
+                        "the LP signal objection applies to cold starts "
+                        "only)")
+    p.add_argument("--auto-recipe", action="store_true",
+                   help="let the quality advisor pick the hierarchy "
+                        "recipe when the intra-degree/k signal says flat "
+                        "label propagation will stall at --k (below the "
+                        "measured threshold a naive --k 64 --refine 30 "
+                        "silently lands ~0.85 cut on community graphs "
+                        "where the recipe lands ~0.13). Without this "
+                        "flag the advisor only prints its "
+                        "recommendation; with it, the run becomes the "
+                        "exact --k-levels/--final-refine/--balance "
+                        "invocation it prints — bit-identical to "
+                        "passing those flags by hand")
     p.add_argument("--spill-dir", default=None, metavar="DIR",
                    help="with --k-levels: where per-part intra-edge "
                         "shards spill (default: system temp). Disk "
@@ -496,7 +509,7 @@ def _run(parser, args) -> int:
             refine_alpha=args.refine_alpha,
             chunk_edges=args.chunk_edges or (1 << 22),
             comm_volume=not args.no_comm_volume, weights=args.weights,
-            balance=args.balance, final_refine=args.final_refine,
+            balance=args.balance, final_refine=args.final_refine or 0,
             spill_dir=args.spill_dir, n_vertices=args.num_vertices,
             refine_budget_bytes=int(args.refine_budget_gb * (1 << 30)),
             **ckpt_kw,
@@ -530,11 +543,20 @@ def _run(parser, args) -> int:
         if args.score_only:
             build_parser().error("--k-levels does not combine with "
                                  "--score-only")
+        if args.auto_recipe:
+            build_parser().error("--auto-recipe asks the advisor to "
+                                 "pick the levels; it replaces "
+                                 "--k-levels")
         return _k_levels(args)
-    if args.final_refine or args.spill_dir:
+    if (args.final_refine and not args.auto_recipe) or args.spill_dir:
         build_parser().error("--final-refine/--spill-dir require "
                              "--k-levels (the flat pipeline has no "
-                             "hierarchy to repair or spill)")
+                             "hierarchy to repair or spill; "
+                             "--final-refine also composes with "
+                             "--auto-recipe)")
+    if args.auto_recipe and args.score_only:
+        build_parser().error("--auto-recipe has no effect with "
+                             "--score-only (nothing is partitioned)")
     if args.score_only:
         if args.balance is not None:
             build_parser().error("--balance has no effect with "
@@ -570,6 +592,121 @@ def _run(parser, args) -> int:
     if args.carry_tail and args.tail_overlap:
         build_parser().error("--carry-tail and --tail-overlap are mutually "
                              "exclusive tail strategies")
+    if args.auto_recipe and len(ks) > 1:
+        build_parser().error("--auto-recipe takes a single --k (the "
+                             "recipe is per target k)")
+    if args.auto_recipe:
+        # flags a --k-levels run cannot honor are rejected UP FRONT:
+        # letting them through would make the same command line a
+        # usage error or not depending on the input's degree signal
+        # (and the eventual error would name --k-levels, a flag the
+        # user never passed)
+        unsupported = [f for f, v in (
+            ("--metrics-out", args.metrics_out),
+            ("--profile-dir", args.profile_dir),
+            ("--segment-rounds", args.segment_rounds),
+            ("--warm-schedule", args.warm_schedule),
+            ("--host-tail-threshold", args.host_tail_threshold),
+            ("--no-cache-chunks", args.no_cache_chunks or None),
+            ("--carry-tail", args.carry_tail),
+            ("--tail-overlap", args.tail_overlap),
+            ("--stale-reuse", args.stale_reuse),
+            ("--dispatch-batch", args.dispatch_batch),
+            ("--inflight", args.inflight),
+            ("--h2d-ring", args.h2d_ring),
+            ("--lift-levels", args.lift_levels),
+            ("--jumps", args.jumps),
+            ("--hoist-bytes", args.hoist_bytes),
+        ) if v is not None]
+        if unsupported:
+            build_parser().error(
+                f"{', '.join(unsupported)} not supported with "
+                f"--auto-recipe (the applied recipe is a --k-levels "
+                f"run, which does not take them)")
+
+    # ---- quality advisor (ISSUE 13) ----------------------------------
+    # The degree pass's cheapest statistic (2E/V, O(1) for binary and
+    # synthetic inputs) prices the LP signal BEFORE any device work: a
+    # naive flat --k below the threshold silently lands an ~0.85-class
+    # cut on community graphs where the three-flag hierarchy recipe
+    # lands ~0.13 — so the tool now SAYS so, and --auto-recipe makes
+    # the run the exact recipe invocation it prints (bit-identical to
+    # the manual flags by construction: same code path, same knobs).
+    if len(ks) == 1 and not args.score_only:
+        advice = None
+        try:
+            with open_input(args.input,
+                            n_vertices=args.num_vertices) as es0:
+                from sheep_tpu.ops.degrees import advise_recipe
+
+                m = es0.num_edges_cheap
+                # the signal must stay O(1): never pay a stream scan
+                # just to advise. num_edges_cheap is O(1) or None by
+                # contract, but num_vertices SCANS the file for
+                # binary/text inputs unless the caller supplied it —
+                # synthetic/memory streams (no path) and CSR headers
+                # are arithmetic, and an already-known _n_vertices
+                # (--num-vertices) is free.
+                cheap_v = (getattr(es0, "path", None) is None
+                           or getattr(es0, "fmt", None) == "csr"
+                           or getattr(es0, "_n_vertices", None)
+                           is not None)
+                if m is not None and cheap_v:
+                    advice = advise_recipe(es0.num_vertices, m, args.k)
+                else:
+                    advice = {"mode": "unknown", "signal": None,
+                              "k": args.k}
+        except (OSError, ValueError):
+            pass  # unopenable input: the main path raises the real error
+        # mirror the trace gating: print on rank 0, and not at all on
+        # rank-autodetected launches (every rank would print)
+        adv_main = args.process_id == 0 or (
+            args.process_id is None
+            and not (args.coordinator or args.num_processes))
+        if advice is not None and advice["mode"] == "hier":
+            lv = ",".join(str(x) for x in advice["k_levels"])
+            # `is None` tests: an EXPLICIT --final-refine 0 /
+            # --balance must survive into the applied recipe
+            fr = advice["final_refine"] if args.final_refine is None \
+                else args.final_refine
+            bal = args.balance if args.balance is not None \
+                else advice["balance"]
+            flags = f"--k-levels {lv} --final-refine {fr} --balance {bal}"
+            if args.refine is not None:
+                flags += f" --refine {args.refine}"
+            if adv_main:
+                print(f"note: quality advisor: intra-degree/k signal "
+                      f"{advice['signal']:.2f} < "
+                      f"{advice['threshold']:.2f} at k={args.k} — flat "
+                      f"label propagation stalls below the signal "
+                      f"threshold (BASELINE.md 'SBM quality'); "
+                      f"recommended recipe: {flags}"
+                      + ("" if args.auto_recipe else
+                         "  (pass --auto-recipe to apply)"),
+                      file=sys.stderr)
+            if args.auto_recipe:
+                args.k_levels = lv
+                args.k = None
+                args.final_refine = fr
+                args.balance = bal
+                return _k_levels(args)
+        elif args.auto_recipe and adv_main:
+            if advice is None or advice.get("signal") is None:
+                why = ("the stream's size is not O(1)-knowable (text "
+                       "inputs, or binary without --num-vertices), so "
+                       "the signal is unknown")
+            elif advice["signal"] >= advice["threshold"]:
+                why = (f"signal {advice['signal']:.2f} >= "
+                       f"{advice['threshold']:.2f} (flat LP is fine)")
+            else:
+                why = (f"signal {advice['signal']:.2f} is low but "
+                       f"k={args.k} has no usable level split (prime "
+                       f"past the per-level cap)")
+            print(f"note: quality advisor: {why}; running the flat "
+                  f"path as asked"
+                  + (" (--final-refine only applies when the advisor "
+                     "selects a hierarchy; ignored)"
+                     if args.final_refine else ""), file=sys.stderr)
 
     is_main = True
     process_id = 0

@@ -60,8 +60,59 @@ from contextlib import nullcontext
 
 import numpy as np
 
+from sheep_tpu import obs
+
 
 _SPILL_MAX_FDS = 64
+
+
+def level_ledger(stream, final, k_levels, edge_cut: int, total: int,
+                 chunk_edges: int = 1 << 22) -> list:
+    """Per-level cut attribution of a hierarchical assignment — the cut
+    LEDGER (ISSUE 13): row d counts the edges whose endpoint labels
+    first diverge at level d (level 0 = between top-level parts: the
+    FRAGMENTATION term; level d > 0 = inside one level-(d-1) part but
+    between its subparts: the MISASSIGNMENT terms). Rows sum exactly to
+    the final edge cut, so "where does the residual to planted live" is
+    answerable per level instead of as one opaque number.
+
+    Computed with one extra stream pass scoring the level-PREFIX label
+    projections ``final // prod(k_levels[d+1:])`` (cut-only, no comm
+    volume) — the deepest prefix is the final assignment itself, whose
+    cut the caller already holds. Levels with k = 1 contribute nothing
+    and are folded into their parent row."""
+    from sheep_tpu.backends.base import score_stream
+
+    rows = []
+    to_score = {}
+    kp = 1
+    suffix = int(np.prod(k_levels))
+    for d, kd in enumerate(k_levels):
+        kp *= int(kd)
+        suffix //= int(kd)
+        if kd <= 1:
+            continue
+        rows.append({"level": d, "k": kp})
+        if suffix > 1:  # the deepest prefix IS final: cut known
+            to_score[kp] = (np.asarray(final, np.int64)
+                            // suffix).astype(np.int32)
+    if not rows:
+        rows = [{"level": 0, "k": kp}]
+    if to_score:
+        scored = score_stream(stream, to_score, chunk_edges=chunk_edges,
+                              comm_volume=False)
+        cum = {k: scored[k][0] for k in to_score}
+    else:
+        cum = {}
+    cum[kp] = int(edge_cut)
+    prev = 0
+    for row in rows:
+        c = int(cum.get(row["k"], edge_cut))
+        row["cut"] = c - prev
+        row["cut_ratio"] = round(row["cut"] / max(total, 1), 6)
+        row["cut_cum"] = c
+        prev = c
+    return rows
 
 
 def _spill_intra(stream, assign, k1, chunk_edges, tmpdir, local_id):
@@ -243,12 +294,13 @@ def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
         # score recomputes it once); chunk_edges forwards as the backends'
         # ctor option so the user's memory ceiling applies at every level
         with fault.scope("level0") if depth == 0 else nullcontext():
-            res = _partition_stream(
-                stream, k1, backend=backend, refine=refine,
-                refine_alpha=refine_alpha, chunk_edges=chunk_edges,
-                **{**opts, "comm_volume": False},
-                **({"checkpointer": level0_ck, "resume": resume}
-                   if level0_ck is not None else {}))
+            with obs.span("hier_partition", level=depth, k=k1):
+                res = _partition_stream(
+                    stream, k1, backend=backend, refine=refine,
+                    refine_alpha=refine_alpha, chunk_edges=chunk_edges,
+                    **{**opts, "comm_volume": False},
+                    **({"checkpointer": level0_ck, "resume": resume}
+                       if level0_ck is not None else {}))
         assign = np.asarray(res.assignment, np.int32)
         t_add(f"level{depth}_partition", time.perf_counter() - t0)
     if len(k_levels) == 1:
@@ -270,8 +322,12 @@ def _hier_assign(stream, k_levels, backend, refine, refine_alpha,
         else:
             os.makedirs(level_dir, exist_ok=True)
         t0 = time.perf_counter()
-        paths = _spill_intra(stream, assign, k1, chunk_edges, level_dir,
-                             local_id)
+        sp = obs.begin("hier_spill", level=depth, parts=k1)
+        try:
+            paths = _spill_intra(stream, assign, k1, chunk_edges,
+                                 level_dir, local_id)
+        finally:
+            sp.end()
         t_add(f"level{depth}_spill", time.perf_counter() - t0)
         if spill_bytes is not None:
             key = f"level{depth}_spill_bytes"
@@ -503,6 +559,52 @@ def partition_hierarchical(path, k_levels, backend=None, refine=8,
                             chunk_edges))
                     res.phase_times["comm_volume"] = round(
                         time.perf_counter() - t0, 3)
+            # ---- cut ledger (ISSUE 13) -------------------------------
+            # Per-level attribution of the FINAL cut (post-refine when a
+            # final refine ran: the ledger must price what shipped, not
+            # an intermediate), plus capacity-freeze accounting at the
+            # full k — one extra cut-only stream pass, the price of
+            # turning one opaque number into a per-level diagnosis.
+            t0 = time.perf_counter()
+            ledger = level_ledger(es, res.assignment, k_levels,
+                                  res.edge_cut, res.total_edges,
+                                  chunk_edges=chunk_edges)
+            from sheep_tpu.ops.score import part_loads_accounting
+
+            alpha_rep = balance if balance is not None else refine_alpha
+            cap = (alpha_rep * (-(-len(res.assignment) // k_total))
+                   if w is None else
+                   alpha_rep * float(np.sum(w)) / k_total)
+            acct = part_loads_accounting(res.assignment, k_total,
+                                         weights=w, cap=cap)
+            for row in ledger:
+                res.diagnostics[f"cut_level{row['level']}"] = row["cut"]
+                res.diagnostics[f"cut_ratio_level{row['level']}"] = \
+                    row["cut_ratio"]
+            res.diagnostics["ledger_parts_at_capacity"] = \
+                acct["parts_at_capacity"]
+            res.diagnostics["ledger_frozen_load_fraction"] = \
+                acct["frozen_load_fraction"]
+            repaired = None
+            if final_refine:
+                before = res.diagnostics.get("refine_cut_before")
+                after = res.diagnostics.get("refine_cut_after")
+                if before is not None and after is not None:
+                    repaired = int(before - after)
+                    res.diagnostics["final_refine_repaired"] = repaired
+            timings["ledger"] = round(time.perf_counter() - t0, 3)
+            obs.event(
+                "quality_ledger", k=k_total,
+                k_levels=[int(x) for x in k_levels],
+                edge_cut=int(res.edge_cut),
+                total_edges=int(res.total_edges),
+                cut_ratio=round(float(res.cut_ratio), 6),
+                balance=round(float(res.balance), 4),
+                levels=[{kk: int(v) if kk != "cut_ratio" else v
+                         for kk, v in row.items()} for row in ledger],
+                final_refine_repaired=repaired,
+                parts_at_capacity=acct["parts_at_capacity"],
+                frozen_load_fraction=acct["frozen_load_fraction"])
             if checkpointer is not None:
                 # success: drop the boundary state, the nested level-0
                 # domain, and the persistent spill root (the flat

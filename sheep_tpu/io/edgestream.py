@@ -590,6 +590,15 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
       BLOCKS power-of-two ground-truth communities, inter-block edge
       fraction POUT (a float) — known-optimal-cut quality evaluation at
       arbitrary scale.
+    - ``plsbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]]`` — the planted
+      partition with POWER-LAW within-block degrees
+      (:class:`~sheep_tpu.io.generators.PowerlawSbmHashStream`).
+    - ``bipartite-hash:SCALE:BLOCKS:POUT[:EF[:SEED]]`` — planted
+      BIPARTITE communities, every edge crossing the two vertex halves
+      (:class:`~sheep_tpu.io.generators.BipartiteHashStream`).
+    - ``nearclique-hash:SCALE:CLIQUE_BITS:POUT[:EF[:SEED]]`` — dense
+      near-clique blocks of 2**CLIQUE_BITS vertices
+      (:class:`~sheep_tpu.io.generators.NearCliqueStream`).
 
     Anything else is treated as a path (format by extension). A
     user-supplied ``n_vertices`` must not contradict a synthetic spec's
@@ -597,24 +606,30 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
     """
     spec = os.fspath(spec)  # pathlib.Path inputs flow through unchanged
     kind, _, rest = spec.partition(":")
-    if kind == "sbm-hash" and rest:
+    # the planted-structure family shares one SCALE:ARG:POUT[:EF[:SEED]]
+    # grammar; ARG is the second structural knob of each class
+    planted = {"sbm-hash": ("BLOCKS", "SbmHashStream"),
+               "plsbm-hash": ("BLOCKS", "PowerlawSbmHashStream"),
+               "bipartite-hash": ("BLOCKS", "BipartiteHashStream"),
+               "nearclique-hash": ("CLIQUE_BITS", "NearCliqueStream")}
+    if kind in planted and rest:
         from sheep_tpu.io import generators
 
+        argname, clsname = planted[kind]
+        shape = f"{kind}:SCALE:{argname}:POUT[:EF[:SEED]]"
         parts = rest.split(":")
         if not 3 <= len(parts) <= 5:
             raise ValueError(
-                f"bad synthetic input spec {spec!r}; want "
-                f"sbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]]")
+                f"bad synthetic input spec {spec!r}; want {shape}")
         try:
-            scale, blocks = int(parts[0]), int(parts[1])
+            scale, arg = int(parts[0]), int(parts[1])
             p_out = float(parts[2])
             ef = int(parts[3]) if len(parts) > 3 else 16
             seed = int(parts[4]) if len(parts) > 4 else 0
         except ValueError:
             raise ValueError(
-                f"bad synthetic input spec {spec!r}; want "
-                f"sbm-hash:SCALE:BLOCKS:POUT[:EF[:SEED]] (POUT a float, "
-                f"the rest integers)")
+                f"bad synthetic input spec {spec!r}; want {shape} "
+                f"(POUT a float, the rest integers)")
         if not (1 <= scale <= 31) or ef < 1:
             raise ValueError(f"bad synthetic input spec {spec!r}: "
                              f"need 1 <= SCALE <= 31 and EF >= 1")
@@ -622,9 +637,9 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
             raise ValueError(
                 f"--num-vertices {n_vertices} contradicts {spec!r} "
                 f"(2**{scale} = {1 << scale} vertices)")
-        # blocks/p_out range checks live in SbmHashStream
-        return generators.SbmHashStream(scale, blocks, p_out,
-                                        edge_factor=ef, seed=seed)
+        # blocks/clique_bits/p_out range checks live in each class
+        return getattr(generators, clsname)(scale, arg, p_out,
+                                            edge_factor=ef, seed=seed)
     if kind in ("rmat-hash", "rmat") and rest:
         from sheep_tpu.io import generators
 

@@ -460,9 +460,11 @@ def _sbm_hash_keys(seed: int):
     return [_mix32_int(s + 0x9E3779B9 * (f + 1)) for f in range(5)]
 
 
-def _sbm_hash_uv(xp, elo, ehi, keys, t_out, n_blocks, block_bits, dtype):
-    """Shared numpy/jnp body: edge-counter words -> (u, v). All uint32
-    wraparound arithmetic, so host and device bits agree exactly."""
+def _hash_fields(xp, elo, ehi, keys):
+    """Per-key independent 32-bit uniforms for one edge-counter word
+    pair — the shared field-hash loop of every counter-hash stream
+    (murmur3 fmix32 over elo ^ key, folded with ehi mid-mix). All
+    uint32 wraparound arithmetic: numpy and jnp agree bit-exactly."""
     fields = []
     for key, key2 in zip(keys, _rmat_hash_keys2(keys)):
         h = elo ^ xp.uint32(key)
@@ -473,7 +475,13 @@ def _sbm_hash_uv(xp, elo, ehi, keys, t_out, n_blocks, block_bits, dtype):
         h = h * xp.uint32(0xC2B2AE35)
         h = h ^ (h >> xp.uint32(16))
         fields.append(h)
-    h_cross, h_bu, h_bv, h_uo, h_vo = fields
+    return fields
+
+
+def _sbm_hash_uv(xp, elo, ehi, keys, t_out, n_blocks, block_bits, dtype):
+    """Shared numpy/jnp body: edge-counter words -> (u, v). All uint32
+    wraparound arithmetic, so host and device bits agree exactly."""
+    h_cross, h_bu, h_bv, h_uo, h_vo = _hash_fields(xp, elo, ehi, keys)
     cross = h_cross < xp.uint32(t_out)
     bu = h_bu & xp.uint32(n_blocks - 1)
     # distinct second block: draw from [0, n_blocks-1) and skip past bu
@@ -612,10 +620,24 @@ class SbmHashStream(DeviceStream, _CounterHashStream):
         blocks = np.arange(self._n, dtype=np.int64) >> self.block_bits
         return (blocks // per).astype(np.int32)
 
-    def planted_cut_ratio(self) -> float:
+    def planted_cut_ratio(self, k: int | None = None) -> float:
         """The exact expected cut ratio of the planted partition at
-        k = n_blocks (cross edges are inter-block by construction)."""
-        return _sbm_t_out(self.p_out) / 4294967296.0
+        ``k`` parts (default: one part per block, where cross edges are
+        inter-block by construction). At a GROUPED ``k`` (n_blocks/k
+        consecutive blocks per part — :meth:`ground_truth`'s grouping) a
+        cross edge stays intra-part when its distinct second block lands
+        in the same group: probability (per - 1)/(n_blocks - 1), so the
+        grouped planted ratio is p * (n_blocks - per)/(n_blocks - 1).
+        This is the per-level optimum the cut ledger's level-0 row is
+        measured against (ISSUE 13)."""
+        p = _sbm_t_out(self.p_out) / 4294967296.0
+        if k is None or k == self.n_blocks:
+            return p
+        if k < 1 or self.n_blocks % k:
+            raise ValueError(f"k must divide n_blocks={self.n_blocks}, "
+                             f"got {k}")
+        per = self.n_blocks // k
+        return p * (self.n_blocks - per) / max(self.n_blocks - 1, 1)
 
     # -- device fast path ---------------------------------------------------
     def device_chunk(self, idx: int, chunk_edges: int, n: int):
@@ -626,3 +648,189 @@ class SbmHashStream(DeviceStream, _CounterHashStream):
             (np.uint32(start & _M32), np.uint32(start >> 32)), count, cs,
             tuple(_sbm_hash_keys(self.seed)), _sbm_t_out(self.p_out),
             self.n_blocks, self.block_bits, n)
+
+
+# ---------------------------------------------------------------------------
+# Quality-scenario streams (ISSUE 13): the quality CI gate sweeps graph
+# CLASSES, not one generator — bipartite, near-clique and power-law-
+# degree community structure each stress a different partitioner
+# behavior (2PS picks its strategy from exactly these degree/structure
+# signals). All three are counter-hash streams like the SBM above:
+# random-access chunks, deterministic under a seed, planted ground
+# truth where one exists.
+# ---------------------------------------------------------------------------
+
+
+class NearCliqueStream(SbmHashStream):
+    """Planted NEAR-CLIQUE communities: 2**scale vertices in dense
+    blocks of ``2**clique_bits`` vertices, each edge intra-clique with
+    probability ``1 - p_out``. Structurally this IS the planted
+    partition with n_blocks = 2**(scale - clique_bits) — the point is
+    the REGIME: with edge_factor around 2**(clique_bits - 1) each block
+    approaches clique density (~ef * 2**clique_bits intra edges against
+    ~2**(2*clique_bits - 1) pairs), the near-clique scenario the
+    quality gate needs (a partitioner that shatters cliques shows up
+    immediately in the cut). Reuses the SBM hash body wholesale, so the
+    device fast path and ground truth come for free and stay
+    bit-identical to the host chunks."""
+
+    def __init__(self, scale: int, clique_bits: int, p_out: float = 0.01,
+                 edge_factor: int = 8, seed: int = 0):
+        cb = int(clique_bits)
+        if not (1 <= cb < int(scale)):
+            raise ValueError(f"clique_bits must be in [1, scale), got "
+                             f"{clique_bits}")
+        super().__init__(scale, 1 << (int(scale) - cb), p_out,
+                         edge_factor, seed=seed)
+        self.clique_bits = cb
+
+    def content_fingerprint(self) -> str:
+        return self._fingerprint(
+            f"nearclique_hash/s{self.scale}/c{self.clique_bits}/"
+            f"p{self.p_out}/ef{self.edge_factor}/{self.seed}/")
+
+
+class PowerlawSbmHashStream(_CounterHashStream):
+    """Planted partition with POWER-LAW within-block degrees: block
+    choice is the SBM's (cross with probability ``p_out``, distinct
+    second block), but the within-block vertex offsets come from the
+    R-MAT recursive bit walk over ``block_bits`` levels instead of a
+    uniform draw — so every block has Graph500-shaped hubs while the
+    planted cut stays exactly Bernoulli(p_out). This is the
+    "power-law SBM" scenario of the quality gate: LP refinement sees
+    hub-dominated majorities where the flat SBM sees uniform ones, and
+    a recipe that only works on flat degree distributions fails here
+    first (the 2PS observation, inverted)."""
+
+    def __init__(self, scale: int, n_blocks: int = 16,
+                 p_out: float = 0.05, edge_factor: int = 16,
+                 seed: int = 0,
+                 a: float = 0.57, b: float = 0.19, c: float = 0.19):
+        if not (1 <= scale <= 31):
+            raise ValueError(f"plsbm-hash scale must be 1..31, got {scale}")
+        nb = int(n_blocks)
+        if nb < 2 or nb & (nb - 1) or nb > (1 << (scale - 1)):
+            # nb == 2**scale would leave block_bits == 0 (no offset
+            # walk at all); require at least 2 vertices per block
+            raise ValueError(f"n_blocks must be a power of two in "
+                             f"[2, 2**(scale-1)], got {n_blocks}")
+        if not (0.0 <= p_out <= 1.0):
+            raise ValueError(f"p_out must be in [0, 1], got {p_out}")
+        self.scale = int(scale)
+        self.n_blocks = nb
+        self.block_bits = self.scale - (nb.bit_length() - 1)
+        self.p_out = float(p_out)
+        self.edge_factor = int(edge_factor)
+        self.seed = int(seed)
+        self.abc = (float(a), float(b), float(c))
+        self._m = self.edge_factor << self.scale
+        self._n = 1 << self.scale
+
+    def _range(self, start: int, count: int) -> np.ndarray:
+        idx = start + np.arange(count, dtype=np.int64)
+        elo = (idx & _M32).astype(np.uint32)
+        ehi = (idx >> 32).astype(np.uint32)
+        # block fields: the SBM draw (seed-distinct from the offset keys)
+        keys = _sbm_hash_keys(self.seed)
+        h_cross, h_bu, h_bv = _hash_fields(np, elo, ehi, keys[:3])
+        cross = h_cross < np.uint32(_sbm_t_out(self.p_out))
+        bu = h_bu & np.uint32(self.n_blocks - 1)
+        bvr = h_bv % np.uint32(self.n_blocks - 1)
+        bv = bvr + (bvr >= bu).astype(np.uint32)
+        b2 = np.where(cross, bv, bu)
+        # within-block offsets: the R-MAT bit walk over block_bits
+        # levels (distinct key schedule so offsets decorrelate from the
+        # block fields)
+        okeys = _rmat_hash_keys(self.block_bits,
+                                _mix32_int(self.seed ^ 0x6A09E667))
+        th = _rmat_hash_thresholds(*self.abc)
+        uo, vo = _rmat_hash_uv(np, elo, ehi, okeys, th, np.uint32)
+        u = (bu << np.uint32(self.block_bits)) | uo
+        v = (b2 << np.uint32(self.block_bits)) | vo
+        return np.stack([u.astype(np.int64), v.astype(np.int64)], axis=1)
+
+    def content_fingerprint(self) -> str:
+        return self._fingerprint(
+            f"plsbm_hash/s{self.scale}/b{self.n_blocks}/p{self.p_out}/"
+            f"ef{self.edge_factor}/{self.abc}/{self.seed}/")
+
+    ground_truth = SbmHashStream.ground_truth
+    planted_cut_ratio = SbmHashStream.planted_cut_ratio
+
+
+class BipartiteHashStream(_CounterHashStream):
+    """Planted BIPARTITE communities: 2**scale vertices split into a
+    left half [0, n/2) and a right half [n/2, n); every edge crosses
+    the halves (no intra-side edges, ever). ``n_blocks`` planted
+    bi-communities each own one contiguous left segment and the
+    matching right segment; an edge joins its block's two sides with
+    probability ``1 - p_out`` and a distinct block's right side
+    otherwise — so the planted cut at k = n_blocks is exactly
+    Bernoulli(p_out), like the SBM, but every neighborhood is
+    one-sided. This is the quality gate's bipartite scenario: degree
+    signals that implicitly assume triangles/within-part edges (an LP
+    majority over SAME-side neighbors, for one) get zero help here."""
+
+    def __init__(self, scale: int, n_blocks: int = 8,
+                 p_out: float = 0.02, edge_factor: int = 16,
+                 seed: int = 0):
+        if not (2 <= scale <= 31):
+            raise ValueError(f"bipartite-hash scale must be 2..31, "
+                             f"got {scale}")
+        nb = int(n_blocks)
+        half = 1 << (int(scale) - 1)
+        if nb < 2 or nb & (nb - 1) or nb > half:
+            raise ValueError(f"n_blocks must be a power of two in "
+                             f"[2, 2**(scale-1)], got {n_blocks}")
+        if not (0.0 <= p_out <= 1.0):
+            raise ValueError(f"p_out must be in [0, 1], got {p_out}")
+        self.scale = int(scale)
+        self.n_blocks = nb
+        # per-SIDE block span: half / n_blocks vertices
+        self.block_bits = (self.scale - 1) - (nb.bit_length() - 1)
+        self.p_out = float(p_out)
+        self.edge_factor = int(edge_factor)
+        self.seed = int(seed)
+        self._m = self.edge_factor << self.scale
+        self._n = 1 << self.scale
+
+    def _range(self, start: int, count: int) -> np.ndarray:
+        idx = start + np.arange(count, dtype=np.int64)
+        elo = (idx & _M32).astype(np.uint32)
+        ehi = (idx >> 32).astype(np.uint32)
+        keys = _sbm_hash_keys(_mix32_int(self.seed ^ 0x3C6EF372))
+        h_cross, h_bu, h_bv, h_uo, h_vo = _hash_fields(np, elo, ehi, keys)
+        cross = h_cross < np.uint32(_sbm_t_out(self.p_out))
+        bu = h_bu & np.uint32(self.n_blocks - 1)
+        bvr = h_bv % np.uint32(self.n_blocks - 1)
+        bv = bvr + (bvr >= bu).astype(np.uint32)
+        b2 = np.where(cross, bv, bu)
+        off_mask = np.uint32((1 << self.block_bits) - 1)
+        half = np.int64(self._n >> 1)
+        u = (bu.astype(np.int64) << self.block_bits) \
+            | (h_uo & off_mask).astype(np.int64)
+        v = half + ((b2.astype(np.int64) << self.block_bits)
+                    | (h_vo & off_mask).astype(np.int64))
+        return np.stack([u, v], axis=1)
+
+    def content_fingerprint(self) -> str:
+        return self._fingerprint(
+            f"bipartite_hash/s{self.scale}/b{self.n_blocks}/"
+            f"p{self.p_out}/ef{self.edge_factor}/{self.seed}/")
+
+    def ground_truth(self, k: int | None = None) -> np.ndarray:
+        """Planted assignment at ``k`` parts (default: one per
+        bi-community). Each part takes a block's left AND right
+        segments, so the planted partition never cuts the half
+        boundary structure itself."""
+        k = self.n_blocks if k is None else int(k)
+        if k < 1 or self.n_blocks % k:
+            raise ValueError(f"k must divide n_blocks={self.n_blocks}, "
+                             f"got {k}")
+        per = self.n_blocks // k
+        half = self._n >> 1
+        side_off = np.arange(self._n, dtype=np.int64) % half
+        blocks = side_off >> self.block_bits
+        return (blocks // per).astype(np.int32)
+
+    planted_cut_ratio = SbmHashStream.planted_cut_ratio

@@ -46,6 +46,19 @@ DEFAULT_LATENCY_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
     10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
+# Cut-ratio buckets for the sheep_quality_* histograms (ISSUE 13):
+# log-ish spacing over [0, 1] — planted-recovery cuts live at 0.01-0.1,
+# expander cuts at 0.9+, and the interesting regressions are small
+# relative moves near the bottom. Fixed for the same merge reason.
+DEFAULT_RATIO_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.4,
+    0.6, 0.8, 0.95)
+
+# Balance buckets: 1.0 is perfect, the --balance contract band is
+# 1.05-1.3, and past 2 the split is degenerate.
+DEFAULT_BALANCE_BUCKETS = (
+    1.01, 1.02, 1.05, 1.1, 1.2, 1.3, 1.5, 2.0, 3.0, 5.0)
+
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
 

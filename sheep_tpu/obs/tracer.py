@@ -168,6 +168,15 @@ class Span:
         self._t0 = time.perf_counter()
         return self
 
+    def annotate(self, **attrs) -> None:
+        """Attach attributes to a RUNNING span so its span_end record
+        carries them (the span_start already went out). The quality
+        ledger's use case (ISSUE 13): the refine span learns its
+        starting cut on the first scoring pass, rounds before the span
+        closes — annotate-then-end puts the number on the interval it
+        belongs to instead of threading it to the end() call site."""
+        self.attrs.update(attrs)
+
     def end(self, **extra) -> None:
         if self._done or self.id is None:
             return
@@ -203,6 +212,9 @@ class NullSpan:
 
     def start(self) -> "NullSpan":
         return self
+
+    def annotate(self, **attrs) -> None:
+        pass
 
     def end(self, **extra) -> None:
         pass

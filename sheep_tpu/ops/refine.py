@@ -192,6 +192,22 @@ def plan_moves_host(best: np.ndarray, gain: np.ndarray, assign: np.ndarray,
     return np.where(allowed, best, cur).astype(np.int32)
 
 
+def _move_accounting(gain, before, after, parity: int, n: int):
+    """(wanted, applied) of one half-round, for the quality ledger
+    (ISSUE 13): ``wanted`` counts positive-gain movers of the active
+    parity — vertices whose neighbor majority says "move"; ``applied``
+    counts labels that actually changed. applied <= wanted always (the
+    planner only ever accepts wanting movers), so wanted - applied is
+    exactly the CAPACITY-BLOCKED count: repair the balance cap refused.
+    Two small designed pulls per half-round — noise next to the O(E)
+    stream pass each half-round already paid."""
+    g = np.asarray(gain)  # sheeplint: sync-ok (ledger pull)
+    vid = np.arange(g.shape[0])
+    wanted = int(((g > 0) & (vid < n) & ((vid % 2) == parity)).sum())
+    applied = int(np.asarray(before != after).sum())  # sheeplint: sync-ok
+    return wanted, applied
+
+
 def spool_stream(stream, n: int, chunk_edges: int = 1 << 22,
                  spool_dir: str = None):
     """Materialize a regeneration-expensive stream to a temp binary file
@@ -404,31 +420,84 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
     # keeps a dedicated 1-pass score (a histogram "pass" there costs
     # ``blocks`` stream passes, so fusing would REGRESS pass counts —
     # review finding) for the same 1 + R*(2*blocks + 1) as before.
+    from sheep_tpu import obs
+
     stats = {"refine_rounds_run": 0,
              "refine_hist_blocks": -(-(n + 1) // vb) if vb else 1,
-             "refine_host_plan": int(host_plan)}
+             "refine_host_plan": int(host_plan),
+             "refine_moves_wanted": 0, "refine_moves_applied": 0,
+             "refine_moves_capacity_blocked": 0}
     best = a_try = a_dev
     best_cut = None
-    for it in range(rounds + 1):
-        if vb:
-            b = g = None
-            cut_now = score(a_try)
-        else:
-            b, g, cut_now = gains(a_try)
-        if best_cut is None:
-            best_cut = cut_now
-            stats["refine_cut_before"] = cut_now
-        elif cut_now < best_cut:
-            best_cut, best = cut_now, a_try
-            stats["refine_rounds_run"] += 1
-        else:
-            break  # roll back this round; refined result never regresses
-        if it == rounds:
-            break
-        if vb:
+    pending = None  # move accounting of the round awaiting its score
+    sp = obs.begin("refine", k=k, rounds_cap=rounds)
+    try:
+        for it in range(rounds + 1):
+            if vb:
+                b = g = None
+                cut_now = score(a_try)
+            else:
+                b, g, cut_now = gains(a_try)
+            if best_cut is None:
+                best_cut = cut_now
+                stats["refine_cut_before"] = cut_now
+                # annotate-then-end: the starting cut is known rounds
+                # before the span closes; put it on the interval now
+                sp.annotate(cut_before=cut_now)
+            else:
+                accepted = cut_now < best_cut
+                if pending is not None:
+                    # the per-round ledger row (ISSUE 13): what the
+                    # round wanted to move, what the capacity cap let
+                    # through, and what the move bought — a rejected
+                    # round reports its (non-positive) gain too, which
+                    # is how "refine stopped because moves stopped
+                    # paying" reads on the trace. The AGGREGATES only
+                    # bank accepted rounds: a rejected round's moves
+                    # are rolled back, so counting them would overstate
+                    # the repair present in the shipped assignment.
+                    obs.event("refine_round", cut=cut_now,
+                              gain=best_cut - cut_now,
+                              accepted=accepted, **pending)
+                    if accepted:
+                        stats["refine_moves_wanted"] += \
+                            pending["moves_wanted"]
+                        stats["refine_moves_applied"] += \
+                            pending["moves_applied"]
+                        stats["refine_moves_capacity_blocked"] += \
+                            pending["moves_capacity_blocked"]
+                        obs.inc("refine_moves_wanted",
+                                pending["moves_wanted"])
+                        obs.inc("refine_moves_applied",
+                                pending["moves_applied"])
+                        obs.inc("refine_moves_capacity_blocked",
+                                pending["moves_capacity_blocked"])
+                    pending = None
+                if accepted:
+                    best_cut, best = cut_now, a_try
+                    stats["refine_rounds_run"] += 1
+                else:
+                    break  # roll back; refined result never regresses
+            if it == rounds:
+                break
+            if vb:
+                b, g, _ = gains(a_try)
+            prev = a_try
+            a_try = plan(b, g, a_try, 0)
+            w0, m0 = _move_accounting(g, prev, a_try, 0, n)
             b, g, _ = gains(a_try)
-        a_try = plan(b, g, a_try, 0)
-        b, g, _ = gains(a_try)
-        a_try = plan(b, g, a_try, 1)
+            prev = a_try
+            a_try = plan(b, g, a_try, 1)
+            w1, m1 = _move_accounting(g, prev, a_try, 1, n)
+            wanted, applied = w0 + w1, m0 + m1
+            pending = {"round": it, "moves_wanted": wanted,
+                       "moves_applied": applied,
+                       "moves_capacity_blocked":
+                           max(0, wanted - applied)}
+    finally:
+        sp.end(rounds_run=stats["refine_rounds_run"],
+               cut_after=best_cut,
+               moves_capacity_blocked=stats[
+                   "refine_moves_capacity_blocked"])
     stats["refine_cut_after"] = best_cut
     return np.asarray(best[:n]), stats  # sheeplint: sync-ok

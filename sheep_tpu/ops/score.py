@@ -105,6 +105,38 @@ def _compact_cap(c_rows: int) -> int:
     return min(c_rows, pow2_at_least(c_rows >> 3, floor=1 << 16))
 
 
+def part_loads_accounting(assign, k: int, weights=None,
+                          cap: float = None) -> dict:
+    """Balance/capacity-freeze accounting of one assignment (ISSUE 13
+    cut ledger): per-part load spread plus — when ``cap`` is given (the
+    split's ``alpha * total/k`` bag capacity or refine's
+    ``alpha * ceil(n/k)`` move cap) — how many parts sit AT/ABOVE it.
+    A part at capacity is FROZEN for every capacity-respecting repair
+    pass (refine can only shrink it), so cut stuck behind frozen parts
+    is attributable to the balance budget, not to the LP signal. Host
+    numpy, O(V): callers gate on need (the ledger, a traced split)."""
+    import numpy as np
+
+    a = np.asarray(assign)
+    if weights is None:
+        loads = np.bincount(a, minlength=k).astype(np.float64)
+    else:
+        loads = np.bincount(a, weights=np.asarray(weights, np.float64),
+                            minlength=k)
+    total = float(loads.sum())
+    mean = total / max(k, 1)
+    out = {"balance": float(loads.max() / mean) if mean > 0 else 1.0,
+           "max_load": float(loads.max()), "min_load": float(loads.min()),
+           "empty_parts": int((loads == 0).sum())}
+    if cap is not None:
+        at_cap = loads >= float(cap)
+        out["cap"] = float(cap)
+        out["parts_at_capacity"] = int(at_cap.sum())
+        out["frozen_load_fraction"] = round(
+            float(loads[at_cap].sum() / total) if total else 0.0, 6)
+    return out
+
+
 def cut_pair_keys_host(chunk, assign, n: int, k: int):
     """Run cut_pairs on a (C, 2) or (D, C, 2) chunk and return the encoded
     int64 keys (vertex * k + foreign_part) on host — the shared comm-volume

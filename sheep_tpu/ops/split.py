@@ -34,7 +34,36 @@ def tree_split_host(
     parent64 = np.asarray(parent, dtype=np.int64)
     pos64 = np.asarray(pos, dtype=np.int64)
     if native.available():
-        return native.tree_split(parent64, pos64, k, weights=weights,
-                                 alpha=alpha)
-    tree = ElimTree(parent=parent64, pos=pos64, n=len(parent64))
-    return pure.tree_split(tree, k, weights=weights, alpha=alpha)
+        assign = native.tree_split(parent64, pos64, k, weights=weights,
+                                   alpha=alpha)
+    else:
+        tree = ElimTree(parent=parent64, pos=pos64, n=len(parent64))
+        assign = pure.tree_split(tree, k, weights=weights, alpha=alpha)
+    account_split(assign, k, weights, alpha)
+    return assign
+
+
+def account_split(assign, k: int, weights, alpha: float) -> None:
+    """Balance/capacity accounting of the split's output on the trace
+    (ISSUE 13 cut ledger): the bag capacity is ``alpha * total/k``
+    (+1 unit of slack the flushed-bag envelope allows), and parts the
+    split already filled to it are FROZEN for downstream repair — the
+    counter names how much of the residual the balance budget owns.
+    Only when tracing is on: the O(V) bincount is pure ledger.
+    Public: the cpu/pure backends call their native/pure split
+    directly and route only the accounting through here."""
+    from sheep_tpu import obs
+
+    if not obs.enabled():
+        return
+    from sheep_tpu.ops.score import part_loads_accounting
+
+    total = float(len(assign)) if weights is None \
+        else float(np.sum(weights))
+    # the contract ceiling: max part load <= (1 + alpha) * total/k
+    # (+max_w slack) — BETA * total/k under --balance. Parts at it
+    # cannot legally grow, whatever the cut says.
+    acct = part_loads_accounting(assign, k, weights=weights,
+                                 cap=(1.0 + alpha) * total / max(k, 1))
+    obs.event("split_balance", k=k, alpha=float(alpha), **acct)
+    obs.gauge("split_parts_at_capacity", acct["parts_at_capacity"])

@@ -340,4 +340,13 @@ class JobEngine:
                                   else float(v))
                              for kk, v in stats.items()
                              if isinstance(v, (int, float))}))
+        for r in results:
+            # the quality plane (ISSUE 13): the served job's final
+            # scores land in the trace + the job's flight ring the
+            # moment they exist; the scheduler turns them into the
+            # sheep_quality_* series at finalize
+            obs.event("job_quality", job=job.id, k=int(r.k),
+                      cut_ratio=round(float(r.cut_ratio), 6),
+                      balance=round(float(r.balance), 4),
+                      edge_cut=int(r.edge_cut))
         job.results = results

@@ -247,6 +247,23 @@ class Scheduler:
             "sheepd_step_seconds", "one dispatch step", ("phase",),
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        # ---- quality plane (ISSUE 13): partition QUALITY is a live,
+        # scrapeable series, not just a number in a result payload —
+        # per-tenant cut/balance distributions at DONE, plus per-job
+        # gauges for recent results via the collector below, so a
+        # fleet dashboard catches "this tenant's cuts got worse" the
+        # same way it catches latency regressions.
+        from sheep_tpu.obs.metrics import (DEFAULT_BALANCE_BUCKETS,
+                                           DEFAULT_RATIO_BUCKETS)
+
+        self._m_quality_cut = self.metrics.histogram(
+            "sheep_quality_cut_ratio",
+            "final cut ratio of DONE jobs, one observation per "
+            "result k", ("tenant",), buckets=DEFAULT_RATIO_BUCKETS)
+        self._m_quality_balance = self.metrics.histogram(
+            "sheep_quality_balance",
+            "final balance of DONE jobs, one observation per result k",
+            ("tenant",), buckets=DEFAULT_BALANCE_BUCKETS)
         self.metrics.add_collector(self._collect_live_gauges)
         # Always-on flight recorder: bounded per-job rings fed by
         # obs.event, dumped on job failure / fault injection / shutdown
@@ -485,6 +502,23 @@ class Scheduler:
             for job in active:
                 labels = {"job": job.id, "tenant": job.spec.tenant}
                 samples.append(("sheepd_job_steps", labels, job.steps))
+            # per-job quality gauges (ISSUE 13): the most recent DONE
+            # jobs' final scores, scrapeable per job/tenant/k. Bounded
+            # to the 32 newest COMPLETIONS (submit order would let a
+            # long-queued early job push the one that just finished
+            # out of the scrape) so a long-lived daemon's scrape does
+            # not grow with terminal-retention history.
+            done = sorted((j for j in self._jobs.values()
+                           if j.state == DONE and j.results),
+                          key=lambda j: j.end_t or 0.0)
+            for job in done[-32:]:
+                for r in job.results:
+                    labels = {"job": job.id, "tenant": job.spec.tenant,
+                              "k": str(r.k)}
+                    samples.append(("sheep_quality_job_cut_ratio",
+                                    labels, float(r.cut_ratio)))
+                    samples.append(("sheep_quality_job_balance",
+                                    labels, float(r.balance)))
         for name, n in compile_cache_sizes().items():
             samples.append(("sheepd_compile_cache_entries",
                             {"program": name}, n))
@@ -792,6 +826,14 @@ class Scheduler:
                 # the client asked for a result at submit, not at start
                 self._m_latency.observe(job.end_t - job.submit_t,
                                         tenant=job.spec.tenant)
+                for r in job.results or []:
+                    # the quality plane (ISSUE 13): every result k is
+                    # one observation in the tenant's cut/balance
+                    # distributions
+                    self._m_quality_cut.observe(
+                        float(r.cut_ratio), tenant=job.spec.tenant)
+                    self._m_quality_balance.observe(
+                        float(r.balance), tenant=job.spec.tenant)
             retries = job.stats.get("dispatch_retries")
             if isinstance(retries, (int, float)) and retries:
                 self._m_retries.inc(int(retries), tenant=job.spec.tenant)

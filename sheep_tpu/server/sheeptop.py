@@ -12,7 +12,9 @@ speaks the same line protocol as sheep-submit) and renders:
 - per-tenant SLO lines: request count and p50/p90/p99 latency
   estimated from the ``sheepd_request_latency_seconds`` histogram
   buckets;
-- per-job rows: id, tenant, state, live phase, steps, wall seconds.
+- per-job rows: id, tenant, state, live phase, steps, wall seconds,
+  and — once a job is done — its final cut ratio and balance from the
+  descriptor's result summaries (the quality plane, ISSUE 13).
 
 Rendering is pure string assembly (:func:`render_lines`) so tests pin
 it without a terminal; curses is a presentation detail that degrades
@@ -121,7 +123,7 @@ def render_lines(model: dict, width: int = 100) -> List[str]:
                 f"{_fmt_s(row['p99']):>10}")
     lines.append("")
     lines.append(f"{'job':<8}{'tenant':<16}{'state':<19}{'phase':<9}"
-                 f"{'steps':>7}  {'wall':>8}")
+                 f"{'steps':>7}  {'wall':>8}{'cut':>8}{'bal':>7}")
     now = model.get("t", time.time())
     for j in jobs:
         start = j.get("start_t")
@@ -129,13 +131,23 @@ def render_lines(model: dict, width: int = 100) -> List[str]:
         wall = j.get("wall_s")
         if wall is None and start is not None:
             wall = max(0.0, (end or now) - start)
+        # quality columns (ISSUE 13): a done job's final score, read
+        # from the descriptor's result summaries (first k of a multi-k
+        # job — the full list is one `status` call away)
+        cut = bal = None
+        results = j.get("results") or []
+        if results:
+            cut = results[0].get("cut_ratio")
+            bal = results[0].get("balance")
         lines.append(
             f"{str(j.get('job_id', '?'))[:7]:<8}"
             f"{str(j.get('tenant', '?'))[:15]:<16}"
             f"{str(j.get('state', '?')):<19}"
             f"{str(j.get('phase', '-')):<9}"
             f"{int(j.get('steps', 0)):>7}  "
-            f"{'-' if wall is None else f'{wall:8.1f}s'}")
+            f"{'-' if wall is None else f'{wall:8.1f}s'}"
+            f"{'-' if cut is None else f'{100 * float(cut):.2f}%':>8}"
+            f"{'-' if bal is None else f'{float(bal):.3f}':>7}")
     if not jobs:
         lines.append("  (no jobs)")
     return [ln[:width] for ln in lines]

@@ -114,6 +114,78 @@ def solve_dispatch_attribution(a: dict, b: dict) -> Optional[dict]:
     return {"per_dispatch_s": per_dispatch, "per_round_s": per_round}
 
 
+def residual_attribution(level_cuts, planted_ratios, total_edges: int
+                         ) -> Optional[dict]:
+    """Attribute a hierarchical build's cut residual against a planted
+    optimum, per level (ISSUE 13 — the "where does the 2.5x live"
+    question of ROADMAP item 4).
+
+    ``level_cuts``: the ledger's per-level cut counts — edges whose
+    endpoint labels first diverge at level d (level 0 = between
+    top-level parts, level 1 = within a top part but between subparts,
+    ...). ``planted_ratios``: the planted optimum's CUMULATIVE cut
+    ratio at each level's grouped k (e.g.
+    ``SbmHashStream.planted_cut_ratio(k_d)``), so the planted
+    PER-LEVEL increment is the difference of adjacent entries.
+
+    Returns per-level ``excess`` ratios (achieved minus planted, the
+    residual each level owns) and the ``dominant`` term, named the way
+    the ledger reads: ``level0_fragmentation`` for the top split,
+    ``level{d}_misassignment`` below it. None when the inputs don't
+    line up."""
+    if not level_cuts or not planted_ratios \
+            or len(level_cuts) != len(planted_ratios) \
+            or not total_edges:
+        return None
+    levels = []
+    prev_planted = 0.0
+    for d, (cut, planted_cum) in enumerate(zip(level_cuts,
+                                               planted_ratios)):
+        achieved = cut / total_edges
+        planted_inc = planted_cum - prev_planted
+        prev_planted = planted_cum
+        levels.append({
+            "level": d,
+            "name": ("level0_fragmentation" if d == 0
+                     else f"level{d}_misassignment"),
+            "cut_ratio": round(achieved, 6),
+            "planted_ratio": round(planted_inc, 6),
+            "excess": round(achieved - planted_inc, 6),
+        })
+    dominant = max(levels, key=lambda r: r["excess"])
+    total_excess = sum(r["excess"] for r in levels)
+    return {"levels": levels, "dominant": dominant["name"],
+            "dominant_excess": dominant["excess"],
+            "total_excess": round(total_excess, 6),
+            "dominant_share": round(
+                dominant["excess"] / total_excess, 4)
+            if total_excess > 0 else None}
+
+
+def ledger_residual(diagnostics: dict, k_levels, planted_fn,
+                    total_edges: int) -> Optional[dict]:
+    """:func:`residual_attribution` straight from a result's ledger
+    diagnostics: pulls each level's ``cut_level{d}`` row, prices the
+    planted grouped optimum at the level's cumulative k via
+    ``planted_fn`` (e.g. ``SbmHashStream.planted_cut_ratio``), and
+    attributes. The one wiring shared by ``tools/hier_quality.py`` and
+    ``tools/quality_regress.py`` — the diagnostics key contract lives
+    here, next to the attribution math. None when some level's k does
+    not divide the planted blocks (``planted_fn`` raises ValueError):
+    no ground truth exists at that grouping."""
+    cuts = []
+    ratios = []
+    kp = 1
+    try:
+        for depth, kd in enumerate(k_levels):
+            kp *= int(kd)
+            cuts.append(int(diagnostics.get(f"cut_level{depth}", 0)))
+            ratios.append(planted_fn(kp))
+    except ValueError:
+        return None
+    return residual_attribution(cuts, ratios, total_edges)
+
+
 def device_memory_stats() -> Optional[dict]:
     """Allocator stats of the default device (HBM high-water mark on TPU);
     None where the platform doesn't expose them (e.g. CPU)."""

@@ -338,7 +338,12 @@ def test_sheeptop_render_lines_from_model():
     model = {"metrics": metrics_mod.parse_prometheus(text),
              "jobs": [{"job_id": "j1", "tenant": "alice",
                        "state": "running", "phase": "build",
-                       "steps": 12, "start_t": 100.0}],
+                       "steps": 12, "start_t": 100.0},
+                      {"job_id": "j2", "tenant": "bob",
+                       "state": "done", "steps": 30, "start_t": 90.0,
+                       "end_t": 104.0, "wall_s": 14.0,
+                       "results": [{"k": 8, "cut_ratio": 0.1252,
+                                    "balance": 1.049}]}],
              "t": 110.0}
     lines = sheeptop.render_lines(model)
     joined = "\n".join(lines)
@@ -346,6 +351,13 @@ def test_sheeptop_render_lines_from_model():
     assert "1.0MiB/4.0MiB" in joined
     assert "alice" in joined and "p99" in joined
     assert "build" in joined and "10.0s" in joined
+    # quality columns (ISSUE 13): done jobs show their final score,
+    # running jobs show the placeholder
+    assert "cut" in lines[-3] and "bal" in lines[-3]  # header row
+    j1 = next(ln for ln in lines if ln.startswith("j1"))
+    j2 = next(ln for ln in lines if ln.startswith("j2"))
+    assert "12.52%" in j2 and "1.049" in j2
+    assert j1.rstrip().endswith("-")
     rows = sheeptop.tenant_slo_rows(model["metrics"])
     assert rows and rows[0]["tenant"] == "alice" \
         and rows[0]["requests"] == 3

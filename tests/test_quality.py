@@ -1,0 +1,492 @@
+"""Quality observability plane (ISSUE 13): the cut ledger, the recipe
+advisor, the new scenario generators, and the quality CI gate."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import sheep_tpu
+from sheep_tpu import obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+quality_regress = _load_tool("quality_regress")
+trace_report = _load_tool("trace_report")
+
+
+# ---------------------------------------------------------------------------
+# recipe advisor (ops/degrees.py)
+# ---------------------------------------------------------------------------
+
+def test_advise_recipe_signal_law():
+    from sheep_tpu.ops.degrees import advise_recipe
+
+    # the measured s22 shape: V=2^22, E=16*2^22 (avg degree 32), k=64:
+    # signal 0.5 < 1 -> the winning [8, 8] split, final refine, balance
+    a = advise_recipe(1 << 22, 16 << 22, 64)
+    assert a["mode"] == "hier" and a["k_levels"] == [8, 8]
+    assert a["final_refine"] > 0 and a["balance"] > 1.0
+    assert a["signal"] == pytest.approx(0.5)
+    # healthy signal: flat is the right call
+    assert advise_recipe(1 << 22, 16 << 22, 8)["mode"] == "flat"
+    # unknown edge count: no verdict, never a guess
+    assert advise_recipe(1 << 22, None, 64)["mode"] == "unknown"
+    # prime k past the per-level cap: no usable split, stay flat
+    assert advise_recipe(1 << 10, 4 << 10, 13)["mode"] == "flat"
+
+
+def test_factor_levels():
+    from sheep_tpu.ops.degrees import factor_levels
+
+    assert factor_levels(64, 32) == [8, 8]
+    assert factor_levels(16, 8) == [4, 4]
+    assert factor_levels(8, 32) == [8]          # fits one level
+    assert factor_levels(60, 5) == [5, 4, 3]
+    assert factor_levels(7, 4) is None          # prime past the cap
+
+
+def test_cli_advisor_prints_and_auto_recipe_bit_identical(tmp_path,
+                                                          capsys):
+    """The acceptance contract: the naive flat invocation PRINTS the
+    recipe, and --auto-recipe reproduces the manual-flags invocation
+    bit for bit (same code path, same knobs)."""
+    from sheep_tpu import cli
+    from sheep_tpu.io.formats import read_partition
+
+    spec = "sbm-hash:9:16:0.05:4:1"  # avg degree 8, k=16 -> signal 0.5
+    naive_out = str(tmp_path / "naive.pbin")
+    rc = cli.main(["--input", spec, "--k", "16", "--backend", "cpu",
+                   "--refine", "1", "--no-comm-volume", "--json",
+                   "--output", naive_out])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "quality advisor" in err
+    assert "--k-levels 4,4" in err and "--auto-recipe" in err
+
+    auto_out = str(tmp_path / "auto.pbin")
+    # explicit --refine 0/--final-refine 2 keep the test out of the
+    # compile-heavy per-level refine; the advisor recipe honors both
+    rc = cli.main(["--input", spec, "--k", "16", "--backend", "cpu",
+                   "--refine", "0", "--final-refine", "2",
+                   "--no-comm-volume", "--auto-recipe",
+                   "--json", "--output", auto_out])
+    assert rc == 0
+    cap = capsys.readouterr()
+    auto_line = json.loads(cap.out.strip().splitlines()[-1])
+    assert auto_line["k"] == 16 and "+hier" in auto_line["backend"]
+
+    manual_out = str(tmp_path / "manual.pbin")
+    rc = cli.main(["--input", spec, "--k-levels", "4,4", "--backend",
+                   "cpu", "--refine", "0", "--final-refine", "2",
+                   "--balance", "1.05", "--no-comm-volume", "--json",
+                   "--output", manual_out])
+    assert rc == 0
+    manual_line = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    np.testing.assert_array_equal(read_partition(auto_out),
+                                  read_partition(manual_out))
+    assert auto_line["edge_cut"] == manual_line["edge_cut"]
+    assert auto_line["balance"] == manual_line["balance"]
+
+
+def test_cli_auto_recipe_healthy_signal_stays_flat(capsys):
+    from sheep_tpu import cli
+
+    rc = cli.main(["--input", "sbm-hash:9:4:0.05:16:1", "--k", "4",
+                   "--backend", "pure", "--no-comm-volume",
+                   "--auto-recipe", "--json"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "flat path as asked" in cap.err
+    line = json.loads(cap.out.strip().splitlines()[-1])
+    assert "+hier" not in line["backend"]
+
+
+def test_cli_auto_recipe_validation(tmp_path):
+    from sheep_tpu import cli
+    from sheep_tpu.io import formats, generators
+
+    p = str(tmp_path / "g.edges")
+    formats.write_edges(p, generators.karate_club())
+    for argv in (["--k-levels", "2,2", "--auto-recipe"],
+                 ["--k", "4,8", "--auto-recipe"],
+                 ["--score-only", p, "--auto-recipe"],
+                 # flags a --k-levels run cannot honor reject UP FRONT,
+                 # not data-dependently on the input's degree signal
+                 ["--k", "4", "--inflight", "2", "--auto-recipe"],
+                 ["--k", "4", "--dispatch-batch", "2", "--auto-recipe"]):
+        with pytest.raises(SystemExit):
+            cli.main(["--input", p] + argv)
+
+
+def test_cli_auto_recipe_explicit_final_refine_zero(capsys):
+    """An EXPLICIT --final-refine 0 must survive into the applied
+    recipe (review finding: the falsy-zero `or` silently substituted
+    the advisor's default 10)."""
+    from sheep_tpu import cli
+
+    rc = cli.main(["--input", "sbm-hash:9:16:0.05:4:1", "--k", "16",
+                   "--backend", "pure", "--refine", "0",
+                   "--final-refine", "0", "--no-comm-volume",
+                   "--auto-recipe", "--json"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "--final-refine 0" in cap.err
+    line = json.loads(cap.out.strip().splitlines()[-1])
+    assert "+hier" in line["backend"]
+
+
+# ---------------------------------------------------------------------------
+# the cut ledger (hierarchy.py + ops/refine.py + ops/split.py)
+# ---------------------------------------------------------------------------
+
+SPEC = "sbm-hash:10:16:0.05:8:1"
+
+
+def test_hierarchy_ledger_levels_sum_to_cut(tmp_path):
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(trace):
+        res = sheep_tpu.partition_hierarchical(
+            SPEC, [4, 4], backend="pure", refine=0, final_refine=2,
+            comm_volume=False)
+    d = res.diagnostics
+    assert d["cut_level0"] + d["cut_level1"] == res.edge_cut
+    assert d["cut_ratio_level0"] == pytest.approx(
+        d["cut_level0"] / res.total_edges, abs=1e-5)
+    assert "ledger_parts_at_capacity" in d
+    assert "final_refine_repaired" in d
+    evs = [json.loads(ln) for ln in open(trace)]
+    ql = [e for e in evs if e["event"] == "quality_ledger"]
+    assert len(ql) == 1
+    q = ql[0]
+    assert q["k_levels"] == [4, 4]
+    assert sum(lv["cut"] for lv in q["levels"]) == q["edge_cut"]
+    assert [lv["level"] for lv in q["levels"]] == [0, 1]
+    # the ledger prices what SHIPPED: post-final-refine labels
+    assert q["edge_cut"] == res.edge_cut
+    # the per-level spans nested in the trace
+    names = {e.get("span") for e in evs if e["event"] == "span_start"}
+    assert {"hier_partition", "hier_spill", "refine"} <= names
+
+
+def test_hierarchy_ledger_single_level():
+    res = sheep_tpu.partition_hierarchical(SPEC, [4], backend="pure",
+                                           refine=0, comm_volume=False)
+    assert res.diagnostics["cut_level0"] == res.edge_cut
+
+
+def test_level_ledger_function_three_levels():
+    from sheep_tpu.hierarchy import level_ledger
+    from sheep_tpu.io.edgestream import open_input
+
+    with open_input(SPEC) as es:
+        res = sheep_tpu.partition_hierarchical(
+            SPEC, [2, 2, 2], backend="pure", refine=0,
+            comm_volume=False)
+        rows = level_ledger(es, res.assignment, [2, 2, 2],
+                            res.edge_cut, res.total_edges)
+    assert [r["k"] for r in rows] == [2, 4, 8]
+    assert sum(r["cut"] for r in rows) == res.edge_cut
+    assert all(r["cut"] >= 0 for r in rows)
+
+
+def test_refine_move_accounting():
+    from sheep_tpu.io.edgestream import open_input
+    from sheep_tpu.ops.refine import refine_assignment
+
+    with open_input(SPEC) as es:
+        n = es.num_vertices
+        rng = np.random.default_rng(0)
+        bad = rng.integers(0, 16, n).astype(np.int32)
+        # a tight cap forces capacity blocking: plenty of vertices want
+        # to move toward the planted blocks, few fit
+        _, stats = refine_assignment(bad, es, n, 16, rounds=2,
+                                     alpha=1.01)
+    assert stats["refine_moves_wanted"] >= stats["refine_moves_applied"]
+    assert stats["refine_moves_capacity_blocked"] == \
+        stats["refine_moves_wanted"] - stats["refine_moves_applied"]
+    assert stats["refine_moves_wanted"] > 0
+    assert stats["refine_moves_capacity_blocked"] > 0
+
+
+def test_refine_round_events_and_counters(tmp_path):
+    from sheep_tpu.io.edgestream import open_input
+    from sheep_tpu.ops.refine import refine_assignment
+
+    trace = str(tmp_path / "t.jsonl")
+    with open_input(SPEC) as es:
+        n = es.num_vertices
+        rng = np.random.default_rng(0)
+        bad = rng.integers(0, 16, n).astype(np.int32)
+        with obs.tracing(trace) as tr:
+            refine_assignment(bad, es, n, 16, rounds=2, alpha=1.10)
+            counters = dict(tr.counters)
+    evs = [json.loads(ln) for ln in open(trace)]
+    rounds = [e for e in evs if e["event"] == "refine_round"]
+    assert rounds, "per-round ledger events missing"
+    for e in rounds:
+        assert e["moves_applied"] <= e["moves_wanted"]
+        assert "gain" in e and "accepted" in e
+    assert counters.get("refine_moves_wanted", 0) > 0
+    spans = [e for e in evs if e["event"] == "span_end"
+             and e.get("span") == "refine"]
+    assert spans and "cut_after" in spans[0]
+
+
+def test_split_balance_event(tmp_path):
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(trace):
+        sheep_tpu.partition(SPEC, 4, backend="pure", comm_volume=False)
+    evs = [json.loads(ln) for ln in open(trace)]
+    sb = [e for e in evs if e["event"] == "split_balance"]
+    assert sb and sb[0]["k"] == 4
+    assert {"balance", "cap", "parts_at_capacity",
+            "frozen_load_fraction"} <= set(sb[0])
+
+
+def test_part_loads_accounting():
+    from sheep_tpu.ops.score import part_loads_accounting
+
+    a = np.array([0, 0, 0, 1, 2, 2], np.int32)
+    acct = part_loads_accounting(a, 4, cap=2.0)
+    assert acct["max_load"] == 3 and acct["empty_parts"] == 1
+    assert acct["parts_at_capacity"] == 2  # loads 3 and 2 are >= cap
+    assert acct["frozen_load_fraction"] == pytest.approx(5 / 6)
+    w = np.array([10.0, 1, 1, 1, 1, 1])
+    acct = part_loads_accounting(a, 4, weights=w, cap=100.0)
+    assert acct["max_load"] == 12 and acct["parts_at_capacity"] == 0
+
+
+def test_residual_attribution():
+    from sheep_tpu.utils.metrics import residual_attribution
+
+    # 1000 edges; level0 cut 300 vs planted 40 cumulative, level1 cut
+    # 100 on top of planted 50 cumulative -> level0 owns the residual
+    r = residual_attribution([300, 100], [0.04, 0.05], 1000)
+    assert r["dominant"] == "level0_fragmentation"
+    assert r["levels"][0]["excess"] == pytest.approx(0.26)
+    assert r["levels"][1]["excess"] == pytest.approx(0.09)
+    assert r["dominant_share"] == pytest.approx(0.26 / 0.35, abs=1e-3)
+    assert residual_attribution([], [], 10) is None
+    assert residual_attribution([1], [0.1, 0.2], 10) is None
+
+
+# ---------------------------------------------------------------------------
+# scenario generators (io/generators.py + open_input)
+# ---------------------------------------------------------------------------
+
+def test_bipartite_stream():
+    from sheep_tpu.io.edgestream import open_input
+
+    with open_input("bipartite-hash:10:4:0.02:8:1") as es:
+        n = es.num_vertices
+        e = es.read_all()
+        half = n // 2
+        assert (e[:, 0] < half).all() and (e[:, 1] >= half).all(), \
+            "every edge must cross the halves"
+        # deterministic random access
+        assert np.array_equal(es._range(100, 50),
+                              es.read_all()[100:150])
+        gt = es.ground_truth()
+        measured = float((gt[e[:, 0]] != gt[e[:, 1]]).mean())
+        assert measured == pytest.approx(es.planted_cut_ratio(),
+                                         abs=0.01)
+        # grouped planted optimum shrinks with k (cross edges can land
+        # in the same group)
+        assert es.planted_cut_ratio(2) < es.planted_cut_ratio()
+
+
+def test_nearclique_stream_is_dense_planted():
+    from sheep_tpu.io import generators
+    from sheep_tpu.io.edgestream import open_input
+
+    with open_input("nearclique-hash:10:4:0.01:8:1") as es:
+        assert isinstance(es, generators.NearCliqueStream)
+        assert es.n_blocks == 1 << (10 - 4)
+        e = es.read_all()
+        gt = es.ground_truth()
+        measured = float((gt[e[:, 0]] != gt[e[:, 1]]).mean())
+        assert measured == pytest.approx(0.01, abs=0.01)
+        # near-clique density: intra edges per block ~ ef * 2^cb = 128
+        # against 120 distinct pairs — every block is near clique-dense
+        intra = e[gt[e[:, 0]] == gt[e[:, 1]]]
+        per_block = np.bincount(gt[intra[:, 0]], minlength=es.n_blocks)
+        assert per_block.min() > 60
+
+
+def test_powerlaw_sbm_stream():
+    from sheep_tpu.io.edgestream import open_input
+
+    with open_input("plsbm-hash:12:4:0.0:16:1") as es:
+        e = es.read_all()
+        deg = np.bincount(e.ravel(), minlength=es.num_vertices)
+        # power-law within blocks: hubs far above the mean (flat SBM
+        # tops out near the Poisson tail, ~2x the mean)
+        assert deg.max() > 10 * deg.mean()
+        gt = es.ground_truth()
+        assert (gt[e[:, 0]] == gt[e[:, 1]]).all(), \
+            "p_out=0 must produce zero planted cut"
+    with open_input("plsbm-hash:10:4:0.05:8:1") as es:
+        e = es.read_all()
+        gt = es.ground_truth()
+        measured = float((gt[e[:, 0]] != gt[e[:, 1]]).mean())
+        assert measured == pytest.approx(0.05, abs=0.012)
+
+
+def test_new_spec_validation():
+    from sheep_tpu.io.edgestream import open_input
+
+    for bad in ("bipartite-hash:10", "bipartite-hash:10:3:0.02",
+                "nearclique-hash:10:12:0.01", "plsbm-hash:10:x:0.05",
+                "plsbm-hash:10:1024:0.05"):
+        with pytest.raises(ValueError):
+            open_input(bad)
+    with pytest.raises(ValueError, match="contradicts"):
+        open_input("bipartite-hash:10:4:0.02", n_vertices=999)
+
+
+# ---------------------------------------------------------------------------
+# the quality CI gate (tools/quality_regress.py)
+# ---------------------------------------------------------------------------
+
+def _artifact(tmp_path, name, scenarios, suite=quality_regress.SUITE):
+    p = str(tmp_path / name)
+    json.dump({"tool": "quality_regress", "suite": suite,
+               "scenarios": scenarios}, open(p, "w"))
+    return p
+
+
+BASE_SC = {"a": {"cut_ratio": 0.10, "balance": 1.05},
+           "b": {"cut_ratio": 0.70, "balance": 1.20}}
+
+
+def test_quality_regress_pass_and_detect(tmp_path):
+    old = _artifact(tmp_path, "old.json", BASE_SC)
+    same = _artifact(tmp_path, "same.json", BASE_SC)
+    assert quality_regress.main([same, old]) == 0
+    worse = _artifact(tmp_path, "worse.json",
+                      {"a": {"cut_ratio": 0.15, "balance": 1.05},
+                       "b": BASE_SC["b"]})
+    assert quality_regress.main([worse, old, "--threshold", "0.02"]) == 2
+    # a balance blow-up gates too
+    fat = _artifact(tmp_path, "fat.json",
+                    {"a": {"cut_ratio": 0.10, "balance": 1.40},
+                     "b": BASE_SC["b"]})
+    assert quality_regress.main([fat, old]) == 2
+    # improvement is a pass
+    better = _artifact(tmp_path, "better.json",
+                       {"a": {"cut_ratio": 0.05, "balance": 1.02},
+                        "b": BASE_SC["b"]})
+    assert quality_regress.main([better, old]) == 0
+
+
+def test_quality_regress_skipped_incomparable(tmp_path):
+    old = _artifact(tmp_path, "old.json", BASE_SC)
+    new = _artifact(tmp_path, "new.json",
+                    {"a": BASE_SC["a"],
+                     "c": {"cut_ratio": 0.3, "balance": 1.1}})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = quality_regress.main([new, old])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "skipped-incomparable: b, c" in out
+    # json shape carries them too
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        quality_regress.main([new, old, "--json"])
+    doc = json.loads(buf.getvalue())
+    assert doc["skipped"] == ["b", "c"] and not doc["regressions"]
+
+
+def test_quality_regress_suite_mismatch_vacuous(tmp_path):
+    old = _artifact(tmp_path, "old.json", BASE_SC, suite=0)
+    new = _artifact(tmp_path, "new.json", BASE_SC)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = quality_regress.main([new, old])
+    assert rc == 0 and "not comparable" in buf.getvalue()
+
+
+def test_quality_regress_sweep_against_committed_seed(tmp_path):
+    """The tier-1 wiring: ONE fast scenario run fresh must agree with
+    the committed QUALITY_r01.json seed (bit-deterministic sweep); the
+    other scenarios report as skipped, not as failures. The FULL sweep
+    runs in tools/obs_smoke.sh leg 9."""
+    seed = os.path.join(REPO, "QUALITY_r01.json")
+    assert os.path.exists(seed), "committed quality seed artifact"
+    fresh = str(tmp_path / "QUALITY_fresh.json")
+    assert quality_regress.main(
+        ["--run", fresh, "--scenarios", "rmat_expander"]) == 0
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = quality_regress.main([fresh, seed, "--threshold", "0.001"])
+    out = buf.getvalue()
+    assert rc == 0, out
+    assert "rmat_expander" in out and "skipped-incomparable" in out
+    doc = json.load(open(fresh))
+    committed = json.load(open(seed))
+    assert doc["scenarios"]["rmat_expander"] == \
+        committed["scenarios"]["rmat_expander"], \
+        "the sweep is deterministic: a fresh run bit-equals the seed"
+
+
+def test_quality_seed_artifact_contract():
+    """The committed sweep covers >= 5 scenarios including the new
+    bipartite + near-clique classes, and the planted hierarchical
+    scenario records the per-level ledger + residual attribution."""
+    doc = json.load(open(os.path.join(REPO, "QUALITY_r01.json")))
+    sc = doc["scenarios"]
+    assert len(sc) >= 5
+    assert {"sbm_planted", "sbm_powerlaw", "rmat_expander", "bipartite",
+            "near_clique"} <= set(sc)
+    planted = sc["sbm_planted"]
+    assert "cut_level0" in planted["levels"]
+    assert "cut_level1" in planted["levels"]
+    assert planted["residual"]["dominant"] in (
+        "level0_fragmentation", "level1_misassignment")
+
+
+# ---------------------------------------------------------------------------
+# trace_report renders the quality tree
+# ---------------------------------------------------------------------------
+
+def test_trace_report_quality_tree(tmp_path):
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(trace):
+        sheep_tpu.partition_hierarchical(SPEC, [4, 4], backend="pure",
+                                         refine=0, final_refine=2,
+                                         comm_volume=False)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = trace_report.main([trace])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "quality ledger:" in out
+    assert "level0 (fragmentation)" in out
+    assert "level1 (misassignment)" in out
+    assert "final refine repaired" in out
+    assert "refine rounds:" in out and "capacity-blocked" in out
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        trace_report.main([trace, "--json"])
+    doc = json.loads(buf.getvalue())
+    t = doc["traces"][0]
+    assert t["quality_ledgers"] and t["refine_rounds"]
+    assert sum(lv["cut"] for lv in t["quality_ledgers"][0]["levels"]) \
+        == t["quality_ledgers"][0]["edge_cut"]

@@ -365,6 +365,24 @@ def test_metrics_expose_per_tenant_latency_under_two_jobs():
     # live progress surfaced while running: phase/steps on descriptors
     assert ja.phase == "score" and ja.steps > 0
     assert ja.descriptor()["phase"] == "score"
+    # the quality plane (ISSUE 13): per-tenant cut/balance
+    # distributions observed at DONE, per-job gauges for recent
+    # results, and the engine's job_quality value matching the
+    # scraped gauge exactly
+    qcut = {lb["tenant"]: v
+            for lb, v in parsed["sheep_quality_cut_ratio_count"]}
+    assert qcut == {"alice": 1.0, "bob": 1.0}
+    qbal = {lb["tenant"]: v
+            for lb, v in parsed["sheep_quality_balance_count"]}
+    assert qbal == {"alice": 1.0, "bob": 1.0}
+    jobs_cut = {lb["job"]: v
+                for lb, v in parsed["sheep_quality_job_cut_ratio"]}
+    assert jobs_cut[ja.id] == pytest.approx(
+        float(ja.results[0].cut_ratio), abs=1e-6)
+    jobs_bal = {(lb["job"], lb["k"]): v
+                for lb, v in parsed["sheep_quality_job_balance"]}
+    assert jobs_bal[(jb.id, "4")] == pytest.approx(
+        float(jb.results[0].balance), abs=1e-4)
 
 
 def test_active_job_progress_gauges_live_mid_build():

@@ -336,6 +336,35 @@ def test_bench_regress_degradation_fields_are_info_only(tmp_path):
     assert "degraded_dispatch_batch" in out and "info" in out
 
 
+def test_bench_regress_skipped_incomparable_fields_reported(tmp_path):
+    """ISSUE 13 satellite: a field present in exactly one capture (the
+    cpu-jax fallback emits fewer contract fields than a real-chip run)
+    compares NOTHING — the pass must say so instead of reading as full
+    coverage."""
+    old = _write(tmp_path, "old.json",
+                 {**BASE, "host_blocked_ms": 120.0, "warm_up_s": 9.0})
+    new = _write(tmp_path, "new.json", BASE)  # fallback: fields absent
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench_regress.main([new, old])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "skipped-incomparable: host_blocked_ms, warm_up_s" in out
+    # json shape carries them too
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_regress.main([new, old, "--json"])
+    doc = json.loads(buf.getvalue())
+    assert doc["skipped"] == ["host_blocked_ms", "warm_up_s"]
+    # fields absent from BOTH captures are not "skipped" — there was
+    # nothing to compare and nothing partial about it
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench_regress.main([_write(tmp_path, "n2.json", BASE),
+                                 _write(tmp_path, "o2.json", BASE)])
+    assert rc == 0 and "skipped-incomparable" not in buf.getvalue()
+
+
 def test_bench_regress_incomparable_metrics_pass(tmp_path):
     """A cpu-jax fallback row must never false-alarm against a real
     accelerator row — different metric strings are vacuously PASS."""

@@ -41,6 +41,12 @@ after an OOM backoff), ``device_loss_recoveries``, and
 consequences that must be VISIBLE in the perf trajectory without
 false-alarming the gate.
 
+Contract fields present in exactly ONE capture compare nothing; they
+are listed on a ``skipped-incomparable: <names>`` line (and in the
+``skipped`` JSON field) so a cpu-jax fallback capture — which emits
+fewer fields than a real-chip one — reads as the PARTIAL pass it is,
+not a full-coverage green.
+
 Link-state fields (rtt_ms, h2d_mbs, d2h_mbs) and device_gap_ms (device
 idle between executions — collapses with pipelining but swings with
 link quality) are environmental and reported but never gated. Two captures whose ``metric`` strings differ
@@ -131,9 +137,14 @@ def load_capture(path: str):
 
 
 def compare(new: dict, old: dict, threshold: float) -> dict:
-    """{"comparable": bool, "rows": [...], "regressions": [...]}."""
+    """{"comparable": bool, "rows": [...], "regressions": [...],
+    "skipped": [...]} — ``skipped`` lists contract fields present in
+    exactly ONE capture (a cpu-jax fallback run emits fewer fields
+    than a real-chip one): those comparisons are vacuous, and a
+    vacuous pass that LOOKS like a full pass hides exactly the partial
+    coverage it came from, so the caller prints them."""
     out = {"comparable": True, "reason": None, "rows": [],
-           "regressions": []}
+           "regressions": [], "skipped": []}
     nm, om = new.get("metric"), old.get("metric")
     if nm != om:
         out["comparable"] = False
@@ -144,9 +155,15 @@ def compare(new: dict, old: dict, threshold: float) -> dict:
         out["comparable"] = False
         out["reason"] = "one capture has value 0/null (a failed run)"
         return out
+
+    def _num(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
     for field in HIGHER_BETTER + LOWER_BETTER + INFO_ONLY:
         a, b = new.get(field), old.get(field)
         if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            if _num(a) != _num(b):
+                out["skipped"].append(field)
             continue
         # old == 0: no relative change exists, but ANY movement off zero
         # is gated absolutely — host_syncs 0 -> 500 must not pass just
@@ -250,6 +267,10 @@ def main(argv=None) -> int:
             print(f"{row['field']:<16}{row['old']:>14,.3f}"
                   f"{row['new']:>14,.3f}{change}"
                   f"  {row['verdict']}")
+        if res.get("skipped"):
+            # fields one capture lacks compared nothing — say so, or a
+            # cpu-jax fallback run reads as a full-coverage pass
+            print(f"skipped-incomparable: {', '.join(res['skipped'])}")
         if res["regressions"]:
             names = ", ".join(r["field"] for r in res["regressions"])
             print(f"verdict: REGRESSION beyond {args.threshold:.0%} "

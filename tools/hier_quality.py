@@ -78,6 +78,14 @@ def main():
 
     with open_input(spec) as es:
         planted = es.planted_cut_ratio()
+        # the cut ledger's residual attribution (ISSUE 13): per-level
+        # achieved-vs-planted excess, naming which level owns the
+        # residual — the diagnosis ROADMAP item 4's follow-up attacks
+        from sheep_tpu.utils.metrics import ledger_residual
+
+        residual = ledger_residual(res.diagnostics or {}, k_levels,
+                                   es.planted_cut_ratio,
+                                   res.total_edges)
 
     out = {
         "spec": spec,
@@ -96,6 +104,7 @@ def main():
         "diagnostics": {k: _num(v) for k, v in
                         (res.diagnostics or {}).items()},
         "planted_optimum": round(planted, 4),
+        "residual": residual,
         "history": {"flat_r30": 0.8467, "hier_r4": 0.4313,
                     "hier_fr10": 0.3364},
     }
@@ -109,6 +118,7 @@ def main():
         os.path.dirname(__file__), "out", "soak",
         f"hier_s{args.scale}_k{args.blocks}_L{lv}"
         f"_r{args.refine}_fr{args.final_refine}{bal}{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

@@ -381,7 +381,40 @@ else:
     raise SystemExit("no diagnostics record in the device-stream trace")
 PYEOF
 
+# ninth leg: the quality observability plane (ISSUE 13) — (a) a tiny
+# --k-levels build must emit the cut ledger (per-level attribution +
+# refine-round + split-balance events) into the trace, with
+# trace_report rendering the quality tree and --check staying green;
+# (b) the naive low-signal flat invocation must PRINT the advisor's
+# recipe; (c) quality_regress's fresh full sweep must pass the gate
+# against the committed QUALITY_r01.json seed artifact — cut
+# regressions caught like perf ones.
+TRACE9="$OUT/trace_quality.jsonl"
+rm -f "$TRACE9"
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input sbm-hash:10:16:0.05:8:1 --k-levels 4,4 --backend pure \
+    --refine 0 --final-refine 2 --no-comm-volume \
+    --trace "$TRACE9" --heartbeat-secs 0.2 --json \
+    > "$OUT/result_quality.json"
+python tools/trace_report.py "$TRACE9" --check > "$OUT/report_quality.txt"
+grep -q '"event": "quality_ledger"' "$TRACE9"
+grep -q '"event": "refine_round"' "$TRACE9"
+grep -q '"event": "split_balance"' "$TRACE9"
+grep -q "quality ledger:" "$OUT/report_quality.txt"
+grep -q "level0 (fragmentation)" "$OUT/report_quality.txt"
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli \
+    --input sbm-hash:10:16:0.05:4:1 --k 16 --backend pure --refine 0 \
+    --no-comm-volume --json > /dev/null 2> "$OUT/advisor.err"
+grep -q "quality advisor" "$OUT/advisor.err"
+grep -q -- "--k-levels 4,4" "$OUT/advisor.err"
+QUAL9="$OUT/QUALITY_fresh.json"
+JAX_PLATFORMS=cpu python tools/quality_regress.py --run "$QUAL9" \
+    2> "$OUT/quality_sweep.err"
+python tools/quality_regress.py "$QUAL9" QUALITY_r01.json \
+    > "$OUT/quality_gate.txt"
+grep -q "verdict: PASS" "$OUT/quality_gate.txt"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9"

@@ -181,6 +181,18 @@ def parse_trace(path: str) -> dict:
         # --last-errors next to the UNCLOSED-span flags
         "flight_dumps": [e for e in events
                          if e.get("event") == "flight_dump"],
+        # the quality observability plane (ISSUE 13): per-level cut
+        # attribution from hierarchical builds, the refine rounds'
+        # move/capacity ledger, split balance accounting, and served
+        # jobs' final scores — rendered as the quality tree below
+        "quality_ledgers": [e for e in events
+                            if e.get("event") == "quality_ledger"],
+        "refine_rounds": [e for e in events
+                          if e.get("event") == "refine_round"],
+        "split_balance": [e for e in events
+                          if e.get("event") == "split_balance"],
+        "job_quality": [e for e in events
+                        if e.get("event") == "job_quality"],
     }
 
 
@@ -382,6 +394,7 @@ def print_report(rep: dict, out) -> None:
         for tenant, row in sorted(tenant_costs(parsed).items()):
             bits = [f"{k}={v}" for k, v in row.items()]
             out.write(f"tenant {tenant}: {' '.join(bits)}\n")
+    print_quality(parsed, out)
     cnt = parsed["counters"]
     if cnt:
         cs = {k: v for k, v in cnt.items() if k not in ("event", "ts")}
@@ -394,6 +407,56 @@ def print_report(rep: dict, out) -> None:
         out.write(f"scores: {' '.join(bits)}\n")
     for p in rep["problems"]:
         out.write(f"warning: {p}\n")
+
+
+def print_quality(parsed: dict, out) -> None:
+    """The quality tree (ISSUE 13): per-level cut attribution from
+    each hierarchical build's ledger, a refine-round summary (gain vs
+    capacity-blocked moves), split balance accounting, and served
+    jobs' final scores — the cut stops being one opaque number."""
+    for q in parsed["quality_ledgers"]:
+        out.write(f"quality ledger: k={q.get('k')} "
+                  f"k_levels={q.get('k_levels')} "
+                  f"cut_ratio={q.get('cut_ratio')} "
+                  f"balance={q.get('balance')}\n")
+        total_cut = max(q.get("edge_cut") or 1, 1)
+        for lv in q.get("levels") or []:
+            share = 100.0 * (lv.get("cut") or 0) / total_cut
+            name = ("level0 (fragmentation)" if lv.get("level") == 0
+                    else f"level{lv.get('level')} (misassignment)")
+            out.write(f"  {name:<26} k={lv.get('k'):<6} "
+                      f"cut {lv.get('cut'):>10,} "
+                      f"({lv.get('cut_ratio')} of edges, "
+                      f"{share:.1f}% of the cut)\n")
+        if q.get("final_refine_repaired") is not None:
+            out.write(f"  final refine repaired     "
+                      f"{q['final_refine_repaired']:>14,} cut edges\n")
+        if q.get("parts_at_capacity") is not None:
+            out.write(f"  capacity-frozen parts     "
+                      f"{q['parts_at_capacity']:>14,} "
+                      f"(frozen load fraction "
+                      f"{q.get('frozen_load_fraction')})\n")
+    rr = parsed["refine_rounds"]
+    if rr:
+        gain = sum(e.get("gain") or 0 for e in rr
+                   if e.get("accepted"))
+        wanted = sum(e.get("moves_wanted") or 0 for e in rr)
+        applied = sum(e.get("moves_applied") or 0 for e in rr)
+        blocked = sum(e.get("moves_capacity_blocked") or 0 for e in rr)
+        out.write(f"refine rounds: {len(rr)}  cut gain {gain:,}  moves "
+                  f"{applied:,}/{wanted:,} applied "
+                  f"({blocked:,} capacity-blocked)\n")
+    for s in parsed["split_balance"]:
+        if s.get("parts_at_capacity"):
+            out.write(f"split balance: k={s.get('k')} "
+                      f"balance={s.get('balance')} "
+                      f"{s['parts_at_capacity']} part(s) at the "
+                      f"capacity ceiling (frozen load fraction "
+                      f"{s.get('frozen_load_fraction')})\n")
+    for jq in parsed["job_quality"]:
+        out.write(f"job quality: job={jq.get('job')} k={jq.get('k')} "
+                  f"cut_ratio={jq.get('cut_ratio')} "
+                  f"balance={jq.get('balance')}\n")
 
 
 def _fmt_flight_event(e: dict, t0: float) -> str:
@@ -489,6 +552,9 @@ def main(argv=None) -> int:
                 "jobs": rep["parsed"]["job_spans"],
                 "tenants": tenant_costs(rep["parsed"]),
                 "flight_dumps": rep["parsed"]["flight_dumps"],
+                "quality_ledgers": rep["parsed"]["quality_ledgers"],
+                "refine_rounds": rep["parsed"]["refine_rounds"],
+                "job_quality": rep["parsed"]["job_quality"],
                 "check_failures": cf,
             })
         doc = {"traces": out}
