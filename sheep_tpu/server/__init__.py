@@ -21,7 +21,14 @@ the partitioner a long-lived RESOURCE instead of a batch process:
 - :mod:`~sheep_tpu.server.protocol` — the JSON wire protocol (request/
   response schema, job states, assignment codec);
 - :mod:`~sheep_tpu.server.client` — the thin client +
-  ``sheep-submit`` CLI (``--watch`` renders live per-job progress);
+  ``sheep-submit`` CLI (``--watch`` renders live per-job progress;
+  ``--reconnect`` rides out daemon bounces with idempotent reattach
+  submits);
+- :mod:`~sheep_tpu.server.journal` — the crash-safe job journal
+  (ISSUE 14): an append-only line-JSON WAL that makes a
+  ``--state-dir`` daemon restart-survivable — queued jobs re-admit,
+  running jobs resume from per-job checkpoints bit-identically, and
+  SIGTERM becomes a graceful checkpoint-and-drain;
 - :mod:`~sheep_tpu.server.sheeptop` — ``sheeptop``, the live console
   view over the ``metrics`` + ``list`` verbs (ISSUE 11).
 

@@ -31,6 +31,18 @@ prints the daemon's Prometheus exposition text; ``--profile DIR``
 (with ``--profile-steps K``) arms an on-demand jax.profiler capture
 of the next K dispatch steps into daemon-side DIR.
 
+Failover (ISSUE 14): ``SheepClient(..., reconnect=N)`` survives a
+daemon bounce — transport errors reconnect with bounded exponential
+backoff (``utils/retry.RetryPolicy`` machinery, transient class) and
+re-send the request. Requests are only auto-retried when re-sending
+is safe: everything except a plain ``submit`` (a blind resend could
+double-build) and ``shutdown``; a submit WITH ``reattach=True`` is
+idempotent (the daemon matches it to the journaled job by spec
+digest) and therefore retried too. ``sheep-submit`` exposes this as
+``--reconnect N``, defaulting ON for ``--watch`` so a daemon restart
+mid-watch keeps the progress lines flowing instead of dying with a
+connection error — the exit-code contract is unchanged.
+
 Exit codes: 0 op succeeded (for --wait/--watch: job DONE), 1 usage/
 transport, 2 daemon answered ok=false, 3 job reached a non-done
 terminal state (failed / cancelled / deadline_exceeded / rejected),
@@ -71,19 +83,60 @@ def _connect(server: str, timeout_s: float) -> socket.socket:
 class SheepClient:
     """One connection to a sheepd; methods mirror the protocol ops and
     return the daemon's response body (raising :class:`ServerError`
-    on ok=false)."""
+    on ok=false). ``reconnect`` arms bounded transport failover (see
+    module docstring); 0 keeps the classic fail-fast behavior."""
 
-    def __init__(self, server: str, timeout_s: float = 600.0):
+    def __init__(self, server: str, timeout_s: float = 600.0,
+                 reconnect: int = 0, reconnect_base_s: float = 0.2):
         self.server = server
-        self._sock = _connect(server, timeout_s)
+        self.timeout_s = timeout_s
+        self.reconnect = int(reconnect)
+        self._reconnect_base_s = float(reconnect_base_s)
+        self._sock = None
+        self._rf = None
+        pol = self._policy()
+        while True:
+            try:
+                self._open()
+                return
+            except OSError as e:
+                # the restart window starts before the first connect:
+                # a client launched while the daemon bounces should
+                # wait for it, not die on ECONNREFUSED
+                self._retry_or_raise(pol, e, "connect")
+
+    def _policy(self):
+        from sheep_tpu.utils import retry as retry_mod
+
+        return retry_mod.RetryPolicy(max_retries=self.reconnect,
+                                     base_delay_s=self._reconnect_base_s,
+                                     max_delay_s=5.0)
+
+    def _retry_or_raise(self, policy, exc, where: str) -> None:
+        from sheep_tpu.utils import retry as retry_mod
+
+        if policy is None or not policy.admit(retry_mod.TRANSIENT):
+            raise exc
+        policy.backoff(retry_mod.TRANSIENT, exc,
+                       where=f"sheep-client.{where}")
+
+    def _open(self) -> None:
+        self._sock = _connect(self.server, self.timeout_s)
         self._rf = self._sock.makefile("rb")
 
-    def close(self) -> None:
+    def _drop(self) -> None:
         try:
-            self._rf.close()
-            self._sock.close()
+            if self._rf is not None:
+                self._rf.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+        self._rf = None
+        self._sock = None
+
+    def close(self) -> None:
+        self._drop()
 
     def __enter__(self) -> "SheepClient":
         return self
@@ -92,25 +145,61 @@ class SheepClient:
         self.close()
         return False
 
+    @staticmethod
+    def _retriable(doc: dict) -> bool:
+        """Safe to blindly re-send after a transport error: everything
+        except a plain submit (double-build risk — reattach makes it
+        idempotent and thus retriable) and shutdown."""
+        op = doc.get("op")
+        if op == "submit":
+            return bool(doc.get("reattach"))
+        return op != "shutdown"
+
     def request(self, doc: dict) -> dict:
-        self._sock.sendall(protocol.dumps(doc))
-        line = self._rf.readline()
-        if not line:
-            raise ServerError("connection closed by daemon")
-        resp = json.loads(line)
-        if not resp.get("ok"):
-            raise ServerError(resp.get("error", "unknown daemon error"))
-        return resp
+        pol = self._policy() if self.reconnect > 0 \
+            and self._retriable(doc) else None
+        while True:
+            try:
+                if self._sock is None:
+                    self._open()
+                self._sock.sendall(protocol.dumps(doc))
+                line = self._rf.readline()
+                if not line:
+                    raise ConnectionResetError(
+                        "connection closed by daemon")
+                resp = json.loads(line)
+            except (OSError, json.JSONDecodeError) as e:
+                self._drop()
+                if isinstance(e, ConnectionResetError) and pol is None:
+                    # the classic (reconnect=0) contract: a daemon
+                    # that hangs up mid-request answers as a daemon
+                    # error, not a transport one
+                    raise ServerError(str(e)) from None
+                self._retry_or_raise(pol, e,
+                                     str(doc.get("op", "request")))
+                continue
+            if not resp.get("ok"):
+                raise ServerError(resp.get("error",
+                                           "unknown daemon error"))
+            return resp
 
     # -- ops -----------------------------------------------------------
     def ping(self) -> dict:
         return self.request({"op": "ping"})
 
     def submit(self, input: str, k, tenant: str = "default",
-               **job_fields) -> dict:
+               reattach: bool = False, **job_fields) -> dict:
+        """``reattach=True`` makes the submit idempotent: the daemon
+        matches the spec digest against existing jobs (journaled ones
+        included) and returns the live/completed twin — with
+        ``"reattached": true`` in the response — instead of building
+        again. The safe shape for retried submits across a daemon
+        restart."""
         job = {"input": input, "k": k, **job_fields}
-        return self.request({"op": "submit", "tenant": tenant,
-                             "job": job})
+        req = {"op": "submit", "tenant": tenant, "job": job}
+        if reattach:
+            req["reattach"] = True
+        return self.request(req)
 
     def status(self, job_id: str) -> dict:
         return self.request({"op": "status", "job_id": job_id})["job"]
@@ -198,6 +287,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of blocking silently")
     p.add_argument("--poll", type=float, default=0.5, metavar="S",
                    help="with --watch: poll interval (default 0.5s)")
+    p.add_argument("--reconnect", type=int, default=None, metavar="N",
+                   help="survive a daemon bounce: retry transport "
+                        "errors up to N times with exponential "
+                        "backoff, re-sending idempotent requests "
+                        "(submits reattach to the journaled job by "
+                        "digest instead of double-building). Default: "
+                        "8 with --watch, else 0")
     p.add_argument("--timeout", type=float, default=None,
                    help="with --wait/--watch: give up after this many "
                         "seconds")
@@ -222,7 +318,12 @@ def build_parser() -> argparse.ArgumentParser:
 def _watch_job(c: "SheepClient", job_id: str, poll_s: float,
                timeout_s: Optional[float]) -> dict:
     """Poll status until terminal (or timeout), rendering one progress
-    line per change on stderr; returns the last descriptor."""
+    line per change on stderr; returns the last descriptor. Daemon
+    bounces are absorbed below in ``request`` when the client was
+    built with ``reconnect`` (the --watch default): each poll retries
+    transports with backoff, so a restarting daemon shows up as a few
+    stderr retry notes and then the resumed job's progress — not a
+    dead watch."""
     import time
 
     t0 = time.monotonic()
@@ -262,8 +363,12 @@ def main(argv=None) -> int:
         p.error("pass exactly one of --input (submit), --status, "
                 "--cancel, --stats, --ping, --metrics, --profile, "
                 "--shutdown")
+    reconnect = args.reconnect if args.reconnect is not None \
+        else (8 if args.watch else 0)
+    if reconnect < 0:
+        p.error("--reconnect must be >= 0")
     try:
-        with SheepClient(args.server) as c:
+        with SheepClient(args.server, reconnect=reconnect) as c:
             if args.ping:
                 print(json.dumps(c.ping()))
                 return 0
@@ -310,7 +415,11 @@ def main(argv=None) -> int:
                     job[field] = val
             if args.comm_volume:
                 job["comm_volume"] = True
-            resp = c.submit(args.input, tenant=args.tenant, **job)
+            # with failover armed the submit itself must be idempotent
+            # (the retried submit against a restarted daemon reattaches
+            # to the journaled job instead of double-building)
+            resp = c.submit(args.input, tenant=args.tenant,
+                            reattach=reconnect > 0, **job)
             if not (args.wait or args.watch):
                 print(json.dumps(resp))
                 return 0

@@ -16,10 +16,22 @@ admitted jobs.
 Faults in a served job degrade THAT job (the per-job retry/degrade
 layer in the engine, ISSUE 9 reused); a handler or protocol error is
 answered on the wire; only a failure of the daemon's own bring-up
-(socket bind, trace sink) is fatal. ``shutdown`` (or SIGTERM/SIGINT)
-runs the clean path: cancel-or-drain the jobs, end every span, stop
-the heartbeat, close the tracer — a clean shutdown leaves a trace
-with ZERO unclosed spans (tools/obs_smoke.sh leg 6 gates this).
+(socket bind, trace sink) is fatal. ``shutdown`` (or SIGINT) runs
+the clean path: cancel-or-drain the jobs, end every span, stop the
+heartbeat, close the tracer — a clean shutdown leaves a trace with
+ZERO unclosed spans (tools/obs_smoke.sh leg 6 gates this).
+
+Durability (ISSUE 14): ``--state-dir`` arms the crash-safe job
+journal + per-job checkpoints, making sheepd restart-survivable —
+kill -9 the daemon mid-build, restart it on the same socket and
+state dir, and the admitted jobs come back: queued ones re-queue,
+running ones RESUME from their last checkpoint, bit-identical to an
+uninterrupted served build. SIGTERM on a durable daemon is a
+graceful drain (``--drain-grace-s``): stop admitting, checkpoint
+running jobs at their next flush barrier, journal the handoff, exit
+0. An exclusive flock'd pidfile under the state dir (or next to the
+unix socket) keeps two sheepds from ever sharing one socket/journal
+— the stale-socket probe alone races a concurrent starter.
 """
 
 from __future__ import annotations
@@ -66,6 +78,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-host", default="127.0.0.1",
                    help="metrics HTTP bind address (default "
                         "127.0.0.1)")
+    p.add_argument("--state-dir", default=None, metavar="DIR",
+                   help="durability root (ISSUE 14): arms the crash-"
+                        "safe job journal (DIR/journal.jsonl), the "
+                        "exclusive daemon lockfile, and per-job "
+                        "checkpoints (DIR/ckpt unless "
+                        "--checkpoint-dir); on startup the journal "
+                        "replays, queued jobs re-admit and running "
+                        "jobs RESUME from their checkpoints")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="with --state-dir: per-job checkpoint root "
+                        "(default STATE_DIR/ckpt)")
+    p.add_argument("--checkpoint-every", type=int, default=16,
+                   metavar="N",
+                   help="with --state-dir: served checkpoint cadence "
+                        "in chunks/groups (default 16)")
+    p.add_argument("--drain-grace-s", type=float, default=10.0,
+                   metavar="S",
+                   help="SIGTERM grace (durable daemons): stop "
+                        "admitting, checkpoint running jobs at their "
+                        "next flush barrier, journal the handoff, "
+                        "exit 0 (default 10s); without --state-dir "
+                        "SIGTERM cancels jobs as before")
     return p
 
 
@@ -79,6 +113,57 @@ class Daemon:
         self._root_span = None
         self._metrics_httpd = None
         self.metrics_port = None  # actual bound port, once listening
+        self._lock_fd = None
+        self._lock_path = None
+
+    # -- exclusive daemon lock (ISSUE 14 satellite) --------------------
+    def _acquire_lock(self) -> None:
+        """Serialize daemon startup per state-dir/socket with an
+        exclusive flock'd pidfile. The stale-socket probe alone RACES
+        a concurrent starter (two probes can both see a dead socket,
+        both unlink, both bind — and then share one journal); the
+        kernel lock is race-free and self-releasing on any death,
+        including SIGKILL. Held for the daemon's lifetime."""
+        import fcntl
+
+        a = self.args
+        if a.state_dir is not None:
+            self._lock_path = os.path.join(a.state_dir, "sheepd.lock")
+        elif a.socket is not None:
+            self._lock_path = a.socket + ".lock"
+        else:
+            return  # TCP without state: the port bind is exclusive
+        fd = os.open(self._lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            try:
+                held_by = os.read(fd, 64).decode("ascii",
+                                                 "replace").strip()
+            except OSError:
+                held_by = "?"
+            os.close(fd)
+            raise SystemExit(
+                f"sheepd: {self._lock_path} is held by a live sheepd "
+                f"(pid {held_by or '?'}); two daemons must not share "
+                f"one socket/journal")
+        os.ftruncate(fd, 0)
+        os.write(fd, f"{os.getpid()}\n".encode("ascii"))
+        os.fsync(fd)
+        self._lock_fd = fd
+
+    def _release_lock(self) -> None:
+        # close releases the flock; the file itself stays (unlinking
+        # it would re-open the open/lock race for waiters holding the
+        # old inode — a stale lockFILE is harmless, only the kernel
+        # lock matters and that dies with the fd/process)
+        if self._lock_fd is None:
+            return
+        try:
+            os.close(self._lock_fd)
+        except OSError:
+            pass
+        self._lock_fd = None
 
     # -- telemetry HTTP listener (ISSUE 11) ----------------------------
     def _start_metrics_http(self):
@@ -210,8 +295,15 @@ class Daemon:
         if op == "submit":
             spec = protocol.JobSpec.from_request(
                 req.get("job"), tenant=req.get("tenant", "default"))
-            job = sched.submit(spec)
+            if req.get("reattach"):
+                # idempotent resubmission (ISSUE 14): a retried submit
+                # reattaches to the journaled/live twin by spec digest
+                # instead of double-building
+                job, reattached = sched.reattach_or_submit(spec)
+            else:
+                job, reattached = sched.submit(spec), False
             return {"ok": True, "job_id": job.id, "state": job.state,
+                    **({"reattached": True} if reattached else {}),
                     **({"error": job.error} if job.error else {})}
         if op in ("status", "wait", "cancel"):
             job_id = req.get("job_id")
@@ -250,6 +342,18 @@ class Daemon:
             info = sched.arm_profile(pdir, steps=req.get("steps", 8))
             return {"ok": True, "profile": info}
         if op == "shutdown":
+            if req.get("suspend"):
+                # the SIGTERM graceful drain, reachable on the wire:
+                # checkpoint + journal the running jobs, then exit 0
+                if sched.journal is None:
+                    raise protocol.ProtocolError(
+                        "shutdown suspend needs a durable daemon "
+                        "(--state-dir)")
+                sched.shutdown_suspend(
+                    float(req.get("grace_s",
+                                  self.args.drain_grace_s)))
+                self._shutdown_evt.set()
+                return {"ok": True, "suspending": True}
             drain = bool(req.get("drain", False))
             sched.shutdown(drain=drain)
             self._shutdown_evt.set()
@@ -267,6 +371,21 @@ class Daemon:
         from sheep_tpu.server.scheduler import Scheduler
 
         a = self.args
+        journal_path = None
+        ckpt_dir = a.checkpoint_dir
+        if a.state_dir is not None:
+            os.makedirs(a.state_dir, exist_ok=True)
+            journal_path = os.path.join(a.state_dir, "journal.jsonl")
+            if ckpt_dir is None:
+                ckpt_dir = os.path.join(a.state_dir, "ckpt")
+        elif ckpt_dir is not None:
+            raise SystemExit("sheepd: --checkpoint-dir needs "
+                             "--state-dir (checkpoints cannot resume "
+                             "jobs a lost journal forgot)")
+        # the exclusive lock comes BEFORE the stale-socket probe: two
+        # concurrent starters must serialize on the kernel lock, not
+        # race the probe/unlink/bind window
+        self._acquire_lock()
         tracer = None
         if a.trace:
             tracer = obs.install(obs.Tracer(a.trace))
@@ -276,7 +395,9 @@ class Daemon:
         try:
             self.scheduler = Scheduler(
                 budget_bytes=a.budget_bytes,
-                root_span_id=getattr(root_span, "id", None))
+                root_span_id=getattr(root_span, "id", None),
+                journal=journal_path, checkpoint_dir=ckpt_dir,
+                checkpoint_every=a.checkpoint_every)
             if tracer is not None and a.heartbeat_secs:
                 # started after the scheduler exists so each beat can
                 # sample its queue depth / active jobs: soak logs show
@@ -293,13 +414,25 @@ class Daemon:
                   f"{self.scheduler.budget or 'unlimited'})",
                   file=sys.stderr, flush=True)
 
-            def _sig(_num, _frame):
+            def _sig_int(_num, _frame):
                 self.scheduler.shutdown(drain=False)
                 self._shutdown_evt.set()
 
+            def _sig_term(_num, _frame):
+                # SIGTERM on a durable daemon is the graceful drain
+                # (ISSUE 14): checkpoint running jobs at their next
+                # flush barrier, journal the handoff, exit 0 — the
+                # next incarnation resumes them. Non-durable daemons
+                # keep the old cancel semantics.
+                if self.scheduler.journal is not None:
+                    self.scheduler.shutdown_suspend(a.drain_grace_s)
+                else:
+                    self.scheduler.shutdown(drain=False)
+                self._shutdown_evt.set()
+
             try:
-                signal.signal(signal.SIGTERM, _sig)
-                signal.signal(signal.SIGINT, _sig)
+                signal.signal(signal.SIGTERM, _sig_term)
+                signal.signal(signal.SIGINT, _sig_int)
             except ValueError:
                 pass  # not the main thread (embedded/test use)
             acceptor = threading.Thread(target=self._accept_loop,
@@ -332,6 +465,7 @@ class Daemon:
                     tracer.heartbeat.stop()
                 obs.uninstall()
                 tracer.close()
+            self._release_lock()
             print("sheepd: shut down cleanly", file=sys.stderr,
                   flush=True)
 

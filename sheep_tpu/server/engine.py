@@ -33,6 +33,24 @@ close the chunk/group iterators — and through them the prefetch
 workers (``Prefetcher.close()``: stop + drain + join) — and end the
 job's phase spans, deterministically, before the job is marked
 cancelled.
+
+Durability (ISSUE 14): a durable scheduler hands each job a per-job
+:class:`~sheep_tpu.utils.checkpoint.Checkpointer` domain (a
+subdirectory of the daemon's checkpoint dir keyed by job id). The
+engine saves at chunk/group boundaries on the checkpointer's cadence
+— each save pulls the carried table to host, which IS the PR-3 flush
+barrier (the pulled state is confirmed, nothing in flight can
+under-represent it) — and on (re)start resumes from the newest intact
+step: degrees resume restores the int64 host totals (exact integer
+addition, so early flushes at save points change nothing), build
+resume restores the carried table and re-folds the remaining chunks
+into it (bit-identical: the same folds in the same order), score
+resume restores the per-k counters and the host forest. A resumed
+served forest is therefore bit-identical to the uninterrupted served
+build, which is itself bit-identical to the cold CLI build.
+:meth:`request_checkpoint` arms an off-cadence save at the next
+boundary — the graceful-drain hook (``sheepd`` SIGTERM): once the
+save lands, ``suspend_ready`` flips and the scheduler parks the job.
 """
 
 from __future__ import annotations
@@ -57,17 +75,30 @@ from sheep_tpu.ops import order as order_ops
 from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
 from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
+from sheep_tpu.utils import checkpoint as ckpt_mod
 from sheep_tpu.utils import retry as retry_mod
 
 
 class JobEngine:
     """Drives one admitted job; see module docstring. ``job`` is a
     :class:`sheep_tpu.server.scheduler.Job`; ``cache`` an optional
-    shared device chunk cache (the daemon's, keyed to this input)."""
+    shared device chunk cache (the daemon's, keyed to this input);
+    ``checkpointer`` an optional per-job recovery domain, with
+    ``resume`` asking for a resume from its newest intact step."""
 
-    def __init__(self, job, cache=None):
+    def __init__(self, job, cache=None, checkpointer=None,
+                 resume: bool = False):
         self.job = job
         self.cache = cache
+        self.ckpt = checkpointer
+        self.resume = bool(resume)
+        # graceful-drain handshake: request_checkpoint() arms an
+        # off-cadence save at the next boundary; the save flips
+        # suspend_ready and the scheduler parks the job (benign
+        # cross-thread bool — armed under the scheduler lock, read by
+        # the dispatch thread between steps)
+        self._ckpt_request = False
+        self.suspend_ready = False
         # live dispatch knobs — the retry layer's degrade hook halves
         # these mid-build; the staging loop restages at the new shape
         self.batch: Optional[int] = None
@@ -76,6 +107,40 @@ class JobEngine:
         self._cs = 0
         self._build_idx = 0
         self._dev_stream = False
+
+    # -- durability hooks (ISSUE 14) -----------------------------------
+    def request_checkpoint(self) -> None:
+        """Arm a save at the next chunk/group boundary regardless of
+        cadence — the scheduler's graceful-drain hook."""
+        if self.ckpt is not None:
+            self._ckpt_request = True
+        else:
+            self.suspend_ready = True  # nothing to save; park now
+
+    def _save(self, phase: str, idx: int, arrays: dict, meta) -> None:
+        self.ckpt.save(phase, int(idx), arrays, meta)
+        stats = self.job.stats
+        stats["ckpt_saves"] = stats.get("ckpt_saves", 0) + 1
+        if self._ckpt_request:
+            self._ckpt_request = False
+            self.suspend_ready = True
+
+    def _save_score(self, idx: int, minp_host, deg_host, cut: dict,
+                    total: int, cv_chunks: dict, rounds: int,
+                    meta) -> None:
+        """Score-phase save: per-k cut counters + the host forest; the
+        cv-key accumulators are compacted into the checkpoint and
+        carried forward compacted (the save_score_state convention)."""
+        arrays = {"minp": np.asarray(minp_host),
+                  "deg": np.asarray(deg_host),
+                  "total": np.int64(total), "rounds": np.int64(rounds)}
+        for k, c in cut.items():
+            arrays[f"cut_k{k}"] = np.int64(c)
+            if self.job.spec.comm_volume:
+                keys = ckpt_mod.compact_cv_keys(cv_chunks[k])
+                arrays[f"cv_k{k}"] = keys
+                cv_chunks[k] = [keys]
+        self._save("score", idx, arrays, meta)
 
     # -- fault hooks (per job; the daemon survives, the job degrades) --
     def _on_resource(self):
@@ -113,23 +178,25 @@ class JobEngine:
         # its own retries usually exhaust and the job FAILS — but the
         # reinit is what keeps the resident daemon able to serve the
         # NEXT job on a fresh runtime instead of failing every request
-        # against a dead accelerator forever. (No snapshot hook: served
-        # jobs have no checkpointer; kill+resume is the CLI contract.)
+        # against a dead accelerator forever. (A durable daemon then
+        # also resumes the lossy job from its last checkpoint on
+        # restart — the served kill+resume contract, ISSUE 14.)
         retry_mod.recover_device_loss(self.job.stats, self._build_idx)
 
     def steps(self):
         """The step generator (see module docstring); sets
         ``job.results`` before finishing."""
         job = self.job
+        spec = job.spec
         stats = job.stats
         stats_acc = obs.stats_accumulator()
         policy = retry_mod.RetryPolicy()
         t_phase: dict = {}
-        with open_input(job.spec.input,
-                        n_vertices=job.spec.num_vertices) as es:
+        with open_input(spec.input,
+                        n_vertices=spec.num_vertices) as es:
             n = es.num_vertices
             check_tpu_vertex_range(n, "sheepd")
-            cs = es.clamp_chunk_edges(job.spec.chunk_edges)
+            cs = es.clamp_chunk_edges(spec.chunk_edges)
             self._n, self._cs = n, cs
             # staged H2D ring (ISSUE 12): device-stream inputs
             # (rmat-hash:/sbm-hash: specs) synthesize chunks in
@@ -140,42 +207,83 @@ class JobEngine:
             # sizing reserves the staged blocks in the HBM model (the
             # tpu backend's ring_model rule)
             self._dev_stream = is_device_stream(es)
-            self.ring = resolve_h2d_ring(job.spec.h2d_ring)
+            self.ring = resolve_h2d_ring(spec.h2d_ring)
             self.batch = resolve_dispatch_batch(
-                job.spec.dispatch_batch, n, cs,
+                spec.dispatch_batch, n, cs,
                 h2d_ring=0 if self._dev_stream else self.ring)
             stats["dispatch_batch"] = self.batch
             job.n_vertices = n
 
+            # ---- durable resume (ISSUE 14) --------------------------
+            meta = None
+            state = None
+            if self.ckpt is not None:
+                # every bit-affecting option is in the fingerprint; a
+                # mismatch (input changed under the journaled job)
+                # raises and FAILS the job — resuming would corrupt it
+                meta = ckpt_mod.stream_meta(
+                    es, k=int(spec.ks[0]), chunk_edges=cs,
+                    weights=spec.weights, alpha=spec.alpha,
+                    comm_volume=spec.comm_volume,
+                    ks=[int(k) for k in spec.ks],
+                    segment_rounds=int(spec.segment_rounds), served=1)
+                state = ckpt_mod.resume_state(self.ckpt, meta,
+                                              self.resume)
+                if state is not None:
+                    stats["resume_phase_idx"] = float(
+                        ckpt_mod.phase_index(state.phase))
+                    stats["resume_chunk_idx"] = float(state.chunk_idx)
+            resume_phase = state.phase if state is not None else None
+
             # ---- degrees --------------------------------------------
             t0 = time.perf_counter()
-            self._enter_phase("degrees")
-            sp = obs.begin_detached("degrees", parent=job.span_id)
+            deg_start = 0
             deg_host = np.zeros(n, dtype=np.int64)
-            deg = degrees_ops.init_degrees(n)
-            flush_every = degrees_ops.flush_every_for(cs)
-            since = 0
-            chunks = _device_chunks(es, cs, n, self.cache, 0,
-                                    self.ring, stats)
-            try:
-                for padded in chunks:
-                    deg = degrees_ops.degree_chunk(deg, padded, n)
-                    since += 1
-                    if since >= flush_every:
-                        deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
-                                               dtype=np.int64)
-                        deg = degrees_ops.init_degrees(n)
-                        since = 0
-                    stats_acc.absorb(stats)
-                    yield "degrees"
-            finally:
-                chunks.close()
-                sp.end()
-            deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
-                                   dtype=np.int64)
+            if resume_phase == "degrees":
+                deg_host = state.arrays["deg"].astype(np.int64)
+                deg_start = int(state.chunk_idx)
+            if resume_phase in (None, "degrees"):
+                self._enter_phase("degrees")
+                sp = obs.begin_detached("degrees", parent=job.span_id)
+                deg = degrees_ops.init_degrees(n)
+                flush_every = degrees_ops.flush_every_for(cs)
+                since = 0
+                idx = deg_start
+                chunks = _device_chunks(es, cs, n, self.cache,
+                                        deg_start, self.ring, stats)
+                try:
+                    for padded in chunks:
+                        deg = degrees_ops.degree_chunk(deg, padded, n)
+                        since += 1
+                        idx += 1
+                        at_ckpt = self.ckpt is not None and (
+                            self.ckpt.due(idx - deg_start)
+                            or self._ckpt_request)
+                        if since >= flush_every or at_ckpt:
+                            # early flushes at save points are exact:
+                            # integer degree sums are associative
+                            deg_host += np.asarray(  # sheeplint: sync-ok
+                                deg[:n], dtype=np.int64)
+                            deg = degrees_ops.init_degrees(n)
+                            since = 0
+                        if at_ckpt:
+                            self._save("degrees", idx,
+                                       {"deg": deg_host}, meta)
+                        stats_acc.absorb(stats)
+                        yield "degrees"
+                finally:
+                    chunks.close()
+                    sp.end()
+                deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
+                                       dtype=np.int64)
+            else:
+                # build/score resume: the completed degree totals ride
+                # in every later-phase checkpoint
+                deg_host = state.arrays["deg"].astype(np.int64)
             t_phase["degrees"] = time.perf_counter() - t0
 
-            # ---- sort (one step) ------------------------------------
+            # ---- sort (one step; recomputed on resume — the order is
+            # a pure deterministic function of the degree totals) -----
             t0 = time.perf_counter()
             self._enter_phase("sort")
             sp = obs.begin_detached("sort", parent=job.span_id)
@@ -196,88 +304,121 @@ class JobEngine:
             yield "sort"
 
             # ---- build: staged batched dispatch ---------------------
-            t0 = time.perf_counter()
-            self._enter_phase("build")
-            sp = obs.begin_detached("build", parent=job.span_id)
-            P = jnp.full(n + 1, n, dtype=jnp.int32)
             total_rounds = 0
-            self._build_idx = 0
-            sentinel_chunk = None
-            try:
-                while True:
-                    batch = self.batch
-                    ring = self.ring
-                    groups = _device_chunk_groups(
-                        es, cs, n, self.cache, self._build_idx, batch,
-                        ring, stats)
-                    restage = False
-                    try:
-                        for group in groups:
-                            gl = len(group)
-                            if gl < batch:
-                                if sentinel_chunk is None:
-                                    sentinel_chunk = jnp.full(
-                                        (cs, 2), n, jnp.int32)
-                                group = group + [sentinel_chunk] * \
-                                    (batch - gl)
-                            loB, hiB = elim_ops.orient_chunks_batch_pos(
-                                jnp.stack(group), pos, n)
-                            while True:
-                                try:
-                                    P2, rounds = \
-                                        elim_ops.fold_segments_batch(
-                                            P, loB, hiB, n,
-                                            segment_rounds=job.spec
-                                            .segment_rounds,
-                                            stats=stats, donate=False)
+            if resume_phase == "score":
+                # build completed before the save; its confirmed forest
+                # rides in the score checkpoint
+                minp_host = state.arrays["minp"]
+                total_rounds = int(state.arrays.get("rounds", 0))
+                t_phase["build"] = 0.0
+            else:
+                t0 = time.perf_counter()
+                self._enter_phase("build")
+                sp = obs.begin_detached("build", parent=job.span_id)
+                if resume_phase == "build":
+                    P = jnp.asarray(state.arrays["p"], dtype=jnp.int32)
+                    self._build_idx = int(state.chunk_idx)
+                    total_rounds = int(state.arrays.get("rounds", 0))
+                else:
+                    P = jnp.full(n + 1, n, dtype=jnp.int32)
+                    self._build_idx = 0
+                sentinel_chunk = None
+                try:
+                    while True:
+                        batch = self.batch
+                        ring = self.ring
+                        groups = _device_chunk_groups(
+                            es, cs, n, self.cache, self._build_idx,
+                            batch, ring, stats)
+                        restage = False
+                        try:
+                            for group in groups:
+                                gl = len(group)
+                                if gl < batch:
+                                    if sentinel_chunk is None:
+                                        sentinel_chunk = jnp.full(
+                                            (cs, 2), n, jnp.int32)
+                                    group = group + [sentinel_chunk] * \
+                                        (batch - gl)
+                                loB, hiB = \
+                                    elim_ops.orient_chunks_batch_pos(
+                                        jnp.stack(group), pos, n)
+                                while True:
+                                    try:
+                                        P2, rounds = \
+                                            elim_ops.fold_segments_batch(
+                                                P, loB, hiB, n,
+                                                segment_rounds=spec
+                                                .segment_rounds,
+                                                stats=stats,
+                                                donate=False)
+                                        break
+                                    except Exception as exc:
+                                        # classify/budget/count/backoff
+                                        # — degrade THIS job, never the
+                                        # daemon; donate=False keeps
+                                        # P/loB/hiB valid for the retry
+                                        retry_mod.handle_build_fault(
+                                            policy, exc,
+                                            f"sheepd.{job.id}.build",
+                                            stats,
+                                            on_resource=self
+                                            ._on_resource,
+                                            on_device_loss=self
+                                            ._on_device_loss)
+                                P = P2
+                                total_rounds += int(rounds)
+                                prev_idx = self._build_idx
+                                self._build_idx += gl
+                                if self.ckpt is not None and (
+                                        self.ckpt.due_span(
+                                            prev_idx, self._build_idx)
+                                        or self._ckpt_request):
+                                    # the pull IS the flush barrier:
+                                    # the saved table is confirmed,
+                                    # nothing queued can under-
+                                    # represent it (PR-3 semantics)
+                                    self._save(
+                                        "build", self._build_idx,
+                                        {"p": np.asarray(P),  # sheeplint: sync-ok
+                                         "deg": deg_host,
+                                         "rounds":
+                                             np.int64(total_rounds)},
+                                        meta)
+                                stats_acc.absorb(stats)
+                                yield "build"
+                                if self.batch != batch \
+                                        or self.ring != ring:
+                                    # degraded mid-stream: restage the
+                                    # remainder at the new shape (and
+                                    # the abandoned supplier's finally
+                                    # drains its staged ring blocks)
+                                    restage = True
                                     break
-                                except Exception as exc:
-                                    # classify/budget/count/backoff —
-                                    # degrade THIS job, never the
-                                    # daemon; donate=False keeps
-                                    # P/loB/hiB valid for the retry
-                                    retry_mod.handle_build_fault(
-                                        policy, exc,
-                                        f"sheepd.{job.id}.build", stats,
-                                        on_resource=self._on_resource,
-                                        on_device_loss=self
-                                        ._on_device_loss)
-                            P = P2
-                            total_rounds += int(rounds)
-                            self._build_idx += gl
-                            stats_acc.absorb(stats)
-                            yield "build"
-                            if self.batch != batch or self.ring != ring:
-                                # degraded mid-stream: restage the
-                                # remainder at the new shape (and the
-                                # abandoned supplier's finally drains
-                                # its staged ring blocks)
-                                restage = True
-                                break
-                    finally:
-                        groups.close()
-                    if not restage:
-                        break
-            finally:
-                sp.end(rounds=int(total_rounds))
+                        finally:
+                            groups.close()
+                        if not restage:
+                            break
+                finally:
+                    sp.end(rounds=int(total_rounds))
+                minp = P[pos]
+                minp_host = np.asarray(minp)  # barrier  # sheeplint: sync-ok
+                t_phase["build"] = time.perf_counter() - t0
             stats["fixpoint_rounds"] = float(total_rounds)
-            minp = P[pos]
-            np.asarray(minp[:1])  # barrier  # sheeplint: sync-ok
-            t_phase["build"] = time.perf_counter() - t0
 
             # ---- split (host, per k — the multi-k reuse query) ------
             t0 = time.perf_counter()
             self._enter_phase("split")
             sp = obs.begin_detached("split", parent=job.span_id)
             try:
-                parent = elim_ops.minp_to_parent(minp, order, n)
+                parent = elim_ops.minp_to_parent(minp_host, order, n)
                 w = deg_host.astype(np.float64) \
-                    if job.spec.weights == "degree" else None
+                    if spec.weights == "degree" else None
                 assigns = {}
-                for k in job.spec.ks:
+                for k in spec.ks:
                     assigns[k] = split_ops.tree_split_host(
                         parent, pos_host, k, weights=w,
-                        alpha=job.spec.alpha)
+                        alpha=spec.alpha)
             finally:
                 sp.end()
             t_phase["split"] = time.perf_counter() - t0
@@ -294,7 +435,22 @@ class JobEngine:
             cut = {k: 0 for k in assigns}
             cv_chunks: dict = {k: [] for k in assigns}
             total = 0
-            chunks = _device_chunks(es, cs, n, self.cache, 0,
+            score_start = 0
+            if resume_phase == "score":
+                score_start = int(state.chunk_idx)
+                total = int(state.arrays["total"])
+                for k in assigns:
+                    cut[k] = int(state.arrays[f"cut_k{k}"])
+                    if spec.comm_volume:
+                        cv_chunks[k] = [state.arrays[f"cv_k{k}"]]
+            elif self.ckpt is not None:
+                # bank build completion at score entry: a crash before
+                # the first cadence save must not re-fold the build
+                # tail from an older build checkpoint
+                self._save_score(0, minp_host, deg_host, cut, total,
+                                 cv_chunks, total_rounds, meta)
+            idx = score_start
+            chunks = _device_chunks(es, cs, n, self.cache, score_start,
                                     self.ring, stats)
             try:
                 for padded in chunks:
@@ -306,11 +462,18 @@ class JobEngine:
                         if first:
                             total += int(tt)  # sheeplint: sync-ok
                             first = False
-                        if job.spec.comm_volume:
+                        if spec.comm_volume:
                             score_ops.accumulate_cv_keys(
                                 cv_chunks[k],
                                 score_ops.cut_pair_keys_host(
                                     padded, a_dev, n, k))
+                    idx += 1
+                    if self.ckpt is not None and (
+                            self.ckpt.due(idx - score_start)
+                            or self._ckpt_request):
+                        self._save_score(idx, minp_host, deg_host, cut,
+                                         total, cv_chunks,
+                                         total_rounds, meta)
                     stats_acc.absorb(stats)
                     yield "score"
             finally:
@@ -319,15 +482,14 @@ class JobEngine:
             t_phase["score"] = time.perf_counter() - t0
 
         from sheep_tpu.core import pure
-        from sheep_tpu.utils.checkpoint import compact_cv_keys
 
         results = []
-        for k in job.spec.ks:
-            cv = int(len(compact_cv_keys(cv_chunks[k]))) \
-                if job.spec.comm_volume else None
+        for k in spec.ks:
+            cv = int(len(ckpt_mod.compact_cv_keys(cv_chunks[k]))) \
+                if spec.comm_volume else None
             bal = pure.part_balance(
                 assigns[k], k,
-                deg_host if job.spec.weights == "degree" else None)
+                deg_host if spec.weights == "degree" else None)
             results.append(PartitionResult(
                 assignment=assigns[k], k=k, edge_cut=cut[k],
                 total_edges=total,

@@ -9,7 +9,8 @@ connection, let alone the daemon.
 Requests (``op`` selects):
 
     {"op": "ping"}
-    {"op": "submit", "tenant": "alice", "job": {...JobSpec fields...}}
+    {"op": "submit", "tenant": "alice", "job": {...JobSpec fields...},
+     "reattach": false}
     {"op": "status", "job_id": "j3"}
     {"op": "wait",   "job_id": "j3", "timeout_s": 30}
     {"op": "cancel", "job_id": "j3"}
@@ -17,7 +18,22 @@ Requests (``op`` selects):
     {"op": "stats"}
     {"op": "metrics"}
     {"op": "profile", "dir": "/tmp/prof", "steps": 8}
-    {"op": "shutdown", "drain": false}
+    {"op": "shutdown", "drain": false, "suspend": false}
+
+Durability verbs (ISSUE 14): ``submit`` with ``"reattach": true`` is
+IDEMPOTENT — the daemon digests the spec (plus the input's content
+identity) and, when a queued/running/done twin exists (journaled jobs
+from before a restart included), answers that job's id with
+``"reattached": true`` instead of building again; failed/cancelled/
+rejected twins do not match (a fresh submit is the retry for those).
+``shutdown`` with ``"suspend": true`` (durable daemons only;
+``grace_s`` optional) is the graceful drain: stop admitting,
+checkpoint running jobs at their next flush barrier, journal the
+handoff, exit 0 — the restarted daemon resumes them. Job ids are
+stable across restarts (the journal floors the id counter), so a
+pre-restart ``job_id`` keeps working in status/wait/cancel; a
+journal-replayed DONE job answers its journaled result summaries,
+without assignment payloads (use ``output`` for those).
 
 Telemetry verbs (ISSUE 11): ``metrics`` answers ``{"ok": true,
 "content_type": ..., "text": "<Prometheus exposition>"}`` — the same

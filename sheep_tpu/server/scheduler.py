@@ -36,6 +36,20 @@ steps: the job's step generator is closed (unwinding through the
 engine's ``finally`` blocks — prefetch workers cancel via
 ``Prefetcher.close``, phase spans end) and only that job changes
 state; the dispatch chain and every other job's table are untouched.
+
+**Durability (ISSUE 14).** With a journal configured
+(:mod:`sheep_tpu.server.journal`), every job is write-ahead logged
+submit->terminal (fsync at admission and terminal) and gets a per-job
+:class:`~sheep_tpu.utils.checkpoint.Checkpointer` domain under
+``checkpoint_dir``; the constructor replays the prior incarnation's
+journal, re-admitting queued jobs and resuming running ones from
+their checkpoints (bit-identical — the engine re-folds the remaining
+chunks into the restored carried table). ``reattach_or_submit`` makes
+retried client submits idempotent by spec digest;
+``shutdown_suspend`` is the graceful drain: checkpoint each running
+job at its next flush barrier, journal the handoff, exit with zero
+unclosed spans. ``sheepd_restarts_total`` / ``sheepd_jobs_resumed_total``
+surface the lineage at /metrics.
 """
 
 from __future__ import annotations
@@ -50,6 +64,7 @@ from typing import Optional
 from sheep_tpu import obs
 from sheep_tpu.obs.flightrec import FlightRecorder
 from sheep_tpu.obs.metrics import MetricRegistry
+from sheep_tpu.server import journal as journal_mod
 from sheep_tpu.server import protocol
 from sheep_tpu.server.engine import JobEngine
 from sheep_tpu.server.protocol import (CANCELLED, DEADLINE_EXCEEDED, DONE,
@@ -158,6 +173,25 @@ class Job:
         # the scheduler drops the cache entry at finalize so the HBM is
         # released and future jobs start a fresh cache
         self.cache_shed = False
+        # ---- durability (ISSUE 14) -----------------------------------
+        # deterministic submit identity (spec + input content), the
+        # reattach key; journaled at submit
+        self.digest: Optional[str] = None
+        # per-job Checkpointer domain + the live engine (the graceful
+        # drain's request_checkpoint handle), set at start
+        self.ckpt = None
+        self.engine = None
+        # True once a graceful drain parked this job with its state on
+        # disk (non-terminal: the journal replays it as resumable)
+        self.suspended = False
+        # a job replayed as terminal from the journal carries result
+        # SUMMARIES only (assignment arrays are not journaled)
+        self.replayed_results: Optional[list] = None
+
+    def journal_spec(self) -> dict:
+        import dataclasses
+
+        return dataclasses.asdict(self.spec)
 
     def descriptor(self, with_results: bool = False) -> dict:
         d = {"job_id": self.id, "tenant": self.spec.tenant,
@@ -188,6 +222,10 @@ class Job:
                     row["assignment"] = protocol.encode_assignment(
                         r.assignment)
                 d["results"].append(row)
+        elif self.state == DONE and self.replayed_results is not None:
+            # journal-replayed completion: scores survive the restart,
+            # assignment payloads do not (use job.output for those)
+            d["results"] = [dict(row) for row in self.replayed_results]
         return d
 
 
@@ -198,7 +236,9 @@ class Scheduler:
     by ``self._lock`` (the condition's lock)."""
 
     def __init__(self, budget_bytes: Optional[int] = None,
-                 root_span_id=None):
+                 root_span_id=None, journal=None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 16):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self.budget = resolve_budget_bytes(budget_bytes)
@@ -209,6 +249,20 @@ class Scheduler:
         self._ids = itertools.count(1)
         self._stop = False
         self._draining = False
+        # ---- durability (ISSUE 14): crash-safe journal + per-job
+        # checkpoint domains. journal is a JobJournal or a path; with
+        # one set, every job is journaled submit->terminal and the
+        # constructor REPLAYS the prior incarnation's journal:
+        # journaled queued jobs re-enter the queue, journaled running
+        # jobs re-enter it flagged resumable (their engines resume
+        # from the per-job checkpoints under checkpoint_dir), and
+        # terminal jobs stay queryable with their journaled scores.
+        self.journal = None
+        self.ckpt_dir = checkpoint_dir
+        self.ckpt_every = max(1, int(checkpoint_every))
+        self._suspending = False
+        self._suspend_deadline = 0.0
+        self._restarts = 0
         self._caches: "OrderedDict[tuple, dict]" = OrderedDict()
         self.totals = {"submitted": 0, "done": 0, "failed": 0,
                        "cancelled": 0, "rejected": 0,
@@ -247,6 +301,19 @@ class Scheduler:
             "sheepd_step_seconds", "one dispatch step", ("phase",),
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        # ---- durability plane (ISSUE 14): restart visibility --------
+        self._m_restarts = self.metrics.counter(
+            "sheepd_restarts_total",
+            "daemon restarts observed in this journal lineage "
+            "(prior daemon_start records at replay)")
+        self._m_resumed = self.metrics.counter(
+            "sheepd_jobs_resumed_total",
+            "journaled RUNNING jobs re-admitted at startup to resume "
+            "from their checkpoints")
+        self._m_reattached = self.metrics.counter(
+            "sheepd_submits_reattached_total",
+            "idempotent resubmissions matched to an existing job by "
+            "digest", ("tenant",))
         # ---- quality plane (ISSUE 13): partition QUALITY is a live,
         # scrapeable series, not just a number in a result payload —
         # per-tenant cut/balance distributions at DONE, plus per-job
@@ -273,21 +340,103 @@ class Scheduler:
         # armed under the lock, driven by the dispatch thread only
         self._profile: Optional[dict] = None
         self.last_profile: Optional[dict] = None
+        if journal is not None:
+            self._recover(journal)
+
+    # ------------------------------------------------------------------
+    # durability: journal replay at startup (ISSUE 14)
+    # ------------------------------------------------------------------
+    def _recover(self, journal) -> None:
+        """Open (or adopt) the journal, replay the prior incarnation's
+        records, and re-seed the queue: queued jobs re-admit as
+        submitted, running jobs re-admit flagged resumable (their
+        engines resume from the per-job checkpoints), terminal jobs
+        stay queryable with journaled scores. Runs in the constructor
+        — before any handler thread exists; the lock is uncontended
+        but keeps every shared-state mutation lexically guarded."""
+        with self._lock:
+            if isinstance(journal, str):
+                journal = journal_mod.JobJournal(journal)
+            self.journal = journal
+            replay = journal.replay()
+            self._restarts = replay.daemon_starts
+            resumed = 0
+            for rj in replay.jobs:
+                try:
+                    spec = JobSpec(
+                        **{k: v for k, v in rj.spec.items()
+                           if k in JobSpec.__dataclass_fields__})
+                except (TypeError, ValueError) as e:
+                    journal_mod._warn(
+                        f"journaled spec of {rj.job_id} does not "
+                        f"reconstruct ({type(e).__name__}: {e}); "
+                        f"dropped")
+                    continue
+                job = Job(rj.job_id, spec, rj.n_vertices,
+                          rj.modeled_bytes)
+                job.digest = rj.digest
+                job.submit_t = rj.submit_t
+                job.deadline_t = None if spec.deadline_s is None \
+                    else rj.submit_t + spec.deadline_s
+                self._jobs[job.id] = job
+                self.totals["submitted"] += 1
+                if rj.terminal:
+                    job.state = rj.state
+                    job.error = rj.error
+                    job.end_t = rj.end_t
+                    job.replayed_results = rj.results
+                    self.totals[rj.state] = \
+                        self.totals.get(rj.state, 0) + 1
+                else:
+                    # both queued and running replay into the queue; a
+                    # running job's per-job checkpoint dir makes its
+                    # restart a RESUME, not a rebuild (and a running
+                    # job that never checkpointed degrades to a clean
+                    # start — the graceful fallback, never a loss of
+                    # the job)
+                    job.state = QUEUED
+                    self._pending.append(job)
+                    if rj.state == RUNNING:
+                        resumed += 1
+                        job.stats["journal_resumed"] = 1
+                obs.event("job_recovered", job=job.id,
+                          tenant=spec.tenant, state=job.state,
+                          journaled_state=rj.state)
+            if replay.jobs or replay.daemon_starts:
+                import sys
+
+                print(f"sheepd: journal replayed {len(replay.jobs)} "
+                      f"job(s) ({len(self._pending)} re-admitted, "
+                      f"{resumed} resumable) after "
+                      f"{replay.daemon_starts} prior start(s)",
+                      file=sys.stderr, flush=True)
+            self._ids = itertools.count(replay.next_id)
+            if replay.daemon_starts:
+                self._m_restarts.inc(replay.daemon_starts)
+            if resumed:
+                self._m_resumed.inc(resumed)
+            journal.append({"rec": "daemon_start", "t": time.time(),
+                            "pid": os.getpid()}, fsync=True)
 
     # ------------------------------------------------------------------
     # submit-side API (connection handler threads)
     # ------------------------------------------------------------------
-    def submit(self, spec: JobSpec) -> Job:
+    def submit(self, spec: JobSpec, digest: Optional[str] = None) -> Job:
         """Validate + model + enqueue. Raises ProtocolError on inputs
         that cannot be opened (answered ok=false; no job is created) —
         admission-budget verdicts come back as a REJECTED job instead,
-        so they are queryable like any other terminal state."""
+        so they are queryable like any other terminal state. ``digest``
+        lets reattach_or_submit hand over the identity it already
+        computed (and matched against) instead of hashing twice."""
+        if digest is None:
+            digest = journal_mod.job_digest(spec)
         n = self._probe_num_vertices(spec)
         modeled, batch, rejected_why = self._model(spec, n)
         with self._lock:
-            if self._stop or self._draining:
+            if self._stop or self._draining or self._suspending:
                 raise protocol.ProtocolError("daemon is shutting down")
             job = Job(f"j{next(self._ids)}", spec, n, modeled)
+            job.digest = digest
             # the admission pre-shed: run at the degraded batch that
             # fits (the same knob an OOM would halve mid-run)
             if batch is not None and batch != spec.dispatch_batch:
@@ -305,11 +454,44 @@ class Scheduler:
                 self._m_terminal.inc(tenant=spec.tenant, state=REJECTED)
             else:
                 self._pending.append(job)
+            if self.journal is not None:
+                # the WAL's admission promise: once the client holds
+                # this job id, a crash cannot lose the job (fsync'd
+                # BEFORE the response leaves; the pre-shed spec is
+                # journaled so the replayed run models identically)
+                self.journal.append(
+                    {"rec": "submit", "job_id": job.id,
+                     "t": job.submit_t, "tenant": spec.tenant,
+                     "digest": digest, "n_vertices": int(n),
+                     "modeled_bytes": modeled, "state": job.state,
+                     **({"error": job.error} if job.error else {}),
+                     "spec": job.journal_spec()}, fsync=True)
             obs.event("job_submit", job=job.id, tenant=spec.tenant,
                       input=spec.input, k=list(spec.ks), state=job.state,
                       modeled_bytes=modeled)
             self._cond.notify_all()
             return job
+
+    def reattach_or_submit(self, spec: JobSpec):
+        """Idempotent resubmission (ISSUE 14): match the spec's digest
+        against existing jobs and return ``(job, True)`` for a live or
+        completed twin instead of double-building — the contract a
+        client's retried submit leans on across a daemon restart. A
+        failed/cancelled/rejected twin does NOT match (retrying those
+        is exactly what a fresh submit is for). The check-then-submit
+        window is unlocked (submit probes the input off-lock), so two
+        simultaneous first-time reattach submits may both build — the
+        retried-client scenario this exists for is serial."""
+        digest = journal_mod.job_digest(spec)
+        with self._lock:
+            for job in reversed(self._jobs.values()):
+                if job.digest == digest \
+                        and job.state in (QUEUED, RUNNING, DONE):
+                    self._m_reattached.inc(tenant=spec.tenant)
+                    obs.event("job_reattach", job=job.id,
+                              tenant=spec.tenant, state=job.state)
+                    return job, True
+        return self.submit(spec, digest=digest), False
 
     def _probe_num_vertices(self, spec: JobSpec) -> int:
         from sheep_tpu.io.edgestream import open_input
@@ -430,6 +612,8 @@ class Scheduler:
                 "uptime_s": round(time.time() - self.started_t, 1),
                 "budget_bytes": self.budget,
                 "reserved_bytes": reserved,
+                "durable": self.journal is not None,
+                "restarts": self._restarts,
                 "jobs": dict(self.totals),
                 "jobs_by_state": by_state,
                 "queued": len(self._pending),
@@ -458,6 +642,89 @@ class Scheduler:
             else:
                 self._stop = True
             self._cond.notify_all()
+
+    def shutdown_suspend(self, grace_s: float = 10.0) -> None:
+        """Graceful drain (ISSUE 14, sheepd's SIGTERM): stop
+        admitting, checkpoint each running job at its next flush
+        barrier, journal the handoff, then let :meth:`run` return —
+        running jobs stay NON-terminal (journal state ``running``), so
+        the next incarnation resumes them where they parked. Queued
+        jobs stay queued. Falls back to plain cancel-shutdown when the
+        scheduler is not durable (nothing could resume them)."""
+        with self._lock:
+            if self.journal is None:
+                self._stop = True
+            elif not self._suspending:
+                self._suspending = True
+                self._suspend_deadline = \
+                    time.monotonic() + max(0.0, float(grace_s))
+                obs.event("daemon_suspend_begin",
+                          grace_s=float(grace_s),
+                          active=len(self._active),
+                          queued=len(self._pending))
+            self._cond.notify_all()
+
+    def _park_locked(self, job: Job) -> None:
+        """Suspend one running job with its state on disk: out of the
+        round-robin, span ended (state=suspended — a graceful drain
+        leaves zero unclosed spans), job NON-terminal. The generator
+        unwind happens outside the lock, like every close."""
+        with self._lock:
+            try:
+                self._active.remove(job)
+            except ValueError:
+                pass
+            job.suspended = True
+            job.engine = None
+            if job.span is not None:
+                job.span.end(state="suspended", steps=job.steps)
+                job.span = None
+            obs.event("job_suspend", job=job.id,
+                      tenant=job.spec.tenant, steps=job.steps,
+                      phase=job.phase)
+
+    def _suspend_cycle(self) -> bool:
+        """One dispatch-loop pass of the graceful drain: arm each
+        active engine's next-barrier checkpoint, park the ones whose
+        save landed (or everything, once the grace deadline passes),
+        and keep stepping the rest. True = fully parked, journal the
+        handoff, run() should return."""
+        to_park = []
+        step_more = []
+        with self._lock:
+            timed_out = time.monotonic() >= self._suspend_deadline
+            for job in list(self._active):
+                eng = job.engine
+                if eng is not None and job.ckpt is not None \
+                        and not timed_out:
+                    eng.request_checkpoint()
+                    if not eng.suspend_ready:
+                        step_more.append(job)
+                        continue
+                # saved (or nothing to save / out of grace: the last
+                # cadence checkpoint still makes restart a resume)
+                to_park.append(job)
+            for job in to_park:
+                self._park_locked(job)
+            done = not self._active
+        for job in to_park:
+            self._close_gen(job)
+        if done:
+            with self._lock:
+                suspended = [j.id for j in self._jobs.values()
+                             if j.suspended]
+                queued = [j.id for j in self._pending]
+                if self.journal is not None:
+                    self.journal.append(
+                        {"rec": "drain", "t": time.time(),
+                         "suspended": suspended, "queued": queued},
+                        fsync=True)
+                obs.event("daemon_suspend_done",
+                          suspended=len(suspended), queued=len(queued))
+            return True
+        for job in step_more:
+            self._step(job)
+        return False
 
     # ------------------------------------------------------------------
     # live telemetry (ISSUE 11): /metrics exposition + heartbeat feed
@@ -651,6 +918,12 @@ class Scheduler:
                     for job in to_close:
                         self._close_gen(job)
                     return
+                if self._suspending:
+                    # graceful drain: no admissions, checkpoint + park
+                    # the active jobs, exit once everything is parked
+                    if self._suspend_cycle():
+                        return
+                    continue
                 with self._lock:
                     self._admit_locked()
                     if self._draining and not self._pending \
@@ -688,6 +961,9 @@ class Scheduler:
         self.flight.dump_all(reason="shutdown")
         if obs.get_flight() is self.flight:
             obs.uninstall_flight()
+        with self._lock:
+            if self.journal is not None:
+                self.journal.close()
 
     def _expire_locked(self) -> None:
         # reentrant re-acquire (RLock): callers already hold the lock;
@@ -727,7 +1003,26 @@ class Scheduler:
                 k=list(job.spec.ks))
             job.span_id = getattr(job.span, "id", None)
             cache = self._lease_cache_locked(job)
-            job.gen = JobEngine(job, cache=cache).steps()
+            if self.ckpt_dir is not None:
+                # per-job recovery domain: job ids are stable across
+                # restarts (the journal floors the id counter), so a
+                # re-admitted job finds exactly its own prior state;
+                # resume=True is a no-op on an empty domain
+                from sheep_tpu.utils.checkpoint import Checkpointer
+
+                job.ckpt = Checkpointer(
+                    os.path.join(self.ckpt_dir, job.id),
+                    every=self.ckpt_every)
+            engine = JobEngine(job, cache=cache, checkpointer=job.ckpt,
+                               resume=job.ckpt is not None)
+            job.engine = engine
+            job.gen = engine.steps()
+            if self.journal is not None:
+                # buffered, not fsync'd: losing this record merely
+                # replays the job as queued (a clean re-start)
+                self.journal.append({"rec": "state", "job_id": job.id,
+                                     "state": RUNNING,
+                                     "t": job.start_t})
             self._active.append(job)
             obs.event("job_admit", job=job.id, tenant=job.spec.tenant,
                       modeled_bytes=job.modeled_bytes,
@@ -837,6 +1132,27 @@ class Scheduler:
             retries = job.stats.get("dispatch_retries")
             if isinstance(retries, (int, float)) and retries:
                 self._m_retries.inc(int(retries), tenant=job.spec.tenant)
+            if self.journal is not None:
+                results = None
+                if state == DONE and job.results:
+                    results = [r.summary() for r in job.results]
+                self.journal.append(
+                    {"rec": "terminal", "job_id": job.id,
+                     "state": state, "t": job.end_t,
+                     **({"error": error} if error else {}),
+                     **({"results": results} if results else {})},
+                    fsync=True)
+            if job.ckpt is not None:
+                # terminal jobs leave no checkpoint residue: the
+                # per-job domain dies with the job (a replayed
+                # terminal never resumes)
+                try:
+                    job.ckpt.clear(force=True)
+                    os.rmdir(job.ckpt.dir)
+                except OSError:
+                    pass
+                job.ckpt = None
+            job.engine = None
             if job.span is not None:
                 cost = {k: job.stats[k]
                         for k in ("device_rounds", "host_syncs",

@@ -538,14 +538,16 @@ def test_profile_capture_stops_when_jobs_drain(tmp_path):
 
 @pytest.mark.slow
 def test_served_soak_tool():
-    """The full daemon-subprocess mini-soak: one oom + one read leg
-    through a real sheepd on a unix socket (see tools/served_soak.py);
-    the tier-1 twin above covers the same faults in-process."""
+    """The full daemon-subprocess mini-soak: one oom + one read leg,
+    plus the durable restart (SIGKILL) and drain (SIGTERM) legs,
+    through real sheepds on unix sockets (see tools/served_soak.py);
+    the tier-1 twins (here and tests/test_journal.py) cover the same
+    faults in-process."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "served_soak.py")],
         cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu",
                        "PYTHONPATH": REPO},
-        capture_output=True, text=True, timeout=600)
+        capture_output=True, text=True, timeout=1200)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     verdicts = [json.loads(line) for line in r.stdout.splitlines()]
     assert verdicts[-1]["ok"] is True

@@ -414,7 +414,92 @@ python tools/quality_regress.py "$QUAL9" QUALITY_r01.json \
     > "$OUT/quality_gate.txt"
 grep -q "verdict: PASS" "$OUT/quality_gate.txt"
 
+# tenth leg: durable sheepd (ISSUE 14) — kill -9 the daemon mid-build
+# through the real CLI, restart it on the same socket/journal/state
+# dir: the journaled job must RESUME from its per-job checkpoint (the
+# resume event on the record, rendered by trace_report), the restart
+# counters must be exported at /metrics, and --check must stay green
+# across the appended daemon runs.
+TRACE10="$OUT/trace_durable.jsonl"
+SOCK10="$OUT/sheepd_durable.sock"
+STATE10="$OUT/sheepd_state"
+rm -f "$TRACE10" "$SOCK10"
+rm -rf "$STATE10"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK10" --trace "$TRACE10" --heartbeat-secs 0.2 \
+    --state-dir "$STATE10" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_durable.err" &
+SHEEPD10_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do [ -S "$SOCK10" ] && break; sleep 0.2; done
+[ -S "$SOCK10" ] || { echo "durable sheepd never bound $SOCK10" >&2; exit 1; }
+# submit through the real CLI (small chunks + batch 1: many observable
+# build steps), then poll until the kill window is INSIDE the build
+JID10=$(JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK10" --input rmat:12:8:3 --k 4 --tenant durable \
+    --chunk-edges 512 --dispatch-batch 1 \
+    | python -c "import json,sys; print(json.load(sys.stdin)['job_id'])")
+JAX_PLATFORMS=cpu python - "$SOCK10" "$JID10" <<'PYEOF'
+import sys
+import time
+
+from sheep_tpu.server.client import SheepClient
+
+with SheepClient(sys.argv[1]) as c:
+    for _ in range(4000):
+        st = c.status(sys.argv[2])
+        if st.get("phase") == "build" and st.get("steps", 0) >= 3:
+            sys.exit(0)
+        if st.get("state") not in ("queued", "running"):
+            raise SystemExit(f"job left the kill window: {st}")
+        time.sleep(0.005)
+raise SystemExit("job never reached the build phase")
+PYEOF
+kill -9 "$SHEEPD10_PID"
+wait "$SHEEPD10_PID" 2>/dev/null || true
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK10" --trace "$TRACE10" --heartbeat-secs 0.2 \
+    --state-dir "$STATE10" --checkpoint-every 1 --metrics-port 0 \
+    2>> "$OUT/sheepd_durable.err" &
+SHEEPD10_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID 2>/dev/null || true' EXIT
+# the client failover path rides out the restart window (stale socket
+# file, then the rebinding daemon) and the resumed job must finish;
+# the restart counters come from the HTTP /metrics scrape
+JAX_PLATFORMS=cpu python - "$SOCK10" "$JID10" "$OUT/sheepd_durable.err" \
+    > "$OUT/durable.json" 2> "$OUT/durable.err" <<'PYEOF'
+import json
+import re
+import sys
+import urllib.request
+
+from sheep_tpu.obs.metrics import parse_prometheus
+from sheep_tpu.server.client import SheepClient
+
+sock, jid, err_path = sys.argv[1], sys.argv[2], sys.argv[3]
+with SheepClient(sock, reconnect=40, reconnect_base_s=0.3) as c:
+    job = c.wait(jid, timeout_s=300)
+    assert job["state"] == "done", job
+    ports = re.findall(r"metrics on http://[^:]+:(\d+)",
+                       open(err_path).read())
+    url = f"http://127.0.0.1:{ports[-1]}/metrics"
+    m = parse_prometheus(
+        urllib.request.urlopen(url, timeout=10).read().decode())
+    restarts = sum(v for _, v in m.get("sheepd_restarts_total", []))
+    resumed = sum(v for _, v in m.get("sheepd_jobs_resumed_total", []))
+    assert restarts >= 1, m.get("sheepd_restarts_total")
+    assert resumed >= 1, m.get("sheepd_jobs_resumed_total")
+    print(json.dumps({"state": job["state"], "restarts": restarts,
+                      "jobs_resumed": resumed}))
+    c.shutdown()
+PYEOF
+wait "$SHEEPD10_PID"
+python tools/trace_report.py "$TRACE10" --check > "$OUT/report_durable.txt"
+grep -q '"event": "resume"' "$TRACE10"        # the checkpoint resume seam
+grep -q '"event": "job_recovered"' "$TRACE10" # the journal replay seam
+grep -q "resume:" "$OUT/report_durable.txt"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10"

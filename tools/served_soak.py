@@ -1,21 +1,32 @@
 #!/usr/bin/env python
 """Served-job mini-soak (ISSUE 10 satellite; chaos_soak's pattern
-applied to sheepd): inject one OOM-class fault and one read fault into
-served jobs and assert the DAEMON survives with the job verdict
+applied to sheepd): inject one OOM-class fault, one read fault, one
+SIGKILL and one SIGTERM drain into served jobs and assert the DAEMON
+(or its restarted incarnation) survives with the job verdict
 ``identical`` or ``degraded_documented``.
 
     python tools/served_soak.py [--out DIR]
 
-Two legs, each a REAL ``sheepd`` subprocess on a unix socket over a
+Four legs, each a REAL ``sheepd`` subprocess on a unix socket over a
 real on-disk graph (so the edgestream read points are live):
 
-    oom    SHEEP_FAULT_INJECT=oom@dispatch:1 — RESOURCE_EXHAUSTED at
-           the first issued dispatch of the served build; the per-job
-           retry layer must degrade/re-fold bit-identically and leave
-           the ``dispatch_retries`` trail in the job diagnostics.
-    read   SHEEP_FAULT_INJECT=read@read:2 — a torn physical read; the
-           edgestream's bounded transient retry absorbs it below the
-           scheduler entirely.
+    oom      SHEEP_FAULT_INJECT=oom@dispatch:1 — RESOURCE_EXHAUSTED at
+             the first issued dispatch of the served build; the per-job
+             retry layer must degrade/re-fold bit-identically and leave
+             the ``dispatch_retries`` trail in the job diagnostics.
+    read     SHEEP_FAULT_INJECT=read@read:2 — a torn physical read; the
+             edgestream's bounded transient retry absorbs it below the
+             scheduler entirely.
+    restart  (ISSUE 14) SIGKILL the durable daemon mid-build, restart
+             it on the same socket/journal/state dir: the journaled job
+             must RESUME from its per-job checkpoint (the
+             ``sheepd_jobs_resumed_total`` counter is required — a leg
+             where the kill landed after completion proved nothing) and
+             finish bit-identical to the clean oracle.
+    drain    (ISSUE 14) SIGTERM the durable daemon mid-build: it must
+             exit rc=0 after checkpointing the job at its next flush
+             barrier (the graceful drain), and the restarted daemon
+             must resume it to a bit-identical finish.
 
 Per leg the verdict is exactly chaos_soak's taxonomy:
 
@@ -23,8 +34,9 @@ Per leg the verdict is exactly chaos_soak's taxonomy:
     degraded_documented  differs, but the job carries a documented
                          degradation marker (quarantined chunks)
     wrong_forest         differs with NO documentation — a real bug
-    unhandled_crash      the job failed, the daemon died, or it
-                         stopped answering pings after the fault
+    unhandled_crash      the job failed, the daemon died (or, durable
+                         legs: never resumed / drain exited nonzero),
+                         or it stopped answering pings after the fault
 
 After each job the daemon must still answer ``ping`` (the fault
 degraded the JOB, not the service) and must shut down rc=0. Exit 0
@@ -37,6 +49,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -50,21 +63,29 @@ LEGS = (
     ("read", "read@read:2"),
 )
 
+# the durable legs (ISSUE 14) kill/drain the daemon MID-BUILD; the
+# graph is bigger and the chunks smaller so the build phase has
+# dozens of observable steps to land the signal in
+DURABLE_V = 4096
+DURABLE_E = 32768
+DURABLE_CHUNK = 256
 
-def build_graph(path: str) -> None:
+
+def build_graph(path: str, n: int = 512, m: int = 4096) -> None:
     from sheep_tpu.io import formats, generators
 
-    formats.write_edges(path, generators.random_graph(512, 4096, seed=7))
+    formats.write_edges(path, generators.random_graph(n, m, seed=7))
 
 
-def clean_oracle(path: str):
+def clean_oracle(path: str, n: int = 512, chunk_edges: int = 512):
     """The fault-free reference assignment, computed in THIS process
     (the daemons never see a fault-free run — the oracle must not)."""
     from sheep_tpu import _partition_stream
     from sheep_tpu.io.edgestream import open_input
 
-    with open_input(path, n_vertices=512) as es:
-        res = _partition_stream(es, 4, backend="tpu", chunk_edges=512,
+    with open_input(path, n_vertices=n) as es:
+        res = _partition_stream(es, 4, backend="tpu",
+                                chunk_edges=chunk_edges,
                                 comm_volume=False)
     return res.assignment
 
@@ -151,9 +172,127 @@ def run_leg(name: str, inject: str, graph: str, out_dir: str,
             proc.wait(timeout=10)
 
 
+def _spawn_durable_daemon(sock, trace, state_dir, err_f):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    return subprocess.Popen(
+        [sys.executable, "-m", "sheep_tpu.server.daemon",
+         "--socket", sock, "--trace", trace,
+         "--state-dir", state_dir, "--checkpoint-every", "1",
+         "--drain-grace-s", "30", "--heartbeat-secs", "0.2"],
+        cwd=REPO, env=env, stderr=err_f)
+
+
+def run_durable_leg(name: str, sig: int, graph: str, out_dir: str,
+                    oracle) -> dict:
+    """ISSUE 14: signal the durable daemon mid-build (SIGKILL for the
+    restart leg, SIGTERM for the graceful drain), restart it on the
+    same socket/journal, and require the job to RESUME — counter on
+    the record — to a forest bit-equal to the clean oracle."""
+    import numpy as np
+
+    from sheep_tpu.obs.metrics import parse_prometheus
+    from sheep_tpu.server.client import ServerError, SheepClient
+
+    sock = os.path.join(out_dir, f"soak_{name}.sock")
+    trace = os.path.join(out_dir, f"soak_{name}.jsonl")
+    state_dir = os.path.join(out_dir, f"soak_{name}.state")
+    err_path = os.path.join(out_dir, f"soak_{name}.err")
+    rec = {"leg": name,
+           "inject": "SIGKILL mid-build" if sig == signal.SIGKILL
+           else "SIGTERM graceful drain mid-build"}
+    err_f = open(err_path, "w")
+    proc = _spawn_durable_daemon(sock, trace, state_dir, err_f)
+    proc2 = None
+    try:
+        for _ in range(300):
+            if os.path.exists(sock) or proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        if not os.path.exists(sock):
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = f"daemon never bound (rc={proc.poll()})"
+            return rec
+        with SheepClient(sock) as c:
+            r = c.submit(graph, k=4, tenant="soak",
+                         chunk_edges=DURABLE_CHUNK,
+                         num_vertices=DURABLE_V, dispatch_batch=1,
+                         return_assignment=True)
+            job_id = r["job_id"]
+            # land the signal INSIDE the build phase: a kill that
+            # arrives after completion proves nothing
+            landed = False
+            for _ in range(4000):
+                st = c.status(job_id)
+                if st["state"] in ("done", "failed"):
+                    break
+                if st.get("phase") == "build" \
+                        and st.get("steps", 0) >= 3:
+                    landed = True
+                    break
+                time.sleep(0.005)
+            if not landed:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = (f"signal window missed: job reached "
+                                f"{st.get('state')}/{st.get('phase')} "
+                                f"before mid-build")
+                return rec
+            rec["killed_at_steps"] = st.get("steps")
+        proc.send_signal(sig)
+        proc.wait(timeout=120)
+        rec["first_daemon_rc"] = proc.returncode
+        if sig == signal.SIGTERM and proc.returncode != 0:
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = (f"graceful drain exited "
+                            f"rc={proc.returncode}, want 0")
+            return rec
+        # restart on the SAME socket/journal/state dir; the stale
+        # socket file (SIGKILL case) must be probed away and the
+        # journaled job must come back resumable
+        proc2 = _spawn_durable_daemon(sock, trace, state_dir, err_f)
+        with SheepClient(sock, reconnect=40,
+                         reconnect_base_s=0.3) as c:
+            try:
+                job = c.wait(job_id, timeout_s=300)
+            except ServerError as e:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = f"restarted daemon lost the job: {e}"
+                return rec
+            rec["state"] = job.get("state")
+            metrics = parse_prometheus(c.metrics())
+            rec["jobs_resumed"] = sum(
+                v for _, v in
+                metrics.get("sheepd_jobs_resumed_total", []))
+            rec["restarts"] = sum(
+                v for _, v in metrics.get("sheepd_restarts_total", []))
+            if job.get("state") != "done":
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = job.get("error", "job not done")
+                return rec
+            served = c.result_assignment(job)
+            rec["verdict"] = "identical" if np.array_equal(
+                served, np.asarray(oracle)) else "wrong_forest"
+            try:
+                c.shutdown()
+            except (ServerError, OSError):
+                pass
+        proc2.wait(timeout=60)
+        rec["daemon_rc"] = proc2.returncode
+        if proc2.returncode != 0:
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = f"restarted daemon exit rc={proc2.returncode}"
+        return rec
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        err_f.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="sheepd fault mini-soak (one oom + one read leg)")
+        description="sheepd fault mini-soak (oom + read + restart + "
+                    "drain legs)")
     ap.add_argument("--out", default=None,
                     help="artifact dir (default: fresh temp dir)")
     args = ap.parse_args(argv)
@@ -178,6 +317,28 @@ def main(argv=None) -> int:
             print(json.dumps({"leg": name,
                               "error": "no dispatch_retries trail — "
                                        "injection never fired"}),
+                  flush=True)
+            ok = False
+
+    # the durable legs (ISSUE 14): kill -9 + restart, then graceful
+    # drain + restart, both resuming to the clean oracle's bits
+    big_graph = os.path.join(out_dir, "soak_big.bin64")
+    build_graph(big_graph, n=DURABLE_V, m=DURABLE_E)
+    big_oracle = clean_oracle(big_graph, n=DURABLE_V,
+                              chunk_edges=DURABLE_CHUNK)
+    for name, sig in (("restart", signal.SIGKILL),
+                      ("drain", signal.SIGTERM)):
+        rec = run_durable_leg(name, sig, big_graph, out_dir,
+                              big_oracle)
+        print(json.dumps(rec), flush=True)
+        if rec["verdict"] not in ("identical", "degraded_documented"):
+            ok = False
+        if rec.get("verdict") == "identical" \
+                and not rec.get("jobs_resumed"):
+            print(json.dumps({"leg": name,
+                              "error": "no sheepd_jobs_resumed_total "
+                                       "trail — the restart never "
+                                       "resumed anything"}),
                   flush=True)
             ok = False
     print(json.dumps({"soak": "served", "ok": ok, "out": out_dir}),
