@@ -303,6 +303,38 @@ def measure(scale: int, platform: str) -> dict:
     out["warm_request_s"] = round(tpu_s, 2)
     log(f"served-request comparison: cold {warm_s:.2f}s vs warm "
         f"{tpu_s:.2f}s ({warm_s / max(tpu_s, 1e-9):.1f}x)")
+    # incremental contract field (ISSUE 15): one resident-partition
+    # update — a delta batch folded into a converged carried table —
+    # timed at a reduced scale so the leg stays seconds everywhere
+    # (the metric tracks the UPDATE machinery, not the headline build;
+    # scale rides in the metric string via the derived size). Gated
+    # lower-better by bench_regress like warm_request_s; compactions
+    # rides info-only.
+    try:
+        import numpy as np
+
+        from sheep_tpu import incremental as inc_mod
+
+        us = max(10, scale - 4)
+        un = 1 << us
+        ustream = generators.RmatHashStream(us, edge_factor, seed=42)
+        ube = get_backend("tpu", chunk_edges=min(accel_chunk,
+                                                 un * edge_factor))
+        ustate, _ = inc_mod.begin_incremental(ustream, k, backend=ube,
+                                  comm_volume=False)
+        delta = np.random.default_rng(1234).integers(
+            0, un, (min(1 << 15, max(1024, (un * edge_factor) // 256)),
+                    2), dtype=np.int64)
+        t0 = time.perf_counter()
+        ube.partition_update(ustate, adds=delta, score=False)
+        out["update_request_s"] = round(time.perf_counter() - t0, 4)
+        out["compactions"] = int(ustate.compactions)
+        log(f"incremental: update_request_s "
+            f"{out['update_request_s']}s (RMAT-{us}, "
+            f"{len(delta)} delta edges, epoch {ustate.epoch})")
+    except Exception as e:  # noqa: BLE001 — the leg must not kill bench
+        log(f"incremental leg skipped: {type(e).__name__}: "
+            f"{str(e)[:200]}")
     # per-segment build-wall attribution (t_warm_s/t_full_s/t_small_s/
     # t_host_tail_s — elim.py accumulates them per sync), the numbers
     # that decompose build wall into device floor vs tunnel/host tax
@@ -523,7 +555,7 @@ def main():
               "degraded_inflight", "degraded_h2d_ring",
               "device_loss_recoveries",
               "checkpoint_degraded", "warm_up_s", "cold_request_s",
-              "warm_request_s"):
+              "warm_request_s", "update_request_s", "compactions"):
         if f in result:
             extra[f] = result[f]
     if failures:

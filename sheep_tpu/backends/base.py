@@ -100,6 +100,37 @@ class Partitioner(abc.ABC):
     # decide whether its level 0 gets a nested chunk-checkpoint domain
     # or level-boundary-only recovery
     supports_checkpoint: bool = False
+    # True when the backend implements _fold_delta (fold a host delta
+    # batch into a converged carried table) — the incremental-
+    # repartitioning capability (ISSUE 15): partition_update applies
+    # epoch-stamped add/tombstone batches in O(Δ) instead of an O(E)
+    # rebuild, bit-identical to a one-shot build of the delta: input
+    # under the anchored order (sheep_tpu/incremental.py)
+    supports_incremental: bool = False
+
+    def partition_update(self, state, adds=None, deletes=None, **opts):
+        """Fold one delta epoch into a resident
+        :class:`~sheep_tpu.incremental.PartitionState` (created by
+        :func:`sheep_tpu.incremental.begin_incremental`): adds fold into the
+        converged carried table via this backend's ``_fold_delta``
+        hook, deletes tombstone (compaction rebuilds their subtrees —
+        ``compact=`` forwards to the driver), the epoch advances, and
+        ``score=True`` (default) returns the refreshed scored
+        result(s). See :mod:`sheep_tpu.incremental` for the exactness
+        contract."""
+        if not self.supports_incremental:
+            raise ValueError(
+                f"backend {self.name!r} does not support incremental "
+                f"updates (supports_incremental is False); use "
+                f"pure/cpu/tpu")
+        from sheep_tpu import incremental
+
+        return incremental.apply_update(self, state, adds=adds,
+                                        deletes=deletes, **opts)
+
+    def _fold_delta(self, state, edges) -> None:
+        raise NotImplementedError(
+            f"backend {self.name!r} declares no delta fold")
 
 
 def score_stream(stream, assignments, chunk_edges: int = 1 << 22,

@@ -25,10 +25,28 @@ if not native.available():  # pragma: no cover - toolchain missing
 class CpuBackend(Partitioner):
     name = "cpu"
     supports_checkpoint = True
+    supports_incremental = True
 
     def __init__(self, chunk_edges: int = 1 << 22, alpha: float = 1.0):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
+
+    def _fold_delta(self, state, edges) -> None:
+        """Incremental fold (ISSUE 15): extend the converged carried
+        forest with a delta batch under the state's ANCHORED order —
+        exactly the streaming build's carried-parent continuation, so
+        the result is the unique fixpoint of the grown multiset."""
+        from sheep_tpu.incremental import (_minp_from_parent,
+                                           _parent_from_minp)
+
+        n = state.n
+        parent = _parent_from_minp(state.minp, state.order, n)
+        e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        for off in range(0, len(e), self.chunk_edges):
+            parent = native.build_elim_tree(
+                e[off: off + self.chunk_edges], state.pos,
+                parent=parent)
+        state.minp = _minp_from_parent(parent, state.pos, n)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
@@ -55,10 +73,19 @@ class CpuBackend(Partitioner):
             deg = np.zeros(n, dtype=np.int64)
         sp = obs.begin("degrees")
         obs.progress(phase="degrees", chunks_done=0, edges_done=0)
+        # anchored-order streams (delta: inputs, io/deltalog.py): the
+        # elimination order derives from the BASE segment's degrees —
+        # the contract that makes the incremental path bit-identical
+        # to this one-shot build; build/score still stream the full
+        # surviving multiset
+        anchored = bool(getattr(stream, "order_anchor", False))
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             idx = start
-            for chunk in stream.chunks(self.chunk_edges, start_chunk=start):
+            deg_chunks = stream.anchor_chunks(
+                self.chunk_edges, start_chunk=start) if anchored \
+                else stream.chunks(self.chunk_edges, start_chunk=start)
+            for chunk in deg_chunks:
                 native.degrees(chunk, n, out=deg)
                 idx += 1
                 maybe_fail("degrees", idx - start)

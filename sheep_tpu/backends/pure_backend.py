@@ -21,10 +21,26 @@ from sheep_tpu.types import ElimTree, PartitionResult
 @register
 class PureBackend(Partitioner):
     name = "pure"
+    supports_incremental = True
 
     def __init__(self, chunk_edges: int = 1 << 22, alpha: float = 1.0):
         self.chunk_edges = chunk_edges
         self.alpha = alpha
+
+    def _fold_delta(self, state, edges) -> None:
+        """Incremental fold (ISSUE 15): the oracle twin of the cpu/tpu
+        hooks — continue the carried forest under the anchored order."""
+        from sheep_tpu.incremental import (_minp_from_parent,
+                                           _parent_from_minp)
+
+        n = state.n
+        parent = _parent_from_minp(state.minp, state.order, n)
+        e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        for off in range(0, len(e), self.chunk_edges):
+            parent = pure.build_elim_tree(
+                e[off: off + self.chunk_edges], state.pos,
+                parent=parent).parent
+        state.minp = _minp_from_parent(parent, state.pos, n)
 
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, **opts) -> PartitionResult:
@@ -41,7 +57,12 @@ class PureBackend(Partitioner):
         sp = obs.begin("degrees")
         deg = np.zeros(n, dtype=np.int64)
         idx = 0
-        for chunk in stream.chunks(self.chunk_edges):
+        # anchored-order streams (delta: inputs): degrees come from the
+        # base segment only — the delta-log order contract
+        deg_chunks = stream.anchor_chunks(self.chunk_edges) \
+            if getattr(stream, "order_anchor", False) \
+            else stream.chunks(self.chunk_edges)
+        for chunk in deg_chunks:
             deg += pure.degrees(chunk, n)
             idx += 1
             obs.chunk_progress(idx, self.chunk_edges, m_cheap)

@@ -296,6 +296,7 @@ class TpuBackend(Partitioner):
     name = "tpu"
     supports_checkpoint = True
     supports_multidevice = False  # single-device; see sheep_tpu/parallel
+    supports_incremental = True   # partition_update via _fold_delta
 
     def __init__(self, chunk_edges: int = 1 << 22, lift_levels: int = 0,
                  alpha: float = 1.0, segment_rounds: int = 2,
@@ -421,6 +422,50 @@ class TpuBackend(Partitioner):
                                       inflight=inflight, donate=donate,
                                       h2d_ring=h2d_ring)
 
+    def _fold_delta(self, state, edges) -> None:
+        """Incremental fold (ISSUE 15): stage the delta batch as
+        padded [N, C] blocks and fold them into the converged carried
+        table with the EXISTING batched dispatch
+        (``ops/elim.py fold_segments_batch``) under the state's
+        anchored order — one bounded device program per group, the
+        same unique fixpoint any dispatch shape lands on. O(Δ) device
+        work; the vertex-space minp crosses to/from position space
+        only at the batch boundary."""
+        n = state.n
+        e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        if not len(e):
+            return
+        # power-of-two delta chunk keeps the set of compiled program
+        # shapes logarithmic across arbitrary delta sizes
+        cs = elim_ops.pow2_at_least(min(len(e), self.chunk_edges),
+                                    floor=1 << 10)
+        batch_n = self._resolve_dispatch_batch(n, cs)
+        pos_sent = np.concatenate([state.pos.astype(np.int32),
+                                   np.asarray([n], np.int32)])
+        order_sent = np.concatenate([state.order,
+                                     np.asarray([n], np.int64)])
+        pos_dev = jnp.asarray(pos_sent)
+        P = jnp.asarray(state.minp[order_sent])
+        stats = state.stats
+        chunks = [pad_chunk(e[off: off + cs], cs, n)
+                  for off in range(0, len(e), cs)]
+        for g0 in range(0, len(chunks), batch_n):
+            group = chunks[g0: g0 + batch_n]
+            # designed upload window: delta batches are host arrays by
+            # definition (they arrived over a wire/log); one staged
+            # transfer per bounded group, off the steady-state path
+            block = jnp.asarray(  # sheeplint: h2d-ok
+                np.stack(group))
+            loB, hiB = elim_ops.orient_chunks_batch_pos(block, pos_dev,
+                                                        n)
+            P, rounds = elim_ops.fold_segments_batch(
+                P, loB, hiB, n, segment_rounds=self.segment_rounds,
+                stats=stats, donate=False)
+            stats["update_rounds"] = \
+                stats.get("update_rounds", 0) + int(rounds)
+        # designed pull: the converged table is the update's product
+        state.minp = np.asarray(P[pos_dev])  # sheeplint: sync-ok
+
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
                   resume: bool = False, **opts) -> PartitionResult:
@@ -486,6 +531,14 @@ class TpuBackend(Partitioner):
         build_stats: dict = {}
         sp = obs.begin("degrees")
         obs.progress(phase="degrees", chunks_done=0, edges_done=0)
+        # anchored-order streams (delta: inputs, io/deltalog.py): the
+        # elimination order derives from the BASE segment's degrees
+        # only — the contract that makes the incremental path
+        # bit-identical to this one-shot build. The anchor pass never
+        # touches the chunk cache (its chunks are a different stream
+        # than the build/score passes'); build fills the cache with
+        # the full surviving multiset as usual.
+        anchored = bool(getattr(stream, "order_anchor", False))
         if from_phase == 0:
             start = state.chunk_idx if state else 0
             deg = degrees_ops.init_degrees(n)
@@ -493,8 +546,10 @@ class TpuBackend(Partitioner):
             idx = start
             # read+parse+pad of chunk i+1 overlaps the device fold of i;
             # the staged ring keeps its H2D transfer off the chain too
-            for padded in _device_chunks(stream, cs, n, cache, start,
-                                         ring_n, build_stats):
+            for padded in _device_chunks(
+                    stream.anchor_stream() if anchored else stream,
+                    cs, n, None if anchored else cache, start,
+                    ring_n, build_stats):
                 deg = degrees_ops.degree_chunk(deg, padded, n)
                 since_flush += 1
                 idx += 1

@@ -49,6 +49,12 @@ class TpuBigVBackend(Partitioner):
     def partition(self, stream, k: int, weights: str = "unit",
                   comm_volume: bool = True, checkpointer=None,
                   resume: bool = False, **opts) -> PartitionResult:
+        if getattr(stream, "order_anchor", False):
+            from sheep_tpu.types import UnsupportedGraphError
+
+            raise UnsupportedGraphError(
+                "delta: inputs (anchored-order streams) are single-"
+                "device today; use --backend tpu or cpu")
         n = stream.num_vertices
         check_tpu_vertex_range(n, self.name)
         mesh = shards_mesh(self.n_devices)

@@ -57,6 +57,12 @@ class TpuShardedBackend(Partitioner):
         # comm_volume defaults True like every other backend (VERDICT r1
         # weak #5 asked for consistency); pass False to skip the host-side
         # O(cut pairs) accumulator on huge runs
+        if getattr(stream, "order_anchor", False):
+            from sheep_tpu.types import UnsupportedGraphError
+
+            raise UnsupportedGraphError(
+                "delta: inputs (anchored-order streams) are single-"
+                "device today; use --backend tpu or cpu")
         n = stream.num_vertices
         check_tpu_vertex_range(n, self.name)
         mesh = shards_mesh(self.n_devices)

@@ -80,6 +80,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "shards spill (default: system temp). Disk "
                         "high-water mark is 8 bytes per intra edge of "
                         "the current level")
+    p.add_argument("--deltas", default=None, metavar="LOG",
+                   help="incremental replay (ISSUE 15): build --input, "
+                        "then fold the delta log's epochs "
+                        "(io/deltalog.py add/tombstone batches) into "
+                        "the converged table in O(Δ) each — "
+                        "bit-identical to a one-shot build of the "
+                        "delta: input at the final epoch; deletions "
+                        "tombstone and compact (see README "
+                        "'Incremental updates'). Single k, flat path, "
+                        "single-device backends (tpu/cpu/pure)")
     p.add_argument("--score-only", default=None, metavar="PARTS",
                    help="skip partitioning: score this existing partition "
                         "map (.parts/.pbin) against --input — the "
@@ -275,6 +285,16 @@ def main(argv=None) -> int:
         from sheep_tpu.server.client import main as submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "update":
+        # ISSUE 15: `sheep update JOB --server S --deltas LOG` streams
+        # a delta log's epochs at a resident served partition (sugar
+        # over sheep-submit --update)
+        from sheep_tpu.server.client import main as submit_main
+
+        rest = list(argv[1:])
+        if rest and not rest[0].startswith("-"):
+            rest = ["--update", rest[0]] + rest[1:]
+        return submit_main(rest)
     if argv and argv[0] == "top":
         # ISSUE 11: the live telemetry console (also installed as the
         # standalone `sheeptop` console script)
@@ -471,6 +491,7 @@ def _run(parser, args) -> int:
             ("--lift-levels", args.lift_levels),
             ("--jumps", args.jumps),
             ("--hoist-bytes", args.hoist_bytes),
+            ("--deltas", args.deltas),
         ) if v is not None]
         if ignored:
             parser.error(f"{', '.join(ignored)} not supported with "
@@ -558,6 +579,10 @@ def _run(parser, args) -> int:
         build_parser().error("--auto-recipe has no effect with "
                              "--score-only (nothing is partitioned)")
     if args.score_only:
+        if args.deltas:
+            build_parser().error("--deltas does not combine with "
+                                 "--score-only (score the delta: "
+                                 "input spec instead)")
         if args.balance is not None:
             build_parser().error("--balance has no effect with "
                                  "--score-only (the split already "
@@ -587,6 +612,26 @@ def _run(parser, args) -> int:
                              "--checkpoint-dir or --refine; run those "
                              "single-k")
     args.k = ks[0]
+    if args.deltas:
+        # the incremental replay is a flat, single-k, single-device
+        # path; every combination it cannot honor is rejected up front
+        bad = [f for f, v in (
+            ("--k lists", len(ks) > 1 or None),
+            ("--refine", args.refine),
+            ("--auto-recipe", args.auto_recipe or None),
+            ("--checkpoint-dir", args.checkpoint_dir),
+            ("--resume", args.resume or None),
+            ("--coordinator/--num-processes",
+             args.coordinator or args.num_processes),
+        ) if v]
+        if bad:
+            build_parser().error(f"{', '.join(bad)} not supported "
+                                 f"with --deltas (the incremental "
+                                 f"replay is flat, single-k, "
+                                 f"single-process)")
+        if not os.path.exists(args.deltas):
+            build_parser().error(f"--deltas {args.deltas!r} does not "
+                                 f"exist")
     if args.resume and not args.checkpoint_dir:
         build_parser().error("--resume requires --checkpoint-dir")
     if args.carry_tail and args.tail_overlap:
@@ -867,7 +912,42 @@ def _run(parser, args) -> int:
             profile.__enter__()
         try:
             try:
-                if len(ks) > 1:
+                if args.deltas:
+                    # incremental replay (ISSUE 15): base build, then
+                    # fold each logged epoch into the converged table
+                    # — O(Δ) per epoch, bit-identical to the one-shot
+                    # delta: build at the final epoch
+                    from sheep_tpu import incremental
+                    from sheep_tpu.io.deltalog import DeltaLogReader
+
+                    if not getattr(be, "supports_incremental", False):
+                        print(f"error: backend {be.name!r} does not "
+                              f"support incremental updates; use "
+                              f"--backend tpu/cpu/pure",
+                              file=sys.stderr)
+                        return 2
+
+                    state, res = incremental.begin_incremental(
+                        es, args.k, backend=be, weights=args.weights,
+                        comm_volume=False)
+                    applied = 0
+                    for ep, d_adds, d_dels in DeltaLogReader(
+                            args.deltas).epochs(
+                                start_epoch=state.epoch):
+                        be.partition_update(state, adds=d_adds,
+                                            deletes=d_dels, epoch=ep,
+                                            score=False)
+                        applied += 1
+                    res = incremental.refresh(
+                        be, state,
+                        comm_volume=not args.no_comm_volume)
+                    if is_main and not args.json:
+                        print(f"deltas: applied {applied} epoch(s) "
+                              f"from {args.deltas} -> epoch "
+                              f"{state.epoch} (stale deletes "
+                              f"{state.stale_deletes}, compactions "
+                              f"{state.compactions})")
+                elif len(ks) > 1:
                     multi = be.partition_multi(
                         es, ks, weights=args.weights,
                         comm_volume=not args.no_comm_volume)

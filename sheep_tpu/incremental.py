@@ -1,0 +1,589 @@
+"""Incremental repartitioning for mutating graphs (ISSUE 15 tentpole).
+
+The elimination fixpoint is order-independent in the constraint
+multiset (the PR-1/PR-3 invariant every pipeline PR leans on), so a
+*converged* carried table absorbs a new-edge batch as just another
+segment batch: O(Δ) device work instead of an O(E) rebuild. This
+module is the state + driver around that observation:
+
+:class:`PartitionState`
+    A resident partition: the anchored elimination order, the
+    converged carried table (vertex-space ``minp``), the anchor
+    degree table, the applied delta history (adds + tombstones) and
+    the epoch counter. O(V + Δ) host memory; the base graph is never
+    re-materialized.
+
+:func:`begin_incremental` / :func:`state_from_build`
+    Create a state from a fresh build (``keep_tree=True`` products)
+    or from the served engine's build artifacts.
+
+``backend.partition_update(state, adds, deletes)``
+    The first-class backend capability (``supports_incremental`` on
+    ``backends/base.py``): fold an epoch's adds into the carried
+    table via the backend's ``_fold_delta`` hook (the tpu hook runs
+    the existing batched dispatch of ``ops/elim.py``), tombstone its
+    deletes, bump the epoch, auto-compact past the staleness
+    threshold, and (optionally) re-split + re-score.
+
+**Exactness contract** (tests/test_incremental.py):
+
+- *Adds* are EXACT: after folding epochs 1..N, the resident table is
+  bit-identical to a one-shot build of the ``delta:`` input at epoch
+  N — same anchored order (the delta-log format's documented
+  semantics, :mod:`sheep_tpu.io.deltalog`), same constraint multiset,
+  unique fixpoint. The shuffled two-halves replay pins this on the
+  pure/cpu/tpu backends and through the served ``update`` verb.
+- *Deletes* tombstone (an elimination forest does not un-fold); the
+  partition keeps serving with the stale tree until **compaction**.
+  Full compaction is a clean rebuild of the surviving multiset with
+  RE-ANCHORED (fresh survivor-degree) order — bit-identical to a
+  from-scratch build of the survivors, by construction. Subtree
+  compaction keeps the anchored order and rebuilds only the
+  tree-split parts the tombstones touch (``tree_split`` locality) —
+  an explicitly score-bounded approximation, gated in tests.
+- *Order drift*: the anchored order ages as degrees drift; the cut
+  cost of anchoring is bounded in tests and in the quality gate's
+  dynamic-graph scenario (tools/quality_regress.py), and compaction
+  re-anchors.
+
+A staleness counter (``stale_deletes`` vs ``compact_threshold``,
+default 20% of the surviving edges) forces compaction inside
+:func:`apply_update` so a delete-heavy stream cannot ride a stale
+tree forever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from sheep_tpu import obs
+
+NO_PARENT = -1
+
+
+def _parent_from_minp(minp: np.ndarray, order: np.ndarray,
+                      n: int) -> np.ndarray:
+    """Vertex-space minp (int32[n+1], n = none) -> parent int64[n]."""
+    m = np.asarray(minp[:n])
+    has = m < n
+    parent = np.full(n, NO_PARENT, dtype=np.int64)
+    parent[has] = order[m[has]]
+    return parent
+
+
+def _minp_from_parent(parent: np.ndarray, pos: np.ndarray,
+                      n: int) -> np.ndarray:
+    minp = np.full(n + 1, n, dtype=np.int32)
+    has = parent >= 0
+    minp[:n][has] = pos[parent[has]]
+    return minp
+
+
+@dataclasses.dataclass
+class PartitionState:
+    """One resident partition (see module docstring)."""
+
+    n: int
+    ks: List[int]
+    weights: str
+    alpha: float
+    chunk_edges: int
+    backend_name: str
+    pos: np.ndarray            # int64[n], anchored elimination order
+    deg_anchor: np.ndarray     # int64[n], degrees the order anchors to
+    minp: np.ndarray           # int32[n+1], converged carried table
+    total_edges: int           # surviving multiset size
+    base: object = None        # re-openable base stream
+    base_spec: Optional[str] = None
+    epoch: int = 0
+    anchored_at_epoch: int = 0
+    adds: List[np.ndarray] = dataclasses.field(default_factory=list)
+    tombs: List[np.ndarray] = dataclasses.field(default_factory=list)
+    # tombstones since the last compaction: the dirty set subtree
+    # compaction localizes on, and the staleness numerator
+    pending_tombs: List[np.ndarray] = dataclasses.field(
+        default_factory=list)
+    stale_deletes: int = 0
+    compactions: int = 0
+    compact_threshold: Optional[int] = None  # None = 20% of survivors
+    stats: dict = dataclasses.field(default_factory=dict)
+    _order: Optional[np.ndarray] = None
+
+    @property
+    def order(self) -> np.ndarray:
+        """order[p] = vertex at rank p (inverse of pos), cached."""
+        if self._order is None or len(self._order) != self.n:
+            order = np.empty(self.n, dtype=np.int64)
+            order[self.pos] = np.arange(self.n, dtype=np.int64)
+            self._order = order
+        return self._order
+
+    def tomb_array(self, pending_only: bool = False) -> np.ndarray:
+        src = self.pending_tombs if pending_only else self.tombs
+        if not src:
+            return np.zeros((0, 2), np.int64)
+        return np.concatenate(src, axis=0)
+
+    def adds_array(self) -> np.ndarray:
+        if not self.adds:
+            return np.zeros((0, 2), np.int64)
+        return np.concatenate(self.adds, axis=0)
+
+    def resolved_compact_threshold(self) -> int:
+        if self.compact_threshold is not None:
+            return int(self.compact_threshold)
+        return max(1024, int(self.total_edges) // 5)
+
+    def survivor_stream(self):
+        """EdgeStream view of the CURRENT surviving multiset (base
+        filtered by tombstones + applied adds) — what scoring and
+        full compaction stream. O(Δ) host state, base re-streamed."""
+        from sheep_tpu.io.deltalog import filter_tombstones
+        from sheep_tpu.io.edgestream import EdgeStream
+
+        state = self
+
+        def factory():
+            cs = state.chunk_edges
+            # state.tombs holds BASE tombstones only — deletes were
+            # resolved against pending adds at apply time
+            # (deltalog.cancel_adds), so the filter must never touch
+            # the adds: a base tombstone reaching forward into a
+            # later-epoch add would diverge from the one-shot replay
+            yield from filter_tombstones(state.base.chunks(cs),
+                                         state.tomb_array())
+            for a in state.adds:
+                for off in range(0, len(a), cs):
+                    yield a[off: off + cs]
+
+        return EdgeStream.from_generator(
+            factory, n_vertices=self.n,
+            num_edges=max(0, int(self.total_edges)))
+
+
+def state_from_build(stream, ks, weights: str, alpha: float,
+                     chunk_edges: int, backend_name: str,
+                     pos, deg, minp, total_edges: int,
+                     base_spec: Optional[str] = None) -> PartitionState:
+    """Wrap a finished build's artifacts into a resident state. When
+    the build's input was a ``delta:`` stream, its applied log (adds /
+    tombstones / epoch) seeds the state so the resident partition and
+    the one-shot build describe the same multiset."""
+    n = int(stream.num_vertices)
+    pos = np.asarray(pos, dtype=np.int64)[:n]
+    deg_anchor = np.asarray(deg, dtype=np.int64)[:n].copy()
+    st = PartitionState(
+        n=n, ks=[int(k) for k in ks], weights=str(weights),
+        alpha=float(alpha), chunk_edges=int(chunk_edges),
+        backend_name=str(backend_name), pos=pos,
+        deg_anchor=deg_anchor,
+        minp=np.asarray(minp, dtype=np.int32),
+        total_edges=int(total_edges), base=stream,
+        base_spec=base_spec)
+    if getattr(stream, "order_anchor", False):
+        # delta: input — the base is the anchor segment; the log's
+        # surviving adds/tombstones are already folded/filtered into
+        # the build, so the state starts at the log's epoch
+        st.base = stream.base
+        st.base_spec = getattr(stream, "base_spec", base_spec)
+        if len(stream.adds):
+            st.adds = [np.asarray(stream.adds, np.int64)]
+        if len(stream.tombs):
+            st.tombs = [np.asarray(stream.tombs, np.int64)]
+        st.epoch = int(stream.epoch)
+    if st.base_spec is None:
+        # a path-backed stream is its own re-openable spec (snapshot
+        # reload re-opens it); pure in-memory bases stay None and
+        # load_state then needs the stream handed back explicitly
+        st.base_spec = getattr(st.base, "path", None)
+    return st
+
+
+def begin_incremental(input_or_stream, ks, backend=None, weights: str = "unit",
+          alpha: float = 1.0, comm_volume: bool = False, **opts):
+    """Build the base partition and return ``(state, result)`` —
+    the entry point of the incremental lifecycle. ``input_or_stream``
+    accepts everything :func:`sheep_tpu.io.edgestream.open_input`
+    does, including ``delta:`` specs (the state then resumes at the
+    log's last epoch)."""
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io.edgestream import open_input
+
+    if isinstance(ks, int):
+        ks = [ks]
+    ks = [int(k) for k in ks]
+    base_spec = None
+    if isinstance(input_or_stream, (str, os.PathLike)):
+        base_spec = os.fspath(input_or_stream)
+        stream = open_input(base_spec)
+    else:
+        stream = input_or_stream
+    if backend is None or isinstance(backend, str):
+        from sheep_tpu import list_backends
+
+        name = backend
+        if name is None:
+            avail = list_backends()
+            name = next(b for b in ("tpu", "cpu", "pure")
+                        if b in avail)
+        be = get_backend(name, **opts)
+    else:
+        be = backend
+    if not getattr(be, "supports_incremental", False):
+        raise ValueError(f"backend {be.name!r} does not support "
+                         f"incremental updates (supports_incremental)")
+    res = be.partition(stream, ks[0], weights=weights,
+                       comm_volume=comm_volume, keep_tree=True)
+    tree = res.tree
+    n = int(stream.num_vertices)
+    minp = _minp_from_parent(np.asarray(tree["parent"], np.int64),
+                             np.asarray(tree["pos"], np.int64), n)
+    state = state_from_build(
+        stream, ks, weights, alpha, getattr(be, "chunk_edges", 1 << 22),
+        be.name, tree["pos"], tree["deg"], minp, res.total_edges,
+        base_spec=base_spec)
+    state.alpha = float(getattr(be, "alpha", alpha))
+    return state, res
+
+
+def _validate_delta(edges, n: int, what: str) -> np.ndarray:
+    e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if len(e) and (e.min() < 0 or e.max() >= n):
+        raise ValueError(
+            f"delta {what} reference vertex {int(e.max())} outside the "
+            f"resident vertex space [0, {n}); build the base with "
+            f"--num-vertices headroom to admit new vertices")
+    return e
+
+
+def apply_update(backend, state: PartitionState, adds=None,
+                 deletes=None, epoch: Optional[int] = None,
+                 score: bool = True, compact: str = "auto",
+                 comm_volume: bool = False):
+    """Apply one delta epoch (module docstring). Returns the refreshed
+    :class:`~sheep_tpu.types.PartitionResult` (list when the state
+    carries several ks) when ``score``, else None. An ``epoch`` at or
+    below the state's is an idempotent no-op returning None —
+    the served retry/replay contract."""
+    if compact not in ("auto", "never", "force"):
+        raise ValueError(f"bad compact mode {compact!r}")
+    if epoch is not None and int(epoch) <= state.epoch:
+        return None  # already applied — idempotent replay
+    t0 = time.perf_counter()
+    n = state.n
+    adds = _validate_delta(adds if adds is not None else [], n, "adds")
+    dels = _validate_delta(deletes if deletes is not None else [], n,
+                           "deletes")
+    sp = obs.begin("partition_update", epoch=int(epoch or
+                                                state.epoch + 1),
+                   adds=len(adds), dels=len(dels))
+    try:
+        if len(adds):
+            backend._fold_delta(state, adds)
+            state.adds.append(adds)
+            state.total_edges += len(adds)
+        if len(dels):
+            from sheep_tpu.io.deltalog import cancel_adds
+
+            # resolve NOW, against the multiset as it stands: cancel
+            # pending adds first (they leave the survivor stream; the
+            # folded tree keeps them until compaction — the stale-tree
+            # semantics), the remainder tombstone base occurrences.
+            # Matching net_effect's in-order rule keeps the one-shot
+            # replay and this path describing the same multiset.
+            state.adds, base_tombs = cancel_adds(state.adds, dels)
+            if len(base_tombs):
+                state.tombs.append(base_tombs)
+            state.pending_tombs.append(dels)
+            state.stale_deletes += len(dels)
+            state.total_edges = max(0, state.total_edges - len(dels))
+        state.epoch = int(epoch) if epoch is not None \
+            else state.epoch + 1
+        state.stats["updates"] = state.stats.get("updates", 0) + 1
+        state.stats["delta_adds"] = \
+            state.stats.get("delta_adds", 0) + len(adds)
+        state.stats["delta_deletes"] = \
+            state.stats.get("delta_deletes", 0) + len(dels)
+        forced = compact == "force" or (
+            compact == "auto"
+            and state.stale_deletes > state.resolved_compact_threshold())
+        if forced:
+            compact_state(backend, state, mode="auto"
+                          if compact == "auto" else "full")
+        obs.event("delta_epoch_applied", epoch=state.epoch,
+                  adds=len(adds), dels=len(dels),
+                  stale_deletes=state.stale_deletes,
+                  compacted=bool(forced))
+    finally:
+        sp.end()
+    state.stats["update_fold_s"] = round(
+        state.stats.get("update_fold_s", 0.0)
+        + (time.perf_counter() - t0), 6)
+    if not score:
+        return None
+    return refresh(backend, state, comm_volume=comm_volume)
+
+
+def refresh(backend, state: PartitionState, comm_volume: bool = False):
+    """Materialize the resident table into scored results: tree split
+    per k (O(V)) + ONE scoring pass over the surviving multiset.
+    Returns one PartitionResult, or a list for multi-k states."""
+    from sheep_tpu.backends.base import score_stream
+    from sheep_tpu.ops.split import tree_split_host
+    from sheep_tpu.types import PartitionResult
+
+    t0 = time.perf_counter()
+    n = state.n
+    parent = _parent_from_minp(state.minp, state.order, n)
+    w = state.deg_anchor.astype(np.float64) \
+        if state.weights == "degree" else None
+    assigns = {k: tree_split_host(parent, state.pos, k, weights=w,
+                                  alpha=state.alpha)
+               for k in state.ks}
+    split_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    scored = score_stream(state.survivor_stream(), assigns,
+                          chunk_edges=state.chunk_edges,
+                          comm_volume=comm_volume, weights=w)
+    score_s = time.perf_counter() - t0
+    diag = {"epoch": float(state.epoch),
+            "stale_deletes": float(state.stale_deletes),
+            "compactions": float(state.compactions),
+            **{k: float(v) for k, v in state.stats.items()
+               if isinstance(v, (int, float))}}
+    out = []
+    for k in state.ks:
+        cut, total, balance, cv = scored[k]
+        out.append(PartitionResult(
+            assignment=assigns[k], k=k, edge_cut=cut,
+            total_edges=total, cut_ratio=cut / max(total, 1),
+            balance=balance, comm_volume=cv,
+            phase_times={"split": split_s / len(state.ks),
+                         "score": score_s / len(state.ks)},
+            backend=state.backend_name, diagnostics=dict(diag)))
+    # the scored pass KNOWS the exact surviving count (unmatched
+    # tombstones removed nothing); adopt it so the staleness fraction
+    # and future compact thresholds price the real multiset
+    state.total_edges = int(out[0].total_edges)
+    return out[0] if len(out) == 1 else out
+
+
+def compact_state(backend, state: PartitionState,
+                  mode: str = "auto") -> str:
+    """Compaction (module docstring). ``full`` re-anchors on fresh
+    survivor degrees and refolds everything — bit-identical to a
+    clean rebuild of the survivors. ``subtree`` keeps the anchored
+    order and refolds only the edges touching tree-split parts the
+    pending tombstones dirtied — the score-bounded local repair.
+    ``auto`` picks subtree while the dirty set stays small (<= 1/4 of
+    the parts), else full. Returns the mode that ran."""
+    if mode not in ("auto", "full", "subtree"):
+        raise ValueError(f"bad compact mode {mode!r}")
+    pending = state.tomb_array(pending_only=True)
+    if mode == "auto":
+        mode = "full"
+        if len(pending):
+            k0 = state.ks[0]
+            parts, _ = _dirty_parts(state, pending, k0)
+            if len(parts) <= max(1, k0 // 4):
+                mode = "subtree"
+        elif state.epoch == state.anchored_at_epoch:
+            # nothing changed since the anchor: compaction is a no-op
+            state.pending_tombs = []
+            state.stale_deletes = 0
+            return "noop"
+    sp = obs.begin("compact", mode=mode,
+                   pending_deletes=int(len(pending)))
+    try:
+        if mode == "full":
+            _compact_full(backend, state)
+        else:
+            _compact_subtree(backend, state, pending)
+    finally:
+        sp.end()
+    state.pending_tombs = []
+    state.stale_deletes = 0
+    state.compactions += 1
+    state.stats["compactions"] = state.compactions
+    obs.event("compacted", mode=mode, epoch=state.epoch,
+              compactions=state.compactions)
+    return mode
+
+
+def _dirty_parts(state: PartitionState, pending: np.ndarray,
+                 k: int) -> tuple:
+    """(dirty part-id set, full assignment) — the tree_split locality
+    map: a part is dirty when a pending tombstone endpoint lives in
+    its subtree."""
+    from sheep_tpu.ops.split import tree_split_host
+
+    parent = _parent_from_minp(state.minp, state.order, state.n)
+    w = state.deg_anchor.astype(np.float64) \
+        if state.weights == "degree" else None
+    assign = tree_split_host(parent, state.pos, k, weights=w,
+                             alpha=state.alpha)
+    return set(np.unique(assign[pending.reshape(-1)]).tolist()), assign
+
+
+def _compact_full(backend, state: PartitionState) -> None:
+    """Clean rebuild of the surviving multiset with RE-ANCHORED order
+    — literally the backend's one-shot partition over the survivor
+    stream, so post-compact == from-scratch by construction."""
+    res = backend.partition(state.survivor_stream(), state.ks[0],
+                            weights=state.weights, comm_volume=False,
+                            keep_tree=True)
+    tree = res.tree
+    n = state.n
+    state.pos = np.asarray(tree["pos"], np.int64)[:n]
+    state._order = None
+    state.deg_anchor = np.asarray(tree["deg"], np.int64)[:n].copy()
+    state.minp = _minp_from_parent(
+        np.asarray(tree["parent"], np.int64), state.pos, n)
+    state.total_edges = int(res.total_edges)
+    state.anchored_at_epoch = state.epoch
+    state.stats["compact_full"] = state.stats.get("compact_full", 0) + 1
+
+
+def _compact_subtree(backend, state: PartitionState,
+                     pending: np.ndarray) -> None:
+    """tree_split-locality repair under the ANCHORED order: drop the
+    carried constraints of the dirty parts (and of clean vertices
+    whose parent is dirty), then refold every surviving edge with an
+    endpoint in a dirty part. One read pass over the survivors, device
+    folds proportional to the dirty region — the affected subtrees
+    rebuild, the clean ones keep their table entries. Explicitly
+    score-bounded (a clean-part fill routed through a deleted edge can
+    linger until a full compaction re-anchors); the bound is pinned in
+    tests/test_incremental.py."""
+    n = state.n
+    k0 = state.ks[0]
+    dirty, assign = _dirty_parts(state, pending, k0)
+    dirty_mask = np.isin(assign, np.asarray(sorted(dirty),
+                                            dtype=assign.dtype))
+    order = state.order
+    minp = state.minp.copy()
+    # a vertex is pruned when IT is dirty or its recorded parent is:
+    # the kept table must only carry constraints entirely inside the
+    # clean region
+    parent = _parent_from_minp(minp, order, n)
+    has = parent >= 0
+    parent_dirty = np.zeros(n, dtype=bool)
+    parent_dirty[has] = dirty_mask[parent[has]]
+    prune = dirty_mask | parent_dirty
+    minp[:n][prune] = n
+    state.minp = minp
+    cs = state.chunk_edges
+    refolded = 0
+    batch: list = []
+    batch_n = 0
+
+    def _flush():
+        # ONE fold per accumulated batch: each _fold_delta call pays
+        # an O(V) pos upload + table pull on the tpu hook, so folding
+        # per survivor chunk would turn a local repair into hundreds
+        # of O(V) round trips; batching keeps the device cost
+        # proportional to the dirty region as promised
+        nonlocal refolded, batch, batch_n
+        if batch:
+            backend._fold_delta(state, np.concatenate(batch))
+            refolded += batch_n
+            batch, batch_n = [], 0
+
+    for chunk in state.survivor_stream().chunks(cs):
+        e = np.asarray(chunk, np.int64).reshape(-1, 2)
+        if not len(e):
+            continue
+        touch = dirty_mask[e[:, 0]] | dirty_mask[e[:, 1]]
+        sub = e[touch]
+        if len(sub):
+            batch.append(sub)
+            batch_n += len(sub)
+            if batch_n >= 4 * cs:  # bound host accumulation
+                _flush()
+    _flush()
+    state.stats["compact_subtree"] = \
+        state.stats.get("compact_subtree", 0) + 1
+    state.stats["compact_refolded_edges"] = \
+        state.stats.get("compact_refolded_edges", 0) + refolded
+
+
+# ----------------------------------------------------------------------
+# durability: resident-state snapshots (the served layer checkpoints a
+# resident partition after every applied epoch; ISSUE 15 (c))
+# ----------------------------------------------------------------------
+STATE_VERSION = 1
+
+
+def save_state(state: PartitionState, path: str) -> None:
+    """Atomic snapshot (tmp + rename + fsync): arrays + meta. The base
+    stream itself is NOT serialized — ``load_state`` re-opens it from
+    ``base_spec`` (or takes an open stream)."""
+    meta = {"v": STATE_VERSION, "n": state.n, "ks": state.ks,
+            "weights": state.weights, "alpha": state.alpha,
+            "chunk_edges": state.chunk_edges,
+            "backend_name": state.backend_name,
+            "base_spec": state.base_spec, "epoch": state.epoch,
+            "anchored_at_epoch": state.anchored_at_epoch,
+            "stale_deletes": state.stale_deletes,
+            "compactions": state.compactions,
+            "compact_threshold": state.compact_threshold,
+            "total_edges": state.total_edges}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, meta=np.frombuffer(
+            json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+            pos=state.pos, deg_anchor=state.deg_anchor,
+            minp=state.minp,
+            adds=state.adds_array(), tombs=state.tomb_array(),
+            pending_tombs=state.tomb_array(pending_only=True))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_state(path: str, base=None) -> PartitionState:
+    """Reload a snapshot; ``base`` overrides re-opening ``base_spec``
+    (in-memory bases cannot be re-opened from a spec)."""
+    from sheep_tpu.io.edgestream import open_input
+
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode("utf-8"))
+        if int(meta.get("v", 0)) > STATE_VERSION:
+            raise ValueError(f"{path}: resident state v{meta.get('v')} "
+                             f"is newer than this reader")
+        arrays = {k: z[k] for k in ("pos", "deg_anchor", "minp",
+                                    "adds", "tombs",
+                                    "pending_tombs")}
+    if base is None:
+        if not meta.get("base_spec"):
+            raise ValueError(f"{path}: state has no base_spec; pass "
+                             f"the base stream explicitly")
+        base = open_input(meta["base_spec"])
+    st = PartitionState(
+        n=int(meta["n"]), ks=[int(k) for k in meta["ks"]],
+        weights=meta["weights"], alpha=float(meta["alpha"]),
+        chunk_edges=int(meta["chunk_edges"]),
+        backend_name=meta["backend_name"],
+        pos=arrays["pos"].astype(np.int64),
+        deg_anchor=arrays["deg_anchor"].astype(np.int64),
+        minp=arrays["minp"].astype(np.int32),
+        total_edges=int(meta["total_edges"]), base=base,
+        base_spec=meta.get("base_spec"), epoch=int(meta["epoch"]),
+        anchored_at_epoch=int(meta.get("anchored_at_epoch", 0)),
+        stale_deletes=int(meta["stale_deletes"]),
+        compactions=int(meta["compactions"]),
+        compact_threshold=meta.get("compact_threshold"))
+    if len(arrays["adds"]):
+        st.adds = [arrays["adds"].astype(np.int64).reshape(-1, 2)]
+    if len(arrays["tombs"]):
+        st.tombs = [arrays["tombs"].astype(np.int64).reshape(-1, 2)]
+    if len(arrays["pending_tombs"]):
+        st.pending_tombs = [arrays["pending_tombs"]
+                            .astype(np.int64).reshape(-1, 2)]
+    return st

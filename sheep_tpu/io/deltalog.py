@@ -1,0 +1,558 @@
+"""Delta log — the append-log input format for MUTATING graphs
+(ISSUE 15 tentpole, ROADMAP item 2).
+
+A production graph is not a frozen file: edges arrive (and leave)
+continuously, and until this format existed a single new edge meant a
+full O(E) rebuild. The delta log is the missing input layer: an
+append-only binary log of epoch-stamped ADD records and tombstone
+(DELETE) records over a *base* input, self-describing (the header
+carries the base input spec), replayable, and damage-hardened under
+the same ``SHEEP_IO_POLICY`` quarantine-or-raise contract as
+``io/edgestream.py``.
+
+Layout::
+
+    header:  magic b"SHEEPDLG" | u32 version | u32 header_len |
+             base_spec utf-8 (header_len - 16 bytes)
+    records: 24-byte little-endian records, appended forever:
+             u64 u | u64 v | u32 epoch | u16 op | u16 flags
+
+``op`` is 0 (ADD) or 1 (DEL); ``epoch`` is non-decreasing — one epoch
+is one applied delta batch (the unit of durability and idempotency for
+the served ``update`` verb). A DEL tombstones ONE occurrence of the
+undirected edge {u, v} from the current multiset, cancelling a pending
+ADD first and a base edge otherwise.
+
+Damage contract (tests/test_edgestream.py TestDeltaLogDamage):
+
+- a torn trailing record ((size - header_len) % 24 != 0) is never
+  silently folded: strict raises :class:`CorruptStreamError` with a
+  diagnosis, quarantine drops the torn bytes + emits a
+  ``chunk_quarantined`` trace event and continues over the intact
+  prefix;
+- a mid-log short read (the log shrank under a live reader) follows
+  the same contract;
+- an epoch that DECREASES mid-log is corruption, not history — same
+  contract, intact prefix only.
+
+:class:`DeltaLogStream` is the one-shot view: an EdgeStream-compatible
+stream of the SURVIVING multiset (base minus tombstones plus surviving
+adds), opened via the ``delta:LOG[@EPOCH]`` input spec
+(:func:`sheep_tpu.io.edgestream.open_input`). Its documented
+**anchored-order semantics**: the elimination order of a delta-log
+build is derived from the BASE segment's degree table
+(``order_anchor`` / :meth:`DeltaLogStream.anchor_chunks`), not the
+union's — which is exactly what makes the incremental path
+(:mod:`sheep_tpu.incremental`) bit-identical to this one-shot build:
+a converged carried table absorbs each epoch as just another segment
+batch under the same order (the fixpoint is order-independent in the
+constraint multiset), so incremental == one-shot by the merge
+property, not by luck. Compaction re-anchors (fresh survivor degrees)
+— see ``incremental.compact``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+MAGIC = b"SHEEPDLG"
+VERSION = 1
+HEADER_FIXED = 16  # magic + u32 version + u32 header_len
+
+OP_ADD = 0
+OP_DEL = 1
+
+RECORD_DTYPE = np.dtype([("u", "<u8"), ("v", "<u8"),
+                         ("epoch", "<u4"), ("op", "<u2"),
+                         ("flags", "<u2")])
+RECORD_BYTES = RECORD_DTYPE.itemsize  # 24
+MAX_BASE_SPEC_BYTES = 1 << 16
+
+
+def _quarantine_or_raise(msg: str, **fields) -> None:
+    from sheep_tpu.io.edgestream import _quarantine_or_raise as q
+
+    q(msg, **fields)
+
+
+def write_header(path: str, base_spec: str) -> None:
+    spec_b = base_spec.encode("utf-8")
+    if not spec_b or len(spec_b) > MAX_BASE_SPEC_BYTES:
+        raise ValueError(f"bad delta-log base spec ({len(spec_b)} bytes)")
+    header_len = HEADER_FIXED + len(spec_b)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint32(VERSION).tobytes())
+        f.write(np.uint32(header_len).tobytes())
+        f.write(spec_b)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_header(path: str) -> dict:
+    """{"version", "base_spec", "header_len"}; raises ValueError on a
+    file that is not a delta log (wrong magic / impossible header)."""
+    with open(path, "rb") as f:
+        fixed = f.read(HEADER_FIXED)
+        if len(fixed) < HEADER_FIXED or fixed[:8] != MAGIC:
+            raise ValueError(f"{path}: not a sheep delta log "
+                             f"(bad magic)")
+        version = int(np.frombuffer(fixed[8:12], "<u4")[0])
+        header_len = int(np.frombuffer(fixed[12:16], "<u4")[0])
+        if version > VERSION:
+            raise ValueError(f"{path}: delta log v{version} is newer "
+                             f"than this reader (v{VERSION})")
+        if not (HEADER_FIXED <= header_len
+                <= HEADER_FIXED + MAX_BASE_SPEC_BYTES):
+            raise ValueError(f"{path}: impossible delta-log header "
+                             f"length {header_len}")
+        spec_b = f.read(header_len - HEADER_FIXED)
+        if len(spec_b) != header_len - HEADER_FIXED:
+            raise ValueError(f"{path}: truncated delta-log header")
+    return {"version": version,
+            "base_spec": spec_b.decode("utf-8"),
+            "header_len": header_len}
+
+
+class DeltaLogWriter:
+    """Appender: one :meth:`append` batch per (op, epoch); epochs are
+    non-decreasing, auto-assigned as last+1 when not given. Appends are
+    fsync'd by default — an acked epoch is the durability promise a
+    tenant streams deltas against."""
+
+    def __init__(self, path: str, base_spec: Optional[str] = None):
+        self.path = path
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            hdr = read_header(path)
+            if base_spec is not None and base_spec != hdr["base_spec"]:
+                raise ValueError(
+                    f"{path} already logs deltas over "
+                    f"{hdr['base_spec']!r}, not {base_spec!r}")
+            self.base_spec = hdr["base_spec"]
+            # resuming an appender needs ONE number: the final
+            # record's epoch (epochs are validated non-decreasing, so
+            # the tail record holds the max). O(1) seek on an intact
+            # log; only a damaged body pays the full validated read.
+            body = os.path.getsize(path) - hdr["header_len"]
+            if body and body % RECORD_BYTES == 0:
+                with open(path, "rb") as f:
+                    f.seek(hdr["header_len"] + body - RECORD_BYTES)
+                    tail = np.fromfile(f, dtype=RECORD_DTYPE, count=1)
+                self.last_epoch = int(tail["epoch"][0])
+            else:
+                recs = DeltaLogReader(path).records()
+                self.last_epoch = int(recs["epoch"][-1]) \
+                    if len(recs) else 0
+        else:
+            if base_spec is None:
+                raise ValueError("a new delta log needs base_spec")
+            write_header(path, base_spec)
+            self.base_spec = base_spec
+            self.last_epoch = 0
+        self._f = open(path, "ab")
+
+    def append(self, edges, op: int = OP_ADD,
+               epoch: Optional[int] = None, fsync: bool = True) -> int:
+        """Append one batch of (m, 2) edges as ``op`` records stamped
+        ``epoch`` (default: a fresh epoch). Returns the epoch used."""
+        e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+        if op not in (OP_ADD, OP_DEL):
+            raise ValueError(f"bad delta op {op!r}")
+        if np.any(e < 0):
+            raise ValueError("delta edges must have non-negative ids")
+        if epoch is None:
+            epoch = self.last_epoch + 1
+        epoch = int(epoch)
+        if epoch < self.last_epoch:
+            raise ValueError(f"epoch {epoch} < last epoch "
+                             f"{self.last_epoch} (epochs never rewind)")
+        rec = np.zeros(len(e), dtype=RECORD_DTYPE)
+        rec["u"] = e[:, 0].astype(np.uint64)
+        rec["v"] = e[:, 1].astype(np.uint64)
+        rec["epoch"] = np.uint32(epoch)
+        rec["op"] = np.uint16(op)
+        self._f.write(rec.tobytes())
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+        self.last_epoch = epoch
+        return epoch
+
+    def append_epoch(self, adds=None, dels=None) -> int:
+        """Convenience: one new epoch carrying adds then dels. The
+        LAST batch written carries the fsync (one durable point per
+        epoch — an empty dels array must not strand the adds
+        unsynced)."""
+        epoch = self.last_epoch + 1
+        has_adds = adds is not None and len(adds)
+        has_dels = dels is not None and len(dels)
+        if has_adds:
+            self.append(adds, OP_ADD, epoch=epoch,
+                        fsync=not has_dels)
+        if has_dels:
+            self.append(dels, OP_DEL, epoch=epoch)
+        self.last_epoch = epoch
+        return epoch
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "DeltaLogWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class DeltaLogReader:
+    """Validated record access (quarantine-or-raise on damage; bounded
+    transient-read retry like every physical read in io/)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.header = read_header(path)
+        self._records: Optional[np.ndarray] = None
+
+    def records(self) -> np.ndarray:
+        """The validated record array (structured RECORD_DTYPE). Under
+        quarantine, damage truncates to the intact prefix; under
+        strict it raises. Cached per reader."""
+        if self._records is not None:
+            return self._records
+        from sheep_tpu.io.edgestream import (_read_retry_policy,
+                                             _retrying)
+
+        hlen = self.header["header_len"]
+        size = os.path.getsize(self.path)
+        body = size - hlen
+        torn = body % RECORD_BYTES
+        if torn:
+            _quarantine_or_raise(
+                f"{self.path}: {body} delta-log body bytes is not a "
+                f"multiple of the {RECORD_BYTES}-byte record "
+                f"({torn} torn trailing bytes)",
+                path=self.path, torn_bytes=int(torn))
+        count = body // RECORD_BYTES
+        policy = _read_retry_policy()
+
+        def _read():
+            with open(self.path, "rb") as f:
+                f.seek(hlen)
+                return np.fromfile(f, dtype=RECORD_DTYPE, count=count)
+
+        recs = _retrying(policy, _read, f"read {self.path}")
+        if len(recs) != count:
+            # mid-log short read: the log shrank under us
+            _quarantine_or_raise(
+                f"{self.path}: short read (wanted {count} delta "
+                f"records, got {len(recs)}) — log truncated mid-pass",
+                path=self.path, expected=int(count), got=int(len(recs)))
+        if len(recs):
+            ep = recs["epoch"].astype(np.int64)
+            bad = np.nonzero(np.diff(ep) < 0)[0]
+            if len(bad):
+                at = int(bad[0]) + 1
+                _quarantine_or_raise(
+                    f"{self.path}: epoch rewinds at record {at} "
+                    f"({int(ep[at])} after {int(ep[at - 1])}) — "
+                    f"corrupt log; keeping the intact prefix",
+                    path=self.path, record=at)
+                recs = recs[:at]
+            bad_op = np.nonzero(~np.isin(recs["op"], (OP_ADD, OP_DEL)))[0]
+            if len(bad_op):
+                at = int(bad_op[0])
+                _quarantine_or_raise(
+                    f"{self.path}: unknown delta op "
+                    f"{int(recs['op'][at])} at record {at}; keeping "
+                    f"the intact prefix",
+                    path=self.path, record=at)
+                recs = recs[:at]
+        self._records = recs
+        return recs
+
+    @property
+    def max_epoch(self) -> int:
+        recs = self.records()
+        return int(recs["epoch"][-1]) if len(recs) else 0
+
+    def epochs(self, start_epoch: int = 0,
+               up_to: Optional[int] = None) -> Iterator[tuple]:
+        """Yield (epoch, adds (a, 2) int64, dels (d, 2) int64) per
+        distinct epoch in (start_epoch, up_to]."""
+        recs = self.records()
+        if up_to is not None:
+            recs = recs[recs["epoch"] <= up_to]
+        recs = recs[recs["epoch"] > start_epoch]
+        if not len(recs):
+            return
+        ep = recs["epoch"].astype(np.int64)
+        bounds = np.nonzero(np.diff(ep))[0] + 1
+        for seg in np.split(np.arange(len(recs)), bounds):
+            r = recs[seg]
+            e = np.stack([r["u"].astype(np.int64),
+                          r["v"].astype(np.int64)], axis=1)
+            is_add = r["op"] == OP_ADD
+            yield int(r["epoch"][0]), e[is_add], e[~is_add]
+
+
+# ----------------------------------------------------------------------
+# multiset algebra shared by the one-shot stream and the incremental
+# state: net effect of a record prefix, and tombstone filtering
+# ----------------------------------------------------------------------
+def net_effect(records) -> tuple:
+    """(surviving_adds (a, 2) int64, base_tombstones (t, 2) int64) of a
+    validated record array, replayed IN LOG ORDER: a DEL removes one
+    occurrence of the edge from the multiset as it stood at that
+    record — it cancels the latest still-pending EARLIER add, else it
+    tombstones the base. A DEL can never reach forward and erase an
+    add from a later epoch (deleting an absent edge removes nothing,
+    then the later add re-introduces it) — exactly how the
+    incremental path applies the same epochs, which is what keeps
+    incremental == one-shot exact."""
+    add_e = []           # (u, v) rows of adds, in order
+    live: dict = {}      # norm key -> stack of indices into add_e
+    tombs = []
+    for rec in records:
+        u, v = int(rec["u"]), int(rec["v"])
+        if rec["op"] == OP_ADD:
+            k = _norm_key(u, v)
+            live.setdefault(k, []).append(len(add_e))
+            add_e.append((u, v))
+        else:
+            k = _norm_key(u, v)
+            stack = live.get(k)
+            if stack:
+                add_e[stack.pop()] = None  # cancel an EARLIER add
+            else:
+                tombs.append(k)
+    surv = np.asarray([r for r in add_e if r is not None],
+                      dtype=np.int64).reshape(-1, 2)
+    tomb_arr = np.asarray(tombs, dtype=np.int64).reshape(-1, 2)
+    return surv, tomb_arr
+
+
+def _norm_key(u, v) -> tuple:
+    u, v = int(u), int(v)
+    return (u, v) if u <= v else (v, u)
+
+
+def _key_iter(e):
+    lo = np.minimum(e[:, 0], e[:, 1])
+    hi = np.maximum(e[:, 0], e[:, 1])
+    return zip(lo.tolist(), hi.tolist())
+
+
+def cancel_adds(adds_list, dels) -> tuple:
+    """Resolve a delete batch against pending ADD arrays, in order:
+    each delete cancels the LATEST still-pending add of its undirected
+    key; the remainder come back as base tombstones. This is the
+    apply-time twin of :func:`net_effect`'s rule — both sides resolve
+    deletes against the multiset AS IT STANDS, so a tombstone can
+    never reach forward and eat an add from a later epoch. Returns
+    (new_adds_list, base_tombstones (t, 2) int64)."""
+    from collections import defaultdict
+
+    stacks = defaultdict(list)
+    for ai, arr in enumerate(adds_list):
+        lo = np.minimum(arr[:, 0], arr[:, 1]).tolist()
+        hi = np.maximum(arr[:, 0], arr[:, 1]).tolist()
+        for ri, k in enumerate(zip(lo, hi)):
+            stacks[k].append((ai, ri))
+    keep = [np.ones(len(a), dtype=bool) for a in adds_list]
+    rem = []
+    for u, v in np.asarray(dels, np.int64).reshape(-1, 2).tolist():
+        k = _norm_key(u, v)
+        s = stacks.get(k)
+        if s:
+            ai, ri = s.pop()
+            keep[ai][ri] = False
+        else:
+            rem.append(k)
+    new_adds = [a[m] for a, m in zip(adds_list, keep) if m.any()]
+    rem_arr = np.asarray(rem, dtype=np.int64).reshape(-1, 2)
+    return new_adds, rem_arr
+
+
+def filter_tombstones(chunks, tombs) -> Iterator[np.ndarray]:
+    """Yield ``chunks`` with one occurrence per tombstone removed
+    (undirected match, multiset semantics). ``tombs`` is an (t, 2)
+    array; unmatched tombstones simply never fire (deleting an edge
+    the graph does not have removes nothing)."""
+    from collections import Counter
+
+    if tombs is None or not len(tombs):
+        for c in chunks:
+            yield c
+        return
+    pending = Counter(_key_iter(np.asarray(tombs, np.int64)))
+    lo_set = np.unique(np.minimum(tombs[:, 0], tombs[:, 1]))
+    for c in chunks:
+        e = np.asarray(c, dtype=np.int64).reshape(-1, 2)
+        if sum(pending.values()) == 0 or not len(e):
+            yield e
+            continue
+        lo = np.minimum(e[:, 0], e[:, 1])
+        cand = np.nonzero(np.isin(lo, lo_set))[0]
+        if not len(cand):
+            yield e
+            continue
+        keep = np.ones(len(e), dtype=bool)
+        for i in cand.tolist():
+            k = _norm_key(e[i, 0], e[i, 1])
+            if pending.get(k, 0) > 0:
+                pending[k] -= 1
+                keep[i] = False
+        yield e[keep]
+
+
+class DeltaLogStream:
+    """EdgeStream-compatible one-shot view of base ∪ log (surviving
+    multiset at ``up_to`` — default: the whole log), with the anchored
+    elimination-order contract (module docstring).
+
+    Single-shard only: delta logs serve the single-device incremental
+    path; the multi-device backends reject anchored streams up front.
+    """
+
+    order_anchor = True
+
+    def __init__(self, path: str, up_to: Optional[int] = None,
+                 n_vertices: Optional[int] = None):
+        from sheep_tpu.io.edgestream import open_input
+
+        self.path = path
+        self.reader = DeltaLogReader(path)
+        self.base_spec = self.reader.header["base_spec"]
+        if self.base_spec.startswith("delta:"):
+            raise ValueError(f"{path}: delta logs do not nest")
+        self.base = open_input(self.base_spec)
+        self.up_to = up_to
+        recs = self.reader.records()
+        if up_to is not None:
+            recs = recs[recs["epoch"] <= up_to]
+        self.epoch = int(recs["epoch"][-1]) if len(recs) else 0
+        self.adds, self.tombs = net_effect(recs)
+        n = int(self.base.num_vertices)
+        if len(self.adds):
+            n = max(n, int(self.adds.max()) + 1)
+        if len(self.tombs):
+            n = max(n, int(self.tombs.max()) + 1)
+        if n_vertices is not None:
+            if n_vertices < n:
+                raise ValueError(
+                    f"--num-vertices {n_vertices} is below the "
+                    f"delta-log vertex space ({n})")
+            n = n_vertices
+        self._n = n
+
+    # -- EdgeStream-compatible surface ---------------------------------
+    @property
+    def num_vertices(self) -> int:
+        return self._n
+
+    @property
+    def num_edges_cheap(self) -> Optional[int]:
+        base = self.base.num_edges_cheap
+        if base is None:
+            return None
+        # tombstones that never match remove nothing, so this is an
+        # upper estimate only when the log deletes absent edges —
+        # consumers treat it as a progress/sizing hint, like every
+        # other cheap count
+        return max(0, base + len(self.adds) - len(self.tombs))
+
+    @property
+    def num_edges(self) -> int:
+        cheap = self.num_edges_cheap
+        if cheap is not None:
+            return cheap
+        return sum(len(c) for c in self.chunks())
+
+    @property
+    def num_edges_upper_bound(self) -> Optional[int]:
+        base = self.base.num_edges_upper_bound
+        if base is None:
+            return None
+        return base + len(self.adds)
+
+    def clamp_chunk_edges(self, chunk_edges: int, parts: int = 1,
+                          floor: int = 1024) -> int:
+        from sheep_tpu.io.edgestream import EdgeStream
+
+        return EdgeStream.clamp_chunk_edges.__get__(self)(
+            chunk_edges, parts, floor)
+
+    def content_fingerprint(self) -> str:
+        import hashlib
+
+        st = os.stat(self.path)
+        blob = (f"{self.base_spec}|{st.st_size}|{st.st_mtime_ns}|"
+                f"{self.epoch}")
+        return hashlib.sha1(blob.encode()).hexdigest()
+
+    def __enter__(self) -> "DeltaLogStream":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def anchor_chunks(self, chunk_edges: int,
+                      start_chunk: int = 0) -> Iterator[np.ndarray]:
+        """The ORDER ANCHOR: the base segment's chunks only — what the
+        degrees pass of a delta-log build streams (anchored-order
+        semantics; the n it scatters into is this stream's full
+        vertex space, so vertices the log introduced rank as
+        degree-0)."""
+        yield from self.base.chunks(chunk_edges, start_chunk=start_chunk)
+
+    def anchor_stream(self):
+        """The base stream object (device-stream bases stay device
+        streams for the anchor pass)."""
+        return self.base
+
+    def chunks(self, chunk_edges: int = 1 << 22, shard: int = 0,
+               num_shards: int = 1, start_chunk: int = 0,
+               byte_range: bool = False) -> Iterator[np.ndarray]:
+        if num_shards != 1:
+            raise NotImplementedError(
+                "delta: inputs stream single-shard (multi-device "
+                "backends reject anchored streams)")
+        idx = 0
+        for c in filter_tombstones(
+                self.base.chunks(chunk_edges), self.tombs):
+            if idx >= start_chunk:
+                yield c
+            idx += 1
+        for off in range(0, len(self.adds), chunk_edges):
+            if idx >= start_chunk:
+                yield self.adds[off: off + chunk_edges]
+            idx += 1
+
+    def read_all(self) -> np.ndarray:
+        out = list(self.chunks())
+        if not out:
+            return np.zeros((0, 2), dtype=np.int64)
+        return np.concatenate(out, axis=0)
+
+
+def open_delta(spec_rest: str,
+               n_vertices: Optional[int] = None) -> DeltaLogStream:
+    """``delta:LOG[@EPOCH]`` -> DeltaLogStream (the one-shot surviving
+    multiset up to EPOCH, default all)."""
+    path, sep, ep = spec_rest.rpartition("@")
+    up_to = None
+    if sep and ep.isdigit():
+        up_to = int(ep)
+    else:
+        path = spec_rest
+    if not path or not os.path.exists(path):
+        raise ValueError(f"delta log {path!r} does not exist "
+                         f"(want delta:LOG[@EPOCH])")
+    return DeltaLogStream(path, up_to=up_to, n_vertices=n_vertices)

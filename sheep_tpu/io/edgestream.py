@@ -600,12 +600,24 @@ def open_input(spec: str, n_vertices: Optional[int] = None):
       near-clique blocks of 2**CLIQUE_BITS vertices
       (:class:`~sheep_tpu.io.generators.NearCliqueStream`).
 
+    - ``delta:LOG[@EPOCH]`` — a mutating graph: the surviving edge
+      multiset of a base input plus an append-log of epoch-stamped
+      add/tombstone records (:mod:`sheep_tpu.io.deltalog`), capped at
+      EPOCH when given. Delta-log builds use the ANCHORED elimination
+      order (base-segment degrees), the contract that makes the
+      incremental path (:mod:`sheep_tpu.incremental`) bit-identical
+      to this one-shot build.
+
     Anything else is treated as a path (format by extension). A
     user-supplied ``n_vertices`` must not contradict a synthetic spec's
     2**SCALE vertex space.
     """
     spec = os.fspath(spec)  # pathlib.Path inputs flow through unchanged
     kind, _, rest = spec.partition(":")
+    if kind == "delta" and rest:
+        from sheep_tpu.io.deltalog import open_delta
+
+        return open_delta(rest, n_vertices=n_vertices)
     # the planted-structure family shares one SCALE:ARG:POUT[:EF[:SEED]]
     # grammar; ARG is the second structural knob of each class
     planted = {"sbm-hash": ("BLOCKS", "SbmHashStream"),

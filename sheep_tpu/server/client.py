@@ -20,8 +20,21 @@ CLI::
     sheep-submit --server /run/sheepd.sock --input g.edges --k 8,64 \\
         --wait [--output parts.pbin] [--tenant alice] [--deadline 60]
     sheep-submit --server ... --input g.edges --k 64 --watch
+    sheep-submit --server ... --input g.edges --k 64 --resident --wait
+    sheep-submit --server ... --update JOB --deltas g.dlog [--wire] \\
+        [--score]
+    sheep-submit --server ... --epoch-of JOB | --compact JOB
     sheep-submit --server ... --status JOB | --cancel JOB | --stats \\
         | --ping | --metrics | --profile DIR | --shutdown
+
+Incremental verbs (ISSUE 15): ``--resident`` holds the finished
+partition in the daemon; ``--update JOB --deltas LOG`` applies the
+log's epochs past the resident epoch (daemon-side path by default;
+``--wire`` reads the log here and streams each epoch inline — the
+remote-tenant shape, idempotent via explicit epoch numbers);
+``--epoch-of`` / ``--compact`` query and repair; ``--cancel`` on the
+DONE job releases the residency. Also reachable as ``sheep update
+JOB ...`` from the main CLI.
 
 ``--watch`` (ISSUE 11) submits and then POLLS ``status`` instead of
 blocking in ``wait``: live progress lines on stderr (state, phase,
@@ -149,11 +162,18 @@ class SheepClient:
     def _retriable(doc: dict) -> bool:
         """Safe to blindly re-send after a transport error: everything
         except a plain submit (double-build risk — reattach makes it
-        idempotent and thus retriable) and shutdown."""
+        idempotent and thus retriable), an un-epoched update (a blind
+        resend could double-fold; explicit epochs and the log form are
+        idempotent — the daemon answers applied=false for an epoch it
+        already holds), compact (double-compacting is observable), and
+        shutdown."""
         op = doc.get("op")
         if op == "submit":
             return bool(doc.get("reattach"))
-        return op != "shutdown"
+        if op == "update":
+            return doc.get("epoch") is not None \
+                or doc.get("log") is not None
+        return op not in ("shutdown", "compact")
 
     def request(self, doc: dict) -> dict:
         pol = self._policy() if self.reconnect > 0 \
@@ -223,6 +243,39 @@ class SheepClient:
         """The daemon's live Prometheus exposition text (same document
         as HTTP GET /metrics on --metrics-port)."""
         return self.request({"op": "metrics"})["text"]
+
+    # -- resident-partition verbs (ISSUE 15) ---------------------------
+    def update(self, job_id: str, adds=None, dels=None,
+               epoch: Optional[int] = None, score: bool = False,
+               compact: str = "auto",
+               log: Optional[str] = None) -> dict:
+        """Stream one delta epoch at a resident partition: ``adds`` /
+        ``dels`` are (m, 2) edge arrays (base64 on the wire, bounded
+        by the 1 MiB request line), or ``log`` names a DAEMON-side
+        delta log whose epochs past the resident epoch all apply.
+        Explicit ``epoch`` numbers make the call idempotent (an
+        already-applied epoch answers ``applied: false``)."""
+        req = {"op": "update", "job_id": job_id,
+               "score": bool(score), "compact": compact}
+        if adds is not None:
+            req["adds"] = protocol.encode_edges(adds)
+        if dels is not None:
+            req["dels"] = protocol.encode_edges(dels)
+        if epoch is not None:
+            req["epoch"] = int(epoch)
+        if log is not None:
+            req["log"] = log
+        return self.request(req)
+
+    def epoch(self, job_id: str) -> dict:
+        """Resident-partition epoch/staleness descriptor."""
+        return self.request({"op": "epoch", "job_id": job_id})
+
+    def compact(self, job_id: str, mode: str = "auto",
+                score: bool = False) -> dict:
+        """Run tombstone compaction on a resident partition."""
+        return self.request({"op": "compact", "job_id": job_id,
+                             "mode": mode, "score": bool(score)})
 
     def profile(self, dir: str, steps: int = 8) -> dict:
         """Arm an on-demand jax.profiler capture of the next ``steps``
@@ -297,6 +350,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=None,
                    help="with --wait/--watch: give up after this many "
                         "seconds")
+    p.add_argument("--resident", action="store_true",
+                   help="with --input: hold the finished partition "
+                        "RESIDENT in the daemon so delta epochs can "
+                        "stream at it (--update); the admission "
+                        "reservation stays charged until --cancel "
+                        "releases it")
+    p.add_argument("--update", metavar="JOB", default=None,
+                   help="apply delta epochs to a resident partition; "
+                        "needs --deltas LOG (daemon-side path by "
+                        "default, --wire streams each epoch inline)")
+    p.add_argument("--deltas", metavar="LOG", default=None,
+                   help="with --update: the delta log "
+                        "(io/deltalog.py) whose epochs past the "
+                        "resident epoch apply")
+    p.add_argument("--wire", action="store_true",
+                   help="with --update: read the log CLIENT-side and "
+                        "stream each epoch as an inline update "
+                        "request (the remote-tenant path; default "
+                        "sends the daemon-side log path)")
+    p.add_argument("--score", action="store_true",
+                   help="with --update/--compact: refresh + return "
+                        "the scored results after applying")
+    p.add_argument("--epoch-of", metavar="JOB", default=None,
+                   help="print a resident partition's epoch/staleness "
+                        "descriptor")
+    p.add_argument("--compact", metavar="JOB", default=None,
+                   help="compact a resident partition's tombstones")
+    p.add_argument("--compact-mode", default="auto",
+                   choices=["auto", "full", "subtree"],
+                   help="with --compact: full re-anchors and rebuilds "
+                        "everything (exact), subtree repairs only the "
+                        "dirty tree-split parts (score-bounded), auto "
+                        "picks (default)")
     p.add_argument("--status", metavar="JOB")
     p.add_argument("--cancel", metavar="JOB")
     p.add_argument("--stats", action="store_true")
@@ -358,11 +444,14 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     modes = [bool(args.input), bool(args.status), bool(args.cancel),
              args.stats, args.ping, args.shutdown, args.metrics,
-             bool(args.profile)]
+             bool(args.profile), bool(args.update),
+             bool(args.epoch_of), bool(args.compact)]
     if sum(modes) != 1:
         p.error("pass exactly one of --input (submit), --status, "
                 "--cancel, --stats, --ping, --metrics, --profile, "
-                "--shutdown")
+                "--update, --epoch-of, --compact, --shutdown")
+    if args.update and not args.deltas:
+        p.error("--update needs --deltas LOG")
     reconnect = args.reconnect if args.reconnect is not None \
         else (8 if args.watch else 0)
     if reconnect < 0:
@@ -384,6 +473,40 @@ def main(argv=None) -> int:
                 return 0
             if args.shutdown:
                 print(json.dumps(c.shutdown(drain=args.drain)))
+                return 0
+            if args.epoch_of:
+                print(json.dumps(c.epoch(args.epoch_of)))
+                return 0
+            if args.compact:
+                print(json.dumps(c.compact(args.compact,
+                                           mode=args.compact_mode,
+                                           score=args.score)))
+                return 0
+            if args.update:
+                if args.wire:
+                    # remote-tenant path: read the log HERE, stream
+                    # each epoch inline (idempotent: explicit epoch
+                    # numbers — an already-applied epoch is a no-op)
+                    from sheep_tpu.io.deltalog import DeltaLogReader
+
+                    cur = int(c.epoch(args.update)["epoch"])
+                    resp = {"job_id": args.update, "epoch": cur,
+                            "applied": False, "epochs_applied": 0}
+                    applied = 0
+                    reader = DeltaLogReader(args.deltas)
+                    mx = reader.max_epoch  # records() cached: 1 read
+                    for ep, adds, dels in reader.epochs(
+                            start_epoch=cur):
+                        resp = c.update(args.update, adds=adds,
+                                        dels=dels, epoch=ep,
+                                        score=args.score and ep == mx)
+                        applied += resp.get("epochs_applied", 0)
+                    resp["epochs_applied"] = applied
+                    resp["applied"] = applied > 0
+                else:
+                    resp = c.update(args.update, log=args.deltas,
+                                    score=args.score)
+                print(json.dumps(resp))
                 return 0
             if args.status:
                 print(json.dumps(c.status(args.status)))
@@ -415,6 +538,8 @@ def main(argv=None) -> int:
                     job[field] = val
             if args.comm_volume:
                 job["comm_volume"] = True
+            if args.resident:
+                job["resident"] = True
             # with failover armed the submit itself must be idempotent
             # (the retried submit against a restarted daemon reattaches
             # to the journaled job instead of double-building)

@@ -334,6 +334,40 @@ class Daemon:
             return {"ok": True,
                     "content_type": metrics_mod.CONTENT_TYPE,
                     "text": sched.render_metrics()}
+        if op in ("update", "epoch", "compact"):
+            # resident-partition verbs (ISSUE 15): executed on the
+            # dispatch thread; this handler just parks on the answer
+            job_id = req.get("job_id")
+            if not job_id:
+                raise protocol.ProtocolError(f"{op} needs job_id")
+            if op == "epoch":
+                return {"ok": True, **sched.epoch_info(job_id)}
+            if op == "compact":
+                return {"ok": True, **sched.compact_resident(
+                    job_id, mode=req.get("mode", "auto"),
+                    score=bool(req.get("score", False)))}
+            adds = protocol.decode_edges(req.get("adds")) \
+                if req.get("adds") is not None else None
+            dels = protocol.decode_edges(req.get("dels")) \
+                if req.get("dels") is not None else None
+            log = req.get("log")
+            if log is not None and not isinstance(log, str):
+                raise protocol.ProtocolError(
+                    "update.log must be a daemon-side path")
+            if log is None and adds is None and dels is None:
+                raise protocol.ProtocolError(
+                    "update needs adds/dels payloads or a log path")
+            epoch = req.get("epoch")
+            if epoch is not None:
+                try:
+                    epoch = int(epoch)
+                except (TypeError, ValueError):
+                    raise protocol.ProtocolError(
+                        "update.epoch must be an integer") from None
+            return {"ok": True, **sched.update(
+                job_id, adds=adds, dels=dels, epoch=epoch,
+                score=bool(req.get("score", False)),
+                compact=str(req.get("compact", "auto")), log=log)}
         if op == "profile":
             pdir = req.get("dir")
             if not pdir or not isinstance(pdir, str):

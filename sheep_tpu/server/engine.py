@@ -481,6 +481,19 @@ class JobEngine:
                 sp.end()
             t_phase["score"] = time.perf_counter() - t0
 
+            if spec.resident:
+                # resident partition (ISSUE 15): wrap the finished
+                # build's artifacts into an incremental PartitionState
+                # — the converged carried table the tenant will stream
+                # delta epochs at. A delta: input seeds the state at
+                # the log's epoch (state_from_build handles both).
+                from sheep_tpu import incremental as inc_mod
+
+                job.incremental_state = inc_mod.state_from_build(
+                    es, spec.ks, spec.weights, spec.alpha, cs,
+                    "sheepd", pos_host, deg_host, minp_host, total,
+                    base_spec=spec.input)
+
         from sheep_tpu.core import pure
 
         results = []

@@ -45,8 +45,15 @@ from typing import Dict, List, Optional
 JOURNAL_VERSION = 1
 
 # record kinds this version understands; anything else is skipped
-# with a warning on replay (forward compatibility, never a crash)
-REC_KINDS = ("daemon_start", "submit", "state", "terminal", "drain")
+# with a warning on replay (forward compatibility, never a crash).
+# delta_epoch / resident_release (ISSUE 15) journal a RESIDENT
+# partition's lifecycle: each applied delta epoch is fsync'd AFTER
+# its state checkpoint lands, so a killed daemon resumes the resident
+# partition at its last applied epoch; release frees the reservation.
+# Both arrive after the job's DONE terminal — replay applies them
+# post-terminal, unlike state records.
+REC_KINDS = ("daemon_start", "submit", "state", "terminal", "drain",
+             "delta_epoch", "resident_release")
 
 _TERMINAL = ("done", "failed", "cancelled", "deadline_exceeded",
              "rejected")
@@ -101,6 +108,10 @@ class ReplayedJob:
     error: Optional[str] = None
     end_t: Optional[float] = None
     results: Optional[List[Dict]] = None   # summaries (terminal done)
+    # resident-partition lineage (ISSUE 15): the last journaled
+    # applied delta epoch, and whether the residency was released
+    delta_epoch: int = 0
+    resident_released: bool = False
 
     @property
     def terminal(self) -> bool:
@@ -278,6 +289,16 @@ def replay(path: str) -> Replay:
         if job is None:
             warn(f"{path}: {kind} record for unjournaled job "
                  f"{job_id} skipped")
+            continue
+        if kind == "delta_epoch":
+            # arrives AFTER the job's DONE terminal by design (a
+            # resident partition only exists once built); the newest
+            # epoch wins (epochs never rewind at the appender)
+            job.delta_epoch = max(job.delta_epoch,
+                                  int(rec.get("epoch", 0)))
+            continue
+        if kind == "resident_release":
+            job.resident_released = True
             continue
         if job.terminal:
             # first terminal wins: a duplicate terminal (crash between

@@ -18,7 +18,29 @@ Requests (``op`` selects):
     {"op": "stats"}
     {"op": "metrics"}
     {"op": "profile", "dir": "/tmp/prof", "steps": 8}
+    {"op": "update",  "job_id": "j3", "adds": {edges b64},
+     "dels": {edges b64}, "epoch": 7, "score": false}
+    {"op": "update",  "job_id": "j3", "log": "/path/g.dlog"}
+    {"op": "epoch",   "job_id": "j3"}
+    {"op": "compact", "job_id": "j3", "mode": "auto", "score": false}
     {"op": "shutdown", "drain": false, "suspend": false}
+
+Incremental verbs (ISSUE 15): a job submitted with ``"resident":
+true`` keeps its converged partition state resident after DONE —
+admission keeps charging its modeled bytes to the membudget model
+until the tenant releases it (``cancel`` on the DONE job). The tenant
+then streams deltas at it: ``update`` folds an epoch of adds /
+tombstones into the carried table in O(Δ) (inline base64 edge
+payloads, bounded by the 1 MiB request line — ~20k edges per request
+— or ``"log"`` naming a daemon-side delta log whose epochs past the
+resident epoch all apply). Explicit ``epoch`` numbers make updates
+IDEMPOTENT: an epoch at or below the resident epoch answers
+``applied: false`` without refolding — the retry/replay contract.
+``epoch`` queries the resident epoch/staleness; ``compact`` runs the
+tombstone compaction (``mode`` auto/full/subtree). On a durable
+daemon every applied epoch checkpoints the resident state and
+journals a ``delta_epoch`` record, so a SIGKILL'd daemon resumes the
+resident partition at its last applied epoch bit-identically.
 
 Durability verbs (ISSUE 14): ``submit`` with ``"reattach": true`` is
 IDEMPOTENT — the daemon digests the spec (plus the input's content
@@ -93,7 +115,7 @@ JOB_STATES = (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
 TERMINAL_STATES = (DONE, FAILED, CANCELLED, DEADLINE_EXCEEDED, REJECTED)
 
 OPS = ("ping", "submit", "status", "wait", "cancel", "list", "stats",
-       "metrics", "profile", "shutdown")
+       "metrics", "profile", "update", "epoch", "compact", "shutdown")
 
 MAX_REQUEST_BYTES = 1 << 20  # one request line; jobs are specs, not data
 
@@ -121,6 +143,10 @@ class JobSpec:
     deadline_s: Optional[float] = None
     output: Optional[str] = None   # daemon-side partition map path
     return_assignment: bool = False
+    # hold the converged partition state resident after DONE so the
+    # tenant can stream delta epochs at it (ISSUE 15); the reservation
+    # stays charged until released via cancel
+    resident: bool = False
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -140,7 +166,7 @@ class JobSpec:
         known = {"input", "k", "ks", "chunk_edges", "dispatch_batch",
                  "h2d_ring", "segment_rounds", "alpha", "weights",
                  "comm_volume", "num_vertices", "deadline_s", "output",
-                 "return_assignment"}
+                 "return_assignment", "resident"}
         unknown = set(body) - known
         if unknown:
             raise ProtocolError(f"unknown job field(s): {sorted(unknown)}")
@@ -160,6 +186,7 @@ class JobSpec:
             output=(None if body.get("output") is None
                     else str(body["output"])),
             return_assignment=bool(body.get("return_assignment", False)),
+            resident=bool(body.get("resident", False)),
         )
         if spec.chunk_edges < 1:
             raise ProtocolError("job.chunk_edges must be >= 1")
@@ -175,6 +202,31 @@ class JobSpec:
         if spec.alpha <= 0:
             raise ProtocolError("job.alpha must be > 0")
         return spec
+
+
+def encode_edges(edges) -> dict:
+    """(m, 2) int edge array -> {"b64": ..., "m": ..., "dtype":
+    "int64"} — the delta payload codec of the ``update`` verb.
+    Bounded by MAX_REQUEST_BYTES at the line layer (~20k edges per
+    request); stream larger deltas as multiple epochs or via the
+    daemon-side ``log`` form."""
+    e = np.asarray(edges, dtype="<i8").reshape(-1, 2)
+    return {"b64": base64.b64encode(e.tobytes()).decode("ascii"),
+            "m": int(len(e)), "dtype": "int64"}
+
+
+def decode_edges(doc) -> np.ndarray:
+    if doc is None:
+        return np.zeros((0, 2), np.int64)
+    if not isinstance(doc, dict) or "b64" not in doc:
+        raise ProtocolError("edge payload must be {b64, m, dtype}")
+    raw = base64.b64decode(doc["b64"])
+    e = np.frombuffer(raw, dtype="<i8").astype(np.int64)
+    if e.size != 2 * int(doc.get("m", e.size // 2)):
+        raise ProtocolError(
+            f"edge payload holds {e.size // 2} pairs, header says "
+            f"{doc.get('m')}")
+    return e.reshape(-1, 2)
 
 
 def encode_assignment(assignment) -> dict:

@@ -187,6 +187,18 @@ class Job:
         # a job replayed as terminal from the journal carries result
         # SUMMARIES only (assignment arrays are not journaled)
         self.replayed_results: Optional[list] = None
+        # ---- resident partition (ISSUE 15) ---------------------------
+        # the engine parks the finished build's incremental state here
+        # (spec.resident only); finalize adopts it as resident_state,
+        # which update/epoch/compact verbs then mutate on the dispatch
+        # thread. A restarted daemon reloads it lazily from the
+        # resident-state snapshot; journaled_epoch is the journal's
+        # floor for the resumed epoch.
+        self.incremental_state = None
+        self.resident_state = None
+        self.resident_released = False
+        self.journaled_epoch = 0
+        self._upd_backend = None
 
     def journal_spec(self) -> dict:
         import dataclasses
@@ -214,6 +226,11 @@ class Job:
             d["wall_s"] = round(self.end_t - base, 4)
         if self.jit_compiles is not None:
             d["jit_compiles"] = self.jit_compiles
+        if self.spec.resident:
+            d["resident"] = not self.resident_released
+            st = self.resident_state
+            d["epoch"] = int(st.epoch) if st is not None \
+                else int(self.journaled_epoch)
         if self.state == DONE and self.results is not None:
             d["results"] = []
             for r in self.results:
@@ -249,6 +266,11 @@ class Scheduler:
         self._ids = itertools.count(1)
         self._stop = False
         self._draining = False
+        # resident-partition work items (update/epoch/compact verbs,
+        # ISSUE 15): handler threads enqueue + wait, the ONE dispatch
+        # thread executes — delta folds share the dispatch chain with
+        # job steps, never a second thread on the device
+        self._updates: deque = deque()
         # ---- durability (ISSUE 14): crash-safe journal + per-job
         # checkpoint domains. journal is a JobJournal or a path; with
         # one set, every job is journaled submit->terminal and the
@@ -314,6 +336,20 @@ class Scheduler:
             "sheepd_submits_reattached_total",
             "idempotent resubmissions matched to an existing job by "
             "digest", ("tenant",))
+        # ---- incremental plane (ISSUE 15): resident partitions ------
+        self._m_updates = self.metrics.counter(
+            "sheep_updates_total",
+            "delta epochs applied to resident partitions", ("tenant",))
+        self._m_update_latency = self.metrics.histogram(
+            "sheep_update_latency_seconds",
+            "one update verb: fold + (optional) refresh wall",
+            ("tenant",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        self._m_compactions = self.metrics.counter(
+            "sheep_compactions_total",
+            "resident-partition compactions (tombstone repair)",
+            ("tenant", "mode"))
         # ---- quality plane (ISSUE 13): partition QUALITY is a live,
         # scrapeable series, not just a number in a result payload —
         # per-tenant cut/balance distributions at DONE, plus per-job
@@ -376,6 +412,12 @@ class Scheduler:
                           rj.modeled_bytes)
                 job.digest = rj.digest
                 job.submit_t = rj.submit_t
+                # resident lineage (ISSUE 15): the journal's epoch
+                # floor; the state snapshot (>= this epoch — it is
+                # saved BEFORE the journal record) loads lazily on
+                # the first update/epoch/compact touch
+                job.journaled_epoch = rj.delta_epoch
+                job.resident_released = rj.resident_released
                 job.deadline_t = None if spec.deadline_s is None \
                     else rj.submit_t + spec.deadline_s
                 self._jobs[job.id] = job
@@ -552,6 +594,23 @@ class Scheduler:
             m = total(batch)
         return m, shed, None
 
+    @staticmethod
+    def _is_resident(job: Job) -> bool:
+        """A DONE resident job whose partition is still held — its
+        modeled bytes stay charged to the admission budget (the
+        resident state re-enters device memory on every update fold),
+        until the tenant releases it via cancel."""
+        return (job.spec.resident and job.state == DONE
+                and not job.resident_released)
+
+    def _reserved_locked(self) -> int:
+        with self._lock:
+            active = sum(j.modeled_bytes or 0 for j in self._active)
+            resident = sum(j.modeled_bytes or 0
+                           for j in self._jobs.values()
+                           if self._is_resident(j))
+            return active + resident
+
     def get(self, job_id: str) -> Optional[Job]:
         with self._lock:
             return self._jobs.get(job_id)
@@ -573,6 +632,28 @@ class Scheduler:
             if job is None:
                 return None
             if job.state in TERMINAL_STATES:
+                if self._is_resident(job):
+                    # cancel on a DONE resident job RELEASES the
+                    # residency: reservation freed, state dropped,
+                    # snapshot removed, release journaled (replay
+                    # must not re-charge the budget)
+                    job.resident_released = True
+                    job.resident_state = None
+                    job.incremental_state = None
+                    path = self._resident_path(job.id)
+                    if path is not None:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                    if self.journal is not None:
+                        self.journal.append(
+                            {"rec": "resident_release",
+                             "job_id": job.id, "t": time.time()},
+                            fsync=True)
+                    obs.event("resident_release", job=job.id,
+                              tenant=job.spec.tenant)
+                    self._cond.notify_all()
                 return job.state
             if job.state == QUEUED:
                 try:
@@ -607,11 +688,14 @@ class Scheduler:
             by_state: dict = {}
             for job in self._jobs.values():
                 by_state[job.state] = by_state.get(job.state, 0) + 1
-            reserved = sum(j.modeled_bytes or 0 for j in self._active)
+            reserved = self._reserved_locked()
+            resident = sum(1 for j in self._jobs.values()
+                           if self._is_resident(j))
             return {
                 "uptime_s": round(time.time() - self.started_t, 1),
                 "budget_bytes": self.budget,
                 "reserved_bytes": reserved,
+                "resident_partitions": resident,
                 "durable": self.journal is not None,
                 "restarts": self._restarts,
                 "jobs": dict(self.totals),
@@ -749,11 +833,13 @@ class Scheduler:
         under the lock is a handful of len()s."""
         with self._lock:
             active = list(self._active)
+            residents = [j for j in self._jobs.values()
+                         if self._is_resident(j)]
             samples = [
                 ("sheepd_queue_depth", {}, len(self._pending)),
                 ("sheepd_active_jobs", {}, len(active)),
-                ("sheepd_reserved_bytes", {},
-                 sum(j.modeled_bytes or 0 for j in active)),
+                ("sheepd_reserved_bytes", {}, self._reserved_locked()),
+                ("sheepd_resident_partitions", {}, len(residents)),
                 ("sheepd_chunk_caches", {}, len(self._caches)),
                 ("sheepd_uptime_seconds", {},
                  round(time.time() - self.started_t, 1)),
@@ -762,13 +848,20 @@ class Scheduler:
                 ("sheepd_flight_dumps", {}, self.flight.dumps),
             ]
             if self.budget is not None:
-                reserved = sum(j.modeled_bytes or 0 for j in active)
+                reserved = self._reserved_locked()
                 samples.append(("sheepd_budget_bytes", {}, self.budget))
                 samples.append(("sheepd_headroom_bytes", {},
                                 self.budget - reserved))
             for job in active:
                 labels = {"job": job.id, "tenant": job.spec.tenant}
                 samples.append(("sheepd_job_steps", labels, job.steps))
+            for job in residents:
+                st = job.resident_state
+                samples.append(
+                    ("sheepd_resident_epoch",
+                     {"job": job.id, "tenant": job.spec.tenant},
+                     int(st.epoch) if st is not None
+                     else int(job.journaled_epoch)))
             # per-job quality gauges (ISSUE 13): the most recent DONE
             # jobs' final scores, scrapeable per job/tenant/k. Bounded
             # to the 32 newest COMPLETIONS (submit order would let a
@@ -898,6 +991,283 @@ class Scheduler:
                   steps_captured=prof["steps_captured"])
 
     # ------------------------------------------------------------------
+    # resident partitions: the update/epoch/compact verbs (ISSUE 15)
+    # ------------------------------------------------------------------
+    def _resident_path(self, job_id: str) -> Optional[str]:
+        if self.ckpt_dir is None:
+            return None
+        return os.path.join(self.ckpt_dir, f"{job_id}.resident.npz")
+
+    def update(self, job_id: str, adds=None, dels=None,
+               epoch=None, score: bool = False, compact: str = "auto",
+               log: Optional[str] = None,
+               timeout_s: float = 600.0) -> dict:
+        """Apply one delta epoch (or a daemon-side delta log's pending
+        epochs) to a resident partition. Handler-thread API: the fold
+        itself runs on the dispatch thread (one device chain)."""
+        return self._submit_item(
+            {"kind": "update", "job_id": job_id, "adds": adds,
+             "dels": dels, "epoch": epoch, "score": bool(score),
+             "compact": str(compact), "log": log}, timeout_s)
+
+    def epoch_info(self, job_id: str,
+                   timeout_s: float = 600.0) -> dict:
+        return self._submit_item(
+            {"kind": "epoch", "job_id": job_id}, timeout_s)
+
+    def compact_resident(self, job_id: str, mode: str = "auto",
+                         score: bool = False,
+                         timeout_s: float = 600.0) -> dict:
+        return self._submit_item(
+            {"kind": "compact", "job_id": job_id, "mode": str(mode),
+             "score": bool(score)}, timeout_s)
+
+    def _submit_item(self, item: dict, timeout_s: float) -> dict:
+        item["evt"] = threading.Event()
+        with self._lock:
+            if self._stop or self._suspending:
+                raise protocol.ProtocolError("daemon is shutting down")
+            job = self._jobs.get(item["job_id"])
+            if job is None:
+                raise protocol.ProtocolError(
+                    f"unknown job {item['job_id']!r}")
+            self._updates.append(item)
+            self._cond.notify_all()
+        if not item["evt"].wait(timeout=timeout_s):
+            with self._lock:
+                try:
+                    # still queued: dequeue it so the abandoned
+                    # request cannot fire AFTER the client was told
+                    # it timed out (a blind retry of an un-epoched
+                    # update would then double-fold)
+                    self._updates.remove(item)
+                    dequeued = True
+                except ValueError:
+                    dequeued = False  # already executing
+                item["abandoned"] = True
+            if dequeued:
+                raise protocol.ProtocolError(
+                    f"{item['kind']} timed out after {timeout_s}s "
+                    f"waiting for the dispatch thread; the request "
+                    f"was dequeued — safe to retry")
+            raise protocol.ProtocolError(
+                f"{item['kind']} timed out after {timeout_s}s "
+                f"mid-execution; it may still apply — query `epoch` "
+                f"before retrying an un-epoched update")
+        if item.get("error") is not None:
+            raise protocol.ProtocolError(item["error"])
+        return item["result"]
+
+    def _service_updates(self) -> None:
+        """Dispatch-thread drain of the resident-partition work queue
+        (between job-step cycles, same thread as every device fold)."""
+        while True:
+            with self._lock:
+                if not self._updates:
+                    return
+                item = self._updates.popleft()
+                if item.get("abandoned"):
+                    continue  # its waiter already gave up
+            try:
+                with self.flight.job_context(item["job_id"]):
+                    item["result"] = self._do_item(item)
+                item["error"] = None
+            except protocol.ProtocolError as e:
+                item["error"] = str(e)
+            except Exception as e:  # noqa: BLE001 — answered, not fatal
+                item["error"] = (f"internal: {type(e).__name__}: "
+                                 f"{str(e)[:300]}")
+            finally:
+                item["evt"].set()
+
+    def _ensure_resident_state(self, job: Job):
+        """The job's live resident state, lazily reloaded from its
+        snapshot after a restart (the snapshot is written BEFORE each
+        journaled delta_epoch, so its epoch >= the journal floor —
+        'resumes at its last applied epoch'). Dispatch thread only."""
+        from sheep_tpu import incremental
+
+        if not job.spec.resident:
+            raise protocol.ProtocolError(
+                f"job {job.id} was not submitted resident")
+        if job.resident_released:
+            raise protocol.ProtocolError(
+                f"job {job.id}'s resident partition was released")
+        if job.state != DONE:
+            raise protocol.ProtocolError(
+                f"job {job.id} is {job.state}; a resident partition "
+                f"exists only after the build is done")
+        if job.resident_state is not None:
+            return job.resident_state
+        path = self._resident_path(job.id)
+        if path is None or not os.path.exists(path):
+            raise protocol.ProtocolError(
+                f"job {job.id} has no resident state on disk "
+                f"(non-durable daemon restarted, or state lost); "
+                f"rebuild with a fresh resident submit")
+        job.resident_state = incremental.load_state(path)
+        if job.resident_state.epoch < job.journaled_epoch:
+            # the journal promised an epoch the snapshot predates —
+            # never silently serve the older state
+            raise protocol.ProtocolError(
+                f"resident snapshot of {job.id} is at epoch "
+                f"{job.resident_state.epoch} but the journal floors "
+                f"{job.journaled_epoch}; state dir damaged")
+        obs.event("resident_resumed", job=job.id,
+                  epoch=int(job.resident_state.epoch))
+        return job.resident_state
+
+    def _update_backend_for(self, job: Job):
+        if job._upd_backend is None:
+            from sheep_tpu.backends.base import get_backend
+
+            spec = job.spec
+            job._upd_backend = get_backend(
+                "tpu", chunk_edges=spec.chunk_edges, alpha=spec.alpha,
+                segment_rounds=spec.segment_rounds)
+        return job._upd_backend
+
+    def _persist_resident(self, job: Job,
+                          journal_epoch: bool = True) -> None:
+        """Snapshot the resident state, then (optionally) journal the
+        applied epoch — strictly in that order, so a replayed journal
+        never names an epoch the snapshot lacks. Dispatch thread only
+        (the sole state mutator), and the O(V) array write + fsync
+        deliberately runs OUTSIDE the scheduler lock: a multi-second
+        snapshot of a big resident table must not stall every
+        ping/status/submit handler. Only the journal append and the
+        epoch-floor bookkeeping take the lock."""
+        from sheep_tpu import incremental
+
+        with self._lock:
+            if job.resident_released:
+                return  # cancel raced us before the write: nothing
+            st = job.resident_state
+            path = self._resident_path(job.id)
+        if st is None or path is None:
+            return
+        incremental.save_state(st, path)
+        with self._lock:
+            if job.resident_released:
+                # cancel released the residency DURING the write: the
+                # unlink it did must win — remove the snapshot we just
+                # resurrected and journal nothing
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
+            if journal_epoch and self.journal is not None:
+                self.journal.append(
+                    {"rec": "delta_epoch", "job_id": job.id,
+                     "epoch": int(st.epoch), "t": time.time()},
+                    fsync=True)
+            job.journaled_epoch = max(job.journaled_epoch,
+                                      int(st.epoch))
+
+    def _do_item(self, item: dict) -> dict:
+        from sheep_tpu import incremental
+
+        with self._lock:
+            job = self._jobs.get(item["job_id"])
+        if job is None:
+            raise protocol.ProtocolError(
+                f"unknown job {item['job_id']!r}")
+        state = self._ensure_resident_state(job)
+        tenant = job.spec.tenant
+        if item["kind"] == "epoch":
+            return {"job_id": job.id, "epoch": int(state.epoch),
+                    "anchored_at_epoch": int(state.anchored_at_epoch),
+                    "stale_deletes": int(state.stale_deletes),
+                    "compactions": int(state.compactions),
+                    "n_vertices": int(state.n),
+                    "total_edges": int(state.total_edges)}
+        backend = self._update_backend_for(job)
+        if item["kind"] == "compact":
+            t0 = time.perf_counter()
+            mode = incremental.compact_state(backend, state,
+                                             mode=item["mode"])
+            if mode != "noop":
+                self._m_compactions.inc(tenant=tenant, mode=mode)
+            out = {"job_id": job.id, "mode": mode,
+                   "epoch": int(state.epoch),
+                   "compactions": int(state.compactions),
+                   "wall_s": round(time.perf_counter() - t0, 4)}
+            if item.get("score"):
+                out["results"] = self._refresh_results(
+                    backend, state, job)
+            self._persist_resident(job)
+            return out
+        # ---- update -------------------------------------------------
+        t0 = time.perf_counter()
+        epochs = []
+        if item.get("log"):
+            from sheep_tpu.io.deltalog import DeltaLogReader
+
+            reader = DeltaLogReader(item["log"])
+            base = reader.header["base_spec"]
+            if state.base_spec is not None \
+                    and base != state.base_spec:
+                raise protocol.ProtocolError(
+                    f"delta log {item['log']!r} logs over {base!r}, "
+                    f"not this partition's base "
+                    f"{state.base_spec!r}")
+            epochs = list(reader.epochs(start_epoch=state.epoch))
+        else:
+            epochs = [(item.get("epoch"), item.get("adds"),
+                       item.get("dels"))]
+        compactions0 = int(state.compactions)
+        applied = 0
+        for ep, adds, dels in epochs:
+            before = int(state.epoch)
+            backend.partition_update(
+                state, adds=adds, deletes=dels, epoch=ep,
+                score=False, compact=item.get("compact", "auto"))
+            if int(state.epoch) != before:
+                # count applied BATCHES, not the epoch-number delta:
+                # explicit epochs may be sparse (1 then 5 is legal)
+                applied += 1
+        if applied > 0:
+            self._m_updates.inc(applied, tenant=tenant)
+            comp = int(state.compactions) - compactions0
+            if comp:
+                self._m_compactions.inc(comp, tenant=tenant,
+                                        mode="auto")
+            self._persist_resident(job)
+        out = {"job_id": job.id, "epoch": int(state.epoch),
+               "applied": applied > 0, "epochs_applied": applied,
+               "stale_deletes": int(state.stale_deletes),
+               "compactions": int(state.compactions)}
+        if item.get("score"):
+            out["results"] = self._refresh_results(backend, state, job)
+        self._m_update_latency.observe(time.perf_counter() - t0,
+                                       tenant=tenant)
+        obs.event("job_update", job=job.id, tenant=tenant,
+                  epoch=int(state.epoch), applied=applied)
+        return out
+
+    def _refresh_results(self, backend, state, job: Job) -> list:
+        """Split + score the current resident table; the job's result
+        rows update so wait/status serve the newest scores."""
+        from sheep_tpu import incremental
+
+        res = incremental.refresh(backend, state,
+                                  comm_volume=job.spec.comm_volume)
+        results = res if isinstance(res, list) else [res]
+        with self._lock:
+            job.results = results
+        for r in results:
+            self._m_quality_cut.observe(float(r.cut_ratio),
+                                        tenant=job.spec.tenant)
+            self._m_quality_balance.observe(float(r.balance),
+                                            tenant=job.spec.tenant)
+            obs.event("job_quality", job=job.id, k=int(r.k),
+                      cut_ratio=round(float(r.cut_ratio), 6),
+                      balance=round(float(r.balance), 4),
+                      edge_cut=int(r.edge_cut))
+        return [r.summary() for r in results]
+
+    # ------------------------------------------------------------------
     # the dispatch loop (one thread)
     # ------------------------------------------------------------------
     def run(self) -> None:
@@ -929,7 +1299,7 @@ class Scheduler:
                     if self._draining and not self._pending \
                             and not self._active:
                         return
-                    idle = not self._active
+                    idle = not self._active and not self._updates
                     capturing = self._profile is not None \
                         and self._profile["state"] == "capturing"
                     if idle and not capturing:
@@ -947,6 +1317,9 @@ class Scheduler:
                     continue
                 for job in cycle:
                     self._step(job)
+                # resident-partition verbs drain between step cycles:
+                # delta folds share the one dispatch chain (ISSUE 15)
+                self._service_updates()
         finally:
             self._teardown_telemetry()
 
@@ -958,6 +1331,14 @@ class Scheduler:
         prof = self._profile
         if prof is not None and prof.get("state") == "capturing":
             self._finish_profile(aborted=True)
+        with self._lock:
+            pending_items = list(self._updates)
+            self._updates.clear()
+        for item in pending_items:
+            # answer every parked update verb: a handler thread must
+            # never ride its full timeout because the loop exited
+            item["error"] = "daemon is shutting down"
+            item["evt"].set()
         self.flight.dump_all(reason="shutdown")
         if obs.get_flight() is self.flight:
             obs.uninstall_flight()
@@ -981,11 +1362,25 @@ class Scheduler:
             while self._pending:
                 job = self._pending[0]
                 if self.budget is not None:
-                    reserved = sum(j.modeled_bytes or 0
-                                   for j in self._active)
-                    if self._active and \
+                    # resident partitions count: their tables re-enter
+                    # device memory on every update fold (ISSUE 15)
+                    reserved = self._reserved_locked()
+                    if (self._active or reserved) and \
                             reserved + (job.modeled_bytes or 0) \
                             > self.budget:
+                        if not self._active \
+                                and not job.stats.get(
+                                    "blocked_by_resident"):
+                            # nothing running will ever free these
+                            # bytes — only a tenant releasing a
+                            # resident partition can; say so ONCE so
+                            # the wait is diagnosable, not silent
+                            job.stats["blocked_by_resident"] = 1
+                            obs.event("admission_blocked_by_resident",
+                                      job=job.id,
+                                      tenant=job.spec.tenant,
+                                      reserved_bytes=int(reserved),
+                                      budget_bytes=int(self.budget))
                         break  # fits the budget, not current headroom
                 self._pending.popleft()
                 self._start_locked(job)
@@ -1080,6 +1475,10 @@ class Scheduler:
             error = f"{type(exc).__name__}: {str(exc)[:300]}"
         with self._lock:
             self._finalize_locked(job, outcome, error)
+        if outcome == DONE and job.resident_state is not None:
+            # the adopted resident partition's initial snapshot —
+            # outside the lock, on the dispatch thread (ISSUE 15)
+            self._persist_resident(job, journal_epoch=False)
         if outcome == FAILED:
             # forensics: the job's last N buffered events (terminal
             # event included — job_done landed in the ring at
@@ -1114,6 +1513,17 @@ class Scheduler:
             self._release_cache_locked(job)
             if state == DONE:
                 self._write_output(job)
+            if state == DONE and job.spec.resident \
+                    and job.incremental_state is not None:
+                # adopt the engine's incremental state as the resident
+                # partition (ISSUE 15); the initial snapshot is
+                # written by _step AFTER this lock releases (an O(V)
+                # disk write must not stall the handler threads) —
+                # until it lands, a crash replays the job as DONE
+                # with no resident state, the documented non-durable
+                # degradation
+                job.resident_state = job.incremental_state
+                job.incremental_state = None
             self.totals[state] = self.totals.get(state, 0) + 1
             self._m_terminal.inc(tenant=job.spec.tenant, state=state)
             if state == DONE:
@@ -1170,7 +1580,8 @@ class Scheduler:
                 # done job's is just noise
                 self.flight.forget(job.id)
             terminal = [jid for jid, j in self._jobs.items()
-                        if j.state in TERMINAL_STATES]
+                        if j.state in TERMINAL_STATES
+                        and not self._is_resident(j)]
             for jid in terminal[:max(0, len(terminal)
                                      - self.MAX_TERMINAL_RETAINED)]:
                 del self._jobs[jid]

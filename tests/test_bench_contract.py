@@ -83,6 +83,12 @@ def test_fallback_emits_null_vs_baseline():
     # every measured line so bench_regress gates warm-path latency
     assert line["warm_up_s"] > 0
     assert line["cold_request_s"] > 0 and line["warm_request_s"] > 0
+    # the incremental contract (ISSUE 15): update_request_s — one
+    # resident-partition delta fold — rides every measured line with
+    # its compactions companion, so bench_regress can gate the O(Δ)
+    # update wall like the warm path
+    assert line["update_request_s"] > 0
+    assert line["compactions"] == 0
 
 
 def test_skip_probe_short_circuits():

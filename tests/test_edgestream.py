@@ -1,5 +1,7 @@
 """EdgeStream chunking/sharding invariants (SURVEY.md §2 #1)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -315,3 +317,117 @@ class TestSizeBounds:
         assert es.clamp_chunk_edges(1 << 20, floor=100) == 1000
         assert es.clamp_chunk_edges(1 << 20, parts=4, floor=100) == 250
         assert es.clamp_chunk_edges(512) == 512  # never grows
+
+
+class TestDeltaLogDamage:
+    """ISSUE 15 satellite: the delta-log format joins the
+    quarantine-or-raise contract — a torn trailing record, a mid-log
+    short read (the log shrank under a live reader) and an epoch
+    rewind are never silently folded into a resident partition."""
+
+    def _log(self, tmp_path, n_epochs=2, per=40):
+        from sheep_tpu.io import deltalog as dl
+
+        e = generators.random_graph(64, n_epochs * per, seed=15)
+        p = str(tmp_path / "g.dlog")
+        base = str(tmp_path / "base.bin64")
+        formats.write_edges(base, generators.random_graph(64, 50,
+                                                          seed=16))
+        with dl.DeltaLogWriter(p, base_spec=base) as w:
+            for i in range(n_epochs):
+                w.append(e[i * per: (i + 1) * per])
+        return p, e
+
+    @pytest.mark.parametrize("extra", [1, 7, 23])
+    def test_torn_trailing_record_strict_raises(self, tmp_path, extra):
+        from sheep_tpu.io import deltalog as dl
+        from sheep_tpu.io.edgestream import CorruptStreamError
+
+        p, _ = self._log(tmp_path)
+        with open(p, "ab") as f:
+            f.write(b"\xff" * extra)
+        with pytest.raises(CorruptStreamError):
+            dl.DeltaLogReader(p).records()
+
+    def test_torn_trailing_record_quarantines_prefix(self, tmp_path,
+                                                     monkeypatch):
+        from sheep_tpu.io import deltalog as dl
+
+        p, e = self._log(tmp_path)
+        with open(p, "ab") as f:
+            f.write(b"\xff" * 5)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        recs = dl.DeltaLogReader(p).records()
+        got = np.stack([recs["u"].astype(np.int64),
+                        recs["v"].astype(np.int64)], axis=1)
+        np.testing.assert_array_equal(got, e)  # intact prefix exact
+
+    def test_midlog_short_read_strict_raises(self, tmp_path,
+                                             monkeypatch):
+        from sheep_tpu.io import deltalog as dl
+        from sheep_tpu.io.edgestream import CorruptStreamError
+
+        p, _ = self._log(tmp_path)
+        real = os.path.getsize(p)
+        # the log "shrank under us": the size check saw more records
+        # than the read returns (metadata lied / concurrent truncate)
+        monkeypatch.setattr(dl.os.path, "getsize",
+                            lambda _p, real=real: real + 24
+                            if _p == p else os.stat(_p).st_size)
+        with pytest.raises(CorruptStreamError):
+            dl.DeltaLogReader(p).records()
+
+    def test_midlog_short_read_quarantines_prefix(self, tmp_path,
+                                                  monkeypatch):
+        from sheep_tpu.io import deltalog as dl
+
+        p, e = self._log(tmp_path)
+        real = os.path.getsize(p)
+        monkeypatch.setattr(dl.os.path, "getsize",
+                            lambda _p, real=real: real + 24
+                            if _p == p else os.stat(_p).st_size)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        recs = dl.DeltaLogReader(p).records()
+        got = np.stack([recs["u"].astype(np.int64),
+                        recs["v"].astype(np.int64)], axis=1)
+        np.testing.assert_array_equal(got, e)  # the intact records
+
+    def test_epoch_rewind_is_corruption(self, tmp_path, monkeypatch):
+        from sheep_tpu.io import deltalog as dl
+        from sheep_tpu.io.edgestream import CorruptStreamError
+
+        p, e = self._log(tmp_path)
+        # flip the SECOND epoch's stamps backwards on disk
+        hdr = dl.read_header(p)
+        recs = np.fromfile(p, dtype=dl.RECORD_DTYPE,
+                           offset=hdr["header_len"])
+        recs["epoch"][40:] = 0
+        with open(p, "r+b") as f:
+            f.seek(hdr["header_len"])
+            f.write(recs.tobytes())
+        with pytest.raises(CorruptStreamError):
+            dl.DeltaLogReader(p).records()
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        kept = dl.DeltaLogReader(p).records()
+        assert len(kept) == 40  # the intact (monotone) prefix
+
+    def test_quarantined_delta_build_equals_intact_prefix(
+            self, tmp_path, monkeypatch):
+        """End-to-end: a torn delta: input under quarantine builds
+        exactly the partition of the intact-prefix log — never a
+        forest from garbage bytes."""
+        from sheep_tpu.io import deltalog as dl
+        from sheep_tpu.io.edgestream import open_input
+
+        import sheep_tpu
+
+        p, _ = self._log(tmp_path)
+        intact = sheep_tpu.partition(f"delta:{p}", 4, backend="tpu",
+                                     chunk_edges=64, comm_volume=False)
+        with open(p, "ab") as f:
+            f.write(b"\xee" * 9)
+        monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+        torn = sheep_tpu.partition(f"delta:{p}", 4, backend="tpu",
+                                   chunk_edges=64, comm_volume=False)
+        np.testing.assert_array_equal(torn.assignment,
+                                      intact.assignment)

@@ -1,0 +1,611 @@
+"""Incremental repartitioning tests (ISSUE 15).
+
+The acceptance pins:
+
+- **Adds are exact**: the shuffled two-halves replay — build half the
+  stream, fold the other half as delta epochs — is BIT-IDENTICAL to a
+  one-shot build of the ``delta:`` input on the pure/cpu/tpu backends,
+  through the CLI ``--deltas`` replay, and through the served
+  ``update`` verb (the anchored-order contract + fixpoint uniqueness,
+  sheep_tpu/incremental.py module docstring).
+- **Delete + full compaction** matches a clean rebuild of the
+  surviving edges bit-identically (full compaction IS a clean rebuild
+  of the survivor stream, re-anchored); **subtree compaction** ships
+  with a tested score bound instead.
+- **Anchored-order drift** is score-bounded against the fresh-order
+  one-shot build (the quality gate's dynamic scenario enforces the
+  same bound in CI).
+- The delta-log format survives damage under the SHEEP_IO_POLICY
+  contract (tests/test_edgestream.py TestDeltaLogDamage) and a
+  resident served partition survives kill + restart at its journaled
+  epoch (tests/test_journal.py drill).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import sheep_tpu
+from sheep_tpu import incremental as inc
+from sheep_tpu.backends.base import get_backend, list_backends
+from sheep_tpu.io import deltalog as dl
+from sheep_tpu.io.edgestream import EdgeStream, open_input
+
+N = 512
+SEED = 5
+
+
+def _graph(m=4000, n=N, seed=SEED):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, n, (m, 2)).astype(np.int64)
+
+
+def _base_file(tmp_path, edges, name="base.bin64"):
+    p = str(tmp_path / name)
+    with open(p, "wb") as f:
+        f.write(np.asarray(edges, np.int64).astype("<u8").tobytes())
+    return p
+
+
+def _backends():
+    avail = list_backends()
+    return [b for b in ("pure", "cpu", "tpu") if b in avail]
+
+
+# ----------------------------------------------------------------------
+# delta-log format
+# ----------------------------------------------------------------------
+class TestDeltaLog:
+    def test_header_round_trip(self, tmp_path):
+        log = str(tmp_path / "g.dlog")
+        dl.write_header(log, "base.bin64")
+        hdr = dl.read_header(log)
+        assert hdr["base_spec"] == "base.bin64"
+        assert hdr["version"] == dl.VERSION
+
+    def test_not_a_delta_log(self, tmp_path):
+        p = str(tmp_path / "junk")
+        with open(p, "wb") as f:
+            f.write(b"not a log at all")
+        with pytest.raises(ValueError, match="bad magic"):
+            dl.read_header(p)
+
+    def test_writer_epochs_and_reopen(self, tmp_path):
+        log = str(tmp_path / "g.dlog")
+        e = _graph(64)
+        with dl.DeltaLogWriter(log, base_spec="b") as w:
+            assert w.append(e[:10]) == 1
+            assert w.append(e[10:20], op=dl.OP_DEL, epoch=1) == 1
+            assert w.append(e[20:30]) == 2
+        with dl.DeltaLogWriter(log) as w2:  # reopen: no base_spec
+            assert w2.last_epoch == 2
+            assert w2.append_epoch(adds=e[30:40], dels=e[40:45]) == 3
+        r = dl.DeltaLogReader(log)
+        eps = list(r.epochs())
+        assert [ep for ep, _, _ in eps] == [1, 2, 3]
+        ep1_adds, ep1_dels = eps[0][1], eps[0][2]
+        assert np.array_equal(ep1_adds, e[:10])
+        assert np.array_equal(ep1_dels, e[10:20])
+        assert r.max_epoch == 3
+        # start/up_to windows
+        assert [ep for ep, _, _ in r.epochs(start_epoch=2)] == [3]
+        r2 = dl.DeltaLogReader(log)
+        assert [ep for ep, _, _ in r2.epochs(up_to=2)] == [1, 2]
+
+    def test_writer_validation(self, tmp_path):
+        log = str(tmp_path / "g.dlog")
+        with pytest.raises(ValueError, match="base_spec"):
+            dl.DeltaLogWriter(log)
+        with dl.DeltaLogWriter(log, base_spec="b") as w:
+            with pytest.raises(ValueError, match="bad delta op"):
+                w.append(_graph(4), op=9)
+            with pytest.raises(ValueError, match="non-negative"):
+                w.append(np.array([[-1, 2]]))
+            w.append(_graph(4), epoch=5)
+            with pytest.raises(ValueError, match="never rewind"):
+                w.append(_graph(4), epoch=4)
+            with pytest.raises(ValueError,
+                               match="logs deltas over"):
+                dl.DeltaLogWriter(log, base_spec="other")
+
+    def test_net_effect_cancels_adds_then_tombstones_base(self):
+        adds = np.array([[1, 2], [3, 4], [2, 1]], np.int64)
+        rec = np.zeros(5, dtype=dl.RECORD_DTYPE)
+        rec["u"][:3] = adds[:, 0]
+        rec["v"][:3] = adds[:, 1]
+        rec["epoch"] = 1
+        # two DELs of {1,2}: one cancels an add (undirected match),
+        # one tombstones the base; one DEL of {7,8} tombstones base
+        rec["u"][3:] = [2, 7]
+        rec["v"][3:] = [1, 8]
+        rec["op"][3:] = dl.OP_DEL
+        surv, tombs = dl.net_effect(rec)
+        keys = {tuple(r) for r in surv.tolist()}
+        assert keys == {(1, 2), (3, 4)}  # one {1,2} copy cancelled
+        assert sorted(map(tuple, tombs.tolist())) == [(7, 8)]
+
+    def test_del_never_cancels_a_later_add(self, tmp_path):
+        """In-order resolution: deleting an edge the graph does not
+        have removes nothing — it must NOT reach forward and erase an
+        add from a later epoch, on either the one-shot or the
+        incremental path (they'd diverge otherwise)."""
+        e = _graph(600)
+        base = _base_file(tmp_path, e[:300])
+        absent = np.array([[N - 1, N - 2]], np.int64)
+        assert not any(tuple(sorted(r)) == (N - 2, N - 1)
+                       for r in e[:300].tolist())
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append_epoch(dels=absent)       # epoch 1: no-op delete
+            w.append(absent)                  # epoch 2: ADD it
+            w.append(e[300:])                 # epoch 3
+        st = open_input(f"delta:{log}", n_vertices=N)
+        keys = [tuple(sorted(r)) for r in st.read_all().tolist()]
+        assert keys.count((N - 2, N - 1)) == 1  # the add SURVIVES
+        # and the incremental replay lands bit-identical
+        be = get_backend("tpu", chunk_edges=777)
+        one = be.partition(open_input(f"delta:{log}", n_vertices=N),
+                           4, comm_volume=False)
+        state, _ = inc.begin_incremental(
+            open_input(base, n_vertices=N), 4, backend=be)
+        be.partition_update(state, deletes=absent, epoch=1,
+                            score=False, compact="never")
+        be.partition_update(state, adds=absent, epoch=2, score=False)
+        r = be.partition_update(state, adds=e[300:], epoch=3,
+                                score=True)
+        assert np.array_equal(r.assignment, one.assignment)
+        assert (r.edge_cut, r.total_edges) == (one.edge_cut,
+                                               one.total_edges)
+
+    def test_filter_tombstones_multiset(self):
+        chunks = [np.array([[1, 2], [3, 4]], np.int64),
+                  np.array([[2, 1], [5, 6]], np.int64)]
+        out = list(dl.filter_tombstones(chunks,
+                                        np.array([[1, 2]], np.int64)))
+        flat = np.concatenate(out)
+        # exactly ONE {1,2} occurrence removed
+        assert len(flat) == 3
+        assert sum(1 for r in flat.tolist()
+                   if tuple(sorted(r)) == (1, 2)) == 1
+
+    def test_delta_spec_parsing(self, tmp_path):
+        e = _graph()
+        base = _base_file(tmp_path, e[:2000])
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append(e[2000:3000])
+            w.append(e[3000:])
+        st = open_input(f"delta:{log}")
+        assert st.epoch == 2
+        assert len(st.read_all()) == len(e)
+        capped = open_input(f"delta:{log}@1")
+        assert capped.epoch == 1
+        assert len(capped.read_all()) == 3000
+        with pytest.raises(ValueError, match="does not exist"):
+            open_input(f"delta:{tmp_path}/nope.dlog")
+        with pytest.raises(ValueError, match="below the"):
+            open_input(f"delta:{log}", n_vertices=4)
+        with pytest.raises(NotImplementedError):
+            list(st.chunks(64, shard=0, num_shards=2))
+
+    def test_delta_logs_do_not_nest(self, tmp_path):
+        e = _graph(100)
+        base = _base_file(tmp_path, e)
+        inner = str(tmp_path / "inner.dlog")
+        with dl.DeltaLogWriter(inner, base_spec=base) as w:
+            w.append(e[:10])
+        outer = str(tmp_path / "outer.dlog")
+        dl.write_header(outer, f"delta:{inner}")
+        with pytest.raises(ValueError, match="do not nest"):
+            open_input(f"delta:{outer}")
+
+
+# ----------------------------------------------------------------------
+# the exactness contract: adds == one-shot, per backend
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("backend", _backends())
+def test_two_halves_replay_bit_identical(tmp_path, backend):
+    e = _graph()
+    half = len(e) // 2
+    base = _base_file(tmp_path, e[:half])
+    log = str(tmp_path / "g.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(e[half: half + 1000])
+        w.append(e[half + 1000:])
+    be = get_backend(backend, chunk_edges=777)
+    one = be.partition(open_input(f"delta:{log}", n_vertices=N), 8,
+                       comm_volume=False)
+    state, res0 = inc.begin_incremental(
+        open_input(base, n_vertices=N), 8, backend=be)
+    r1 = be.partition_update(state, adds=e[half: half + 1000],
+                             score=False)
+    assert r1 is None  # score=False returns nothing, folds silently
+    r2 = be.partition_update(state, adds=e[half + 1000:], score=True)
+    assert state.epoch == 2
+    assert np.array_equal(r2.assignment, one.assignment)
+    assert (r2.edge_cut, r2.total_edges) == (one.edge_cut,
+                                             one.total_edges)
+    assert r2.balance == pytest.approx(one.balance)
+    assert r2.diagnostics["epoch"] == 2.0
+
+
+def test_incremental_state_round_trips_through_snapshot(tmp_path):
+    e = _graph()
+    half = len(e) // 2
+    base = _base_file(tmp_path, e[:half])
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 8, backend=be)
+    be.partition_update(state, adds=e[half:-500], score=False)
+    be.partition_update(state, deletes=e[:100], score=False,
+                        compact="never")
+    path = str(tmp_path / "st.npz")
+    inc.save_state(state, path)
+    loaded = inc.load_state(path)
+    assert loaded.epoch == state.epoch
+    assert np.array_equal(loaded.minp, state.minp)
+    # the reloaded state continues BIT-identically
+    ra = be.partition_update(state, adds=e[-500:], score=True,
+                             compact="never")
+    rb = be.partition_update(loaded, adds=e[-500:], score=True,
+                             compact="never")
+    assert np.array_equal(ra.assignment, rb.assignment)
+    assert ra.edge_cut == rb.edge_cut
+
+
+def test_epoch_idempotency_and_vertex_space_guard(tmp_path):
+    e = _graph()
+    base = _base_file(tmp_path, e[:2000])
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 4, backend=be)
+    assert be.partition_update(state, adds=e[2000:2100],
+                               epoch=1) is not None
+    # replaying an applied epoch is a silent no-op (the served retry
+    # contract)
+    assert be.partition_update(state, adds=e[2000:2100],
+                               epoch=1) is None
+    assert state.epoch == 1
+    with pytest.raises(ValueError, match="outside the resident"):
+        be.partition_update(state, adds=np.array([[0, N + 7]]))
+    with pytest.raises(ValueError, match="bad compact mode"):
+        be.partition_update(state, adds=e[:4], compact="later")
+
+
+def test_unsupported_backends_reject_incremental_and_delta(tmp_path):
+    e = _graph(200)
+    base = _base_file(tmp_path, e)
+    log = str(tmp_path / "g.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(e[:10])
+    from sheep_tpu.types import UnsupportedGraphError
+
+    for name in ("tpu-sharded", "tpu-bigv"):
+        if name not in list_backends():
+            continue
+        be = get_backend(name)
+        with pytest.raises(ValueError,
+                           match="does not support incremental"):
+            be.partition_update(None, adds=e[:2])
+        with pytest.raises(UnsupportedGraphError,
+                           match="single-device"):
+            be.partition(open_input(f"delta:{log}", n_vertices=N), 4)
+
+
+# ----------------------------------------------------------------------
+# deletions: tombstones, compaction, staleness
+# ----------------------------------------------------------------------
+def test_delete_full_compact_matches_clean_rebuild(tmp_path):
+    e = _graph()
+    base = _base_file(tmp_path, e[:2000])
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 8, backend=be)
+    be.partition_update(state, adds=e[2000:], score=False)
+    dels = e[np.random.default_rng(9).permutation(len(e))[:600]]
+    r_stale = be.partition_update(state, deletes=dels, score=True,
+                                  compact="never")
+    assert state.stale_deletes == 600
+    mode = inc.compact_state(be, state, mode="full")
+    assert mode == "full"
+    assert state.stale_deletes == 0
+    assert state.anchored_at_epoch == state.epoch
+    r = inc.refresh(be, state)
+    surv = np.concatenate(list(dl.filter_tombstones([e], dels)))
+    clean = be.partition(EdgeStream.from_array(surv, n_vertices=N), 8,
+                         comm_volume=False)
+    assert np.array_equal(r.assignment, clean.assignment)
+    assert (r.edge_cut, r.total_edges) == (clean.edge_cut,
+                                           clean.total_edges)
+    # the stale pre-compact score already counted the right multiset
+    assert r_stale.total_edges == clean.total_edges
+
+
+def test_subtree_compact_is_local_and_score_bounded(tmp_path):
+    e = _graph(6000)
+    base = _base_file(tmp_path, e[:3000])
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 8, backend=be)
+    be.partition_update(state, adds=e[3000:], score=False)
+    # a few localized deletes: the dirty set stays small
+    dels = e[:30]
+    be.partition_update(state, deletes=dels, score=False,
+                        compact="never")
+    mode = inc.compact_state(be, state, mode="subtree")
+    assert mode == "subtree"
+    assert state.stats["compact_subtree"] == 1
+    # locality: the refold touched a subset, not the whole stream
+    assert 0 < state.stats["compact_refolded_edges"] < len(e)
+    r = inc.refresh(be, state)
+    surv = np.concatenate(list(dl.filter_tombstones([e], dels)))
+    clean = be.partition(EdgeStream.from_array(surv, n_vertices=N), 8,
+                         comm_volume=False)
+    assert r.total_edges == clean.total_edges
+    # the explicit, tested score bound of the approximate mode
+    assert r.cut_ratio <= clean.cut_ratio + 0.05
+
+
+def test_staleness_counter_forces_compaction(tmp_path):
+    e = _graph()
+    base = _base_file(tmp_path, e)
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 8, backend=be)
+    state.compact_threshold = 50
+    be.partition_update(state, deletes=e[:40], score=False)
+    assert state.compactions == 0  # under threshold: tombstones ride
+    be.partition_update(state, deletes=e[40:100], score=False)
+    assert state.compactions == 1  # past threshold: forced
+    assert state.stale_deletes == 0
+
+
+def test_compact_noop_when_nothing_changed(tmp_path):
+    e = _graph(1000)
+    base = _base_file(tmp_path, e)
+    be = get_backend("tpu", chunk_edges=777)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 4, backend=be)
+    assert inc.compact_state(be, state, mode="auto") == "noop"
+    assert state.compactions == 0
+
+
+def test_anchored_drift_is_score_bounded():
+    """The order-anchoring cost on a structured graph stays inside the
+    bound the quality gate's dynamic scenario enforces in CI."""
+    with open_input("sbm-hash:9:8:0.05:16:3") as es:
+        edges = es.read_all()
+        n = es.num_vertices
+    rng = np.random.default_rng(7)
+    e = edges[rng.permutation(len(edges))]
+    half = len(e) // 2
+    be = get_backend("tpu", chunk_edges=1 << 12)
+    state, _ = inc.begin_incremental(
+        EdgeStream.from_array(e[:half], n_vertices=n), 8, backend=be)
+    res = be.partition_update(state, adds=e[half:], score=True)
+    oneshot = be.partition(EdgeStream.from_array(e, n_vertices=n), 8,
+                           comm_volume=False)
+    assert res.total_edges == oneshot.total_edges
+    assert res.cut_ratio <= oneshot.cut_ratio + 0.05
+
+
+# ----------------------------------------------------------------------
+# CLI: --deltas replay and validation
+# ----------------------------------------------------------------------
+def test_cli_deltas_replay_matches_one_shot(tmp_path, capsys):
+    import json
+
+    from sheep_tpu import cli
+
+    e = _graph()
+    half = len(e) // 2
+    base = _base_file(tmp_path, e[:half])
+    log = str(tmp_path / "g.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(e[half:-700])
+        w.append(e[-700:])
+    rc = cli.main(["--input", base, "--k", "4", "--backend", "tpu",
+                   "--num-vertices", str(N), "--chunk-edges", "777",
+                   "--deltas", log, "--json"])
+    assert rc == 0
+    incr = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rc = cli.main(["--input", f"delta:{log}", "--k", "4",
+                   "--backend", "tpu", "--num-vertices", str(N),
+                   "--chunk-edges", "777", "--json"])
+    assert rc == 0
+    one = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert incr["edge_cut"] == one["edge_cut"]
+    assert incr["total_edges"] == one["total_edges"]
+    assert incr["diagnostics"]["epoch"] == 2.0
+
+
+def test_cli_deltas_validation(tmp_path):
+    from sheep_tpu import cli
+
+    e = _graph(100)
+    base = _base_file(tmp_path, e)
+    log = str(tmp_path / "g.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(e[:10])
+    for extra in (["--refine", "2"], ["--k", "4,8"],
+                  ["--checkpoint-dir", str(tmp_path / "ck")],
+                  ["--auto-recipe"]):
+        argv = ["--input", base, "--k", "4", "--deltas", log] + extra
+        if extra == ["--k", "4,8"]:
+            argv = ["--input", base, "--deltas", log] + extra
+        with pytest.raises(SystemExit):
+            cli.main(argv)
+    with pytest.raises(SystemExit):
+        cli.main(["--input", base, "--k-levels", "2,2",
+                  "--deltas", log])
+    with pytest.raises(SystemExit):
+        cli.main(["--input", base, "--k", "4", "--deltas",
+                  str(tmp_path / "missing.dlog")])
+
+
+# ----------------------------------------------------------------------
+# served surface: resident partitions, update/epoch/compact verbs
+# ----------------------------------------------------------------------
+def _spec(input, n=N, ks=(4,), resident=True, **fields):
+    from sheep_tpu.server.protocol import JobSpec
+
+    body = {"input": input, "k": list(ks), "chunk_edges": 512,
+            "num_vertices": n, "resident": resident}
+    body.update(fields)
+    return JobSpec.from_request(body, tenant="inc")
+
+
+def _run_scheduler(**kw):
+    from sheep_tpu.server.scheduler import Scheduler
+
+    sched = Scheduler(**kw)
+    t = threading.Thread(target=sched.run, daemon=True)
+    t.start()
+    return sched, t
+
+
+def test_served_update_verb_bit_identical(tmp_path):
+    e = _graph(3000)
+    base = _base_file(tmp_path, e[:1500])
+    sched, t = _run_scheduler()
+    try:
+        job = sched.submit(_spec(base))
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        assert sched.stats()["resident_partitions"] == 1
+        r1 = sched.update(job.id, adds=e[1500:2200], epoch=1)
+        assert r1["applied"] and r1["epoch"] == 1
+        r2 = sched.update(job.id, adds=e[2200:], epoch=2, score=True)
+        assert r2["epoch"] == 2
+        # idempotent replay answers applied=false
+        r1b = sched.update(job.id, adds=e[1500:2200], epoch=1)
+        assert r1b["applied"] is False
+        info = sched.epoch_info(job.id)
+        assert info["epoch"] == 2
+        assert 0 < info["total_edges"] <= len(e)
+        # the served result bit-equals the one-shot delta: build
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append(e[1500:2200])
+            w.append(e[2200:])
+        be = get_backend("tpu", chunk_edges=512)
+        one = be.partition(open_input(f"delta:{log}", n_vertices=N),
+                           4, comm_volume=False)
+        assert np.array_equal(job.results[0].assignment,
+                              one.assignment)
+        assert r2["results"][0]["edge_cut"] == one.edge_cut
+        # metrics joined the catalog
+        text = sched.render_metrics()
+        assert 'sheep_updates_total{tenant="inc"} 2' in text
+        assert "sheep_update_latency_seconds_bucket" in text
+        # cancel releases the residency + its reservation
+        sched.cancel(job.id)
+        assert sched.stats()["resident_partitions"] == 0
+        with pytest.raises(Exception, match="released"):
+            sched.epoch_info(job.id)
+    finally:
+        sched.shutdown()
+        t.join(timeout=60)
+
+
+def test_served_update_log_form_and_deletes(tmp_path):
+    e = _graph(3000)
+    base = _base_file(tmp_path, e[:1500])
+    log = str(tmp_path / "g.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(e[1500:])
+        w.append_epoch(dels=e[:50])
+    sched, t = _run_scheduler()
+    try:
+        job = sched.submit(_spec(base))
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        r = sched.update(job.id, log=log, score=True)
+        assert r["epochs_applied"] == 2 and r["epoch"] == 2
+        assert r["stale_deletes"] == 50
+        c = sched.compact_resident(job.id, mode="full", score=True)
+        assert c["mode"] == "full" and c["compactions"] == 1
+        surv = np.concatenate(list(dl.filter_tombstones([e], e[:50])))
+        be = get_backend("tpu", chunk_edges=512)
+        clean = be.partition(EdgeStream.from_array(surv, n_vertices=N),
+                             4, comm_volume=False)
+        assert c["results"][0]["edge_cut"] == clean.edge_cut
+        assert np.array_equal(job.results[0].assignment,
+                              clean.assignment)
+    finally:
+        sched.shutdown()
+        t.join(timeout=60)
+
+
+def test_served_update_rejects_non_resident_and_unknown(tmp_path):
+    from sheep_tpu.server import protocol
+
+    e = _graph(500)
+    base = _base_file(tmp_path, e)
+    sched, t = _run_scheduler()
+    try:
+        job = sched.submit(_spec(base, resident=False))
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        with pytest.raises(protocol.ProtocolError,
+                           match="not submitted resident"):
+            sched.update(job.id, adds=e[:4], epoch=1)
+        with pytest.raises(protocol.ProtocolError, match="unknown"):
+            sched.epoch_info("j999")
+    finally:
+        sched.shutdown()
+        t.join(timeout=60)
+
+
+def test_resident_reservation_charges_admission(tmp_path):
+    """A held resident partition keeps its modeled bytes reserved, so
+    headroom-short jobs queue behind it and releasing it admits them
+    (the membudget charge of ISSUE 15 (c))."""
+    e = _graph(1000)
+    base = _base_file(tmp_path, e)
+    sched, t = _run_scheduler()
+    try:
+        job = sched.submit(_spec(base))
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        with sched._lock:
+            reserved = sched._reserved_locked()
+        assert reserved == (job.modeled_bytes or 0)
+        # shrink the budget so the next identical job cannot fit
+        # beside the resident reservation: it must QUEUE
+        if job.modeled_bytes:
+            sched.budget = int(job.modeled_bytes * 1.5)
+            j2 = sched.submit(_spec(base, resident=False))
+            import time as _t
+
+            _t.sleep(0.3)
+            assert sched.get(j2.id).state == "queued"
+            sched.cancel(job.id)  # release the residency
+            assert sched.wait(j2.id, timeout_s=120).state == "done"
+    finally:
+        sched.shutdown()
+        t.join(timeout=60)
+
+
+def test_protocol_edge_codec_round_trip():
+    from sheep_tpu.server import protocol
+
+    e = _graph(123)
+    doc = protocol.encode_edges(e)
+    back = protocol.decode_edges(doc)
+    assert np.array_equal(back, e)
+    assert protocol.decode_edges(None).shape == (0, 2)
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_edges({"nope": 1})
+    bad = dict(doc)
+    bad["m"] = 7
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_edges(bad)
+
+
+def test_jobspec_resident_field():
+    from sheep_tpu.server.protocol import JobSpec
+
+    spec = JobSpec.from_request({"input": "x", "k": 4,
+                                 "resident": True})
+    assert spec.resident is True
+    assert JobSpec.from_request({"input": "x", "k": 4}).resident \
+        is False

@@ -507,3 +507,88 @@ def test_client_failover_rides_daemon_bounce(tmp_path):
         assert not t2.is_alive()
     finally:
         c.close()
+
+
+# ----------------------------------------------------------------------
+# resident partitions (ISSUE 15): kill + resume mid-delta-epoch
+# ----------------------------------------------------------------------
+def test_resident_partition_kill_resumes_at_journaled_epoch(tmp_path):
+    """THE mid-delta-epoch drill: a durable daemon holding a resident
+    partition dies between epochs 1 and 2; the restarted scheduler
+    must resume the partition at its journaled epoch (idempotent
+    replays of epoch 1 are no-ops), absorb epoch 2, and land
+    BIT-IDENTICAL to an uninterrupted replay of the same log."""
+    from sheep_tpu.io import deltalog as dl
+    from sheep_tpu.io.edgestream import open_input
+
+    jp, ck = durable_paths(tmp_path)
+    rng = np.random.default_rng(21)
+    n = 512
+    E = rng.integers(0, n, (3000, 2)).astype(np.int64)
+    base = str(tmp_path / "base.bin64")
+    with open(base, "wb") as f:
+        f.write(E[:1500].astype("<u8").tobytes())
+    sp = spec(input=base, ks=(4,), chunk_edges=CHUNK,
+              num_vertices=n, resident=True)
+
+    with running_scheduler(journal=jp, checkpoint_dir=ck,
+                           checkpoint_every=1) as sched:
+        job = sched.submit(sp)
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        jid = job.id
+        r1 = sched.update(jid, adds=E[1500:2200], epoch=1)
+        assert r1["applied"] and r1["epoch"] == 1
+        # the epoch is journaled (fsync'd AFTER the state snapshot)
+        recs = [json.loads(ln) for ln in open(jp)]
+        assert any(r.get("rec") == "delta_epoch"
+                   and r.get("epoch") == 1 for r in recs)
+    # <- the daemon is gone here, mid-way through the delta stream
+
+    with running_scheduler(journal=jp, checkpoint_dir=ck,
+                           checkpoint_every=1) as sched2:
+        info = sched2.epoch_info(jid)
+        assert info["epoch"] == 1  # resumed at the journaled epoch
+        # an idempotent client replay of epoch 1 must be a no-op
+        assert sched2.update(jid, adds=E[1500:2200],
+                             epoch=1)["applied"] is False
+        r2 = sched2.update(jid, adds=E[2200:], epoch=2, score=True)
+        assert r2["epoch"] == 2
+        resumed_assign = sched2.get(jid).results[0].assignment.copy()
+
+    # the uninterrupted reference: the one-shot build of the same log
+    log = str(tmp_path / "ref.dlog")
+    with dl.DeltaLogWriter(log, base_spec=base) as w:
+        w.append(E[1500:2200])
+        w.append(E[2200:])
+    from sheep_tpu.backends.base import get_backend
+
+    one = get_backend("tpu", chunk_edges=CHUNK).partition(
+        open_input(f"delta:{log}", n_vertices=n), 4,
+        comm_volume=False)
+    np.testing.assert_array_equal(resumed_assign, one.assignment)
+
+
+def test_resident_release_survives_replay(tmp_path):
+    """A released residency must stay released across restart (the
+    journal's resident_release record): its reservation never comes
+    back and updates are refused."""
+    jp, ck = durable_paths(tmp_path)
+    n = 512
+    E = np.random.default_rng(22).integers(0, n, (1000, 2))
+    base = str(tmp_path / "b.bin64")
+    with open(base, "wb") as f:
+        f.write(E.astype("<u8").tobytes())
+    sp = spec(input=base, ks=(4,), num_vertices=n, resident=True)
+    with running_scheduler(journal=jp, checkpoint_dir=ck) as sched:
+        job = sched.submit(sp)
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        jid = job.id
+        assert sched.stats()["resident_partitions"] == 1
+        sched.cancel(jid)  # release
+        assert sched.stats()["resident_partitions"] == 0
+    with running_scheduler(journal=jp, checkpoint_dir=ck) as sched2:
+        assert sched2.stats()["resident_partitions"] == 0
+        from sheep_tpu.server.protocol import ProtocolError
+
+        with pytest.raises(ProtocolError, match="released"):
+            sched2.epoch_info(jid)

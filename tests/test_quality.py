@@ -490,3 +490,19 @@ def test_trace_report_quality_tree(tmp_path):
     assert t["quality_ledgers"] and t["refine_rounds"]
     assert sum(lv["cut"] for lv in t["quality_ledgers"][0]["levels"]) \
         == t["quality_ledgers"][0]["edge_cut"]
+
+
+def test_quality_dynamic_scenario_artifact():
+    """ISSUE 15 satellite: the committed QUALITY_r02.json carries the
+    dynamic-graph scenario (half-stream + delta epochs through the
+    REAL incremental path) inside its anchored-drift bound, and
+    extends QUALITY_r01.json bit-identically on the shared rows."""
+    doc = json.load(open(os.path.join(REPO, "QUALITY_r02.json")))
+    sc = doc["scenarios"]["dynamic_sbm"]
+    assert "oneshot_cut_ratio" in sc and "anchored_drift" in sc
+    assert sc["epoch"] == sc["recipe"]["dynamic"]["epochs"]
+    assert sc["anchored_drift"] <= sc["recipe"]["dynamic"]["bound"]
+    assert "bound_exceeded" not in sc
+    r01 = json.load(open(os.path.join(REPO, "QUALITY_r01.json")))
+    for name, row in r01["scenarios"].items():
+        assert doc["scenarios"][name] == row, name

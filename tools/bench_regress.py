@@ -15,6 +15,8 @@ each compared only when present in BOTH captures:
                                       beyond --threshold regresses)
     host_syncs, device_rounds,        lower is better (relative rise
     host_blocked_ms, h2d_blocked_ms,  beyond --threshold regresses —
+    update_request_s,                 the resident-partition delta-fold
+                                      wall (ISSUE 15);
     warm_up_s, warm_request_s,        warm_up_s is the cold-request jit
                                       tax and warm_request_s the warm
                                       served-request wall — the pair
@@ -81,9 +83,14 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # holds it near 0, and the old==0 absolute rule below gates any
 # reappearance. On the timed leg's device-stream input it is exactly 0
 # (zero host bytes per chunk).
+# update_request_s (ISSUE 15) is the resident-partition delta-fold
+# wall — the O(Δ) promise of the incremental subsystem, gated with
+# the warm_request_s convention (a rise is the update path slowing);
+# its companion `compactions` count is info-only below (compactions
+# are workload consequences, not regressions).
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
                 "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
-                "warm_request_s")
+                "warm_request_s", "update_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory
@@ -95,7 +102,7 @@ INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
              "degraded_dispatch_batch", "degraded_inflight",
              "degraded_h2d_ring",
              "device_loss_recoveries", "checkpoint_degraded",
-             "cold_request_s")
+             "cold_request_s", "compactions")
 
 
 def load_capture(path: str):

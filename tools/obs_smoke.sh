@@ -410,7 +410,7 @@ grep -q -- "--k-levels 4,4" "$OUT/advisor.err"
 QUAL9="$OUT/QUALITY_fresh.json"
 JAX_PLATFORMS=cpu python tools/quality_regress.py --run "$QUAL9" \
     2> "$OUT/quality_sweep.err"
-python tools/quality_regress.py "$QUAL9" QUALITY_r01.json \
+python tools/quality_regress.py "$QUAL9" QUALITY_r02.json \
     > "$OUT/quality_gate.txt"
 grep -q "verdict: PASS" "$OUT/quality_gate.txt"
 
@@ -499,7 +499,125 @@ grep -q '"event": "resume"' "$TRACE10"        # the checkpoint resume seam
 grep -q '"event": "job_recovered"' "$TRACE10" # the journal replay seam
 grep -q "resume:" "$OUT/report_durable.txt"
 
+# eleventh leg: incremental repartitioning served end-to-end (ISSUE
+# 15) — a resident partition built through the real CLI/clients, two
+# delta epochs streamed at it with the `sheep update` verb, a
+# compaction, a kill -9 + restart on the same state dir: the resident
+# partition must resume at its journaled epoch, sheep_updates_total /
+# sheep_update_latency_seconds must join the /metrics catalog, the
+# scored update must bit-match the one-shot delta: build, and --check
+# must stay green across the appended daemon runs.
+TRACE11="$OUT/trace_incremental.jsonl"
+SOCK11="$OUT/sheepd_inc.sock"
+STATE11="$OUT/sheepd_inc_state"
+rm -f "$TRACE11" "$SOCK11"
+rm -rf "$STATE11"
+JAX_PLATFORMS=cpu python - "$OUT" <<'PYEOF'
+import os
+import sys
+
+import numpy as np
+
+from sheep_tpu.io import deltalog as dl
+
+out = sys.argv[1]
+rng = np.random.default_rng(11)
+E = rng.integers(0, 512, (6000, 2))
+base = os.path.join(out, "inc_base.bin64")
+with open(base, "wb") as f:
+    f.write(E[:3000].astype("<u8").tobytes())
+with dl.DeltaLogWriter(os.path.join(out, "inc.dlog"),
+                       base_spec=base) as w:
+    w.append(E[3000:4500])
+    w.append(E[4500:])
+PYEOF
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK11" --trace "$TRACE11" --heartbeat-secs 0.2 \
+    --state-dir "$STATE11" --checkpoint-every 4 --metrics-port 0 \
+    2> "$OUT/sheepd_inc.err" &
+SHEEPD11_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do [ -S "$SOCK11" ] && break; sleep 0.2; done
+[ -S "$SOCK11" ] || { echo "inc sheepd never bound $SOCK11" >&2; exit 1; }
+JID11=$(JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK11" --input "$OUT/inc_base.bin64" --k 4 \
+    --num-vertices 512 --chunk-edges 512 --tenant inc --resident --wait \
+    | python -c "import json,sys; print(json.load(sys.stdin)['job_id'])")
+# stream the log's two epochs at the resident partition (the real
+# `sheep update` CLI verb), scoring the final state
+JAX_PLATFORMS=cpu python -m sheep_tpu.cli update "$JID11" \
+    --server "$SOCK11" --deltas "$OUT/inc.dlog" --score \
+    > "$OUT/inc_update.json"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.client --server "$SOCK11" \
+    --compact "$JID11" > "$OUT/inc_compact.json"
+# metrics BEFORE the kill (counters are per-process):
+# sheep_updates_total and the latency series must be in the catalog
+JAX_PLATFORMS=cpu python - "$OUT/sheepd_inc.err" <<'PYEOF'
+import re
+import sys
+import urllib.request
+
+from sheep_tpu.obs.metrics import parse_prometheus
+
+ports = re.findall(r"metrics on http://[^:]+:(\d+)",
+                   open(sys.argv[1]).read())
+url = f"http://127.0.0.1:{ports[-1]}/metrics"
+text = urllib.request.urlopen(url, timeout=10).read().decode()
+m = parse_prometheus(text)
+updates = sum(v for _, v in m.get("sheep_updates_total", []))
+assert updates >= 2, m.get("sheep_updates_total")
+assert "sheep_update_latency_seconds_bucket" in text, \
+    "update latency histogram missing from /metrics"
+assert sum(v for _, v in m.get("sheepd_resident_partitions", [])) >= 1
+PYEOF
+kill -9 "$SHEEPD11_PID"
+wait "$SHEEPD11_PID" 2>/dev/null || true
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK11" --trace "$TRACE11" --heartbeat-secs 0.2 \
+    --state-dir "$STATE11" --checkpoint-every 4 --metrics-port 0 \
+    2>> "$OUT/sheepd_inc.err" &
+SHEEPD11_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID 2>/dev/null || true' EXIT
+# the resident partition resumes at its journaled epoch (2) across
+# the SIGKILL, and the scored update bit-matches the one-shot build
+# of the same delta: input through the plain CLI
+JAX_PLATFORMS=cpu python - "$SOCK11" "$JID11" "$OUT" \
+    > "$OUT/inc_resume.json" <<'PYEOF'
+import json
+import os
+import subprocess
+import sys
+
+from sheep_tpu.server.client import SheepClient
+
+sock, jid, out = sys.argv[1], sys.argv[2], sys.argv[3]
+with SheepClient(sock, reconnect=40, reconnect_base_s=0.3) as c:
+    ep = c.epoch(jid)
+    assert ep["epoch"] == 2, ep
+    upd = json.load(open(os.path.join(out, "inc_update.json")))
+    assert upd["epoch"] == 2 and upd["applied"], upd
+    served_cut = upd["results"][0]["edge_cut"]
+    one = subprocess.run(
+        [sys.executable, "-m", "sheep_tpu.cli", "--input",
+         f"delta:{os.path.join(out, 'inc.dlog')}", "--k", "4",
+         "--num-vertices", "512", "--chunk-edges", "512", "--json"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert one.returncode == 0, one.stderr[-800:]
+    oneshot = json.loads(one.stdout.strip().splitlines()[-1])
+    assert served_cut == oneshot["edge_cut"], (served_cut, oneshot)
+    print(json.dumps({"epoch": ep["epoch"],
+                      "served_cut": served_cut,
+                      "oneshot_cut": oneshot["edge_cut"]}))
+    c.shutdown()
+PYEOF
+wait "$SHEEPD11_PID"
+python tools/trace_report.py "$TRACE11" --check \
+    > "$OUT/report_incremental.txt"
+grep -q '"event": "delta_epoch_applied"' "$TRACE11"
+grep -q '"event": "resident_resumed"' "$TRACE11"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11"

@@ -66,7 +66,59 @@ SCENARIOS = (
     {"name": "near_clique", "spec": "nearclique-hash:11:4:0.01:16:1",
      "k_levels": [4, 2], "refine": 0, "final_refine": 2,
      "balance": 1.1},
+    # dynamic-graph scenario (ISSUE 15): half the shuffled stream
+    # builds the base, the other half arrives as delta epochs through
+    # the incremental path (sheep_tpu/incremental.py). cut_ratio is
+    # the INCREMENTAL result's — gated against the committed artifact
+    # like every row — and the run itself enforces the anchored-order
+    # drift bound against the fresh-order one-shot build of the same
+    # edges (cut_ratio <= oneshot + bound, else the sweep exits 2).
+    {"name": "dynamic_sbm", "spec": "sbm-hash:10:16:0.05:16:2",
+     "k": 16, "dynamic": {"epochs": 2, "bound": 0.05, "seed": 7}},
 )
+
+
+def run_dynamic_scenario(sc: dict, backend: str) -> dict:
+    """Half-stream + deltas through the REAL incremental path; the
+    one-shot build of the identical multiset rides along as the drift
+    reference."""
+    import numpy as np
+
+    from sheep_tpu import incremental
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io.edgestream import EdgeStream, open_input
+
+    dyn = sc["dynamic"]
+    with open_input(sc["spec"]) as es:
+        edges = es.read_all()
+        n = int(es.num_vertices)
+    rng = np.random.default_rng(int(dyn.get("seed", 7)))
+    e = edges[rng.permutation(len(edges))]
+    half = len(e) // 2
+    be = get_backend(backend)
+    state, _ = incremental.begin_incremental(
+        EdgeStream.from_array(e[:half], n_vertices=n), sc["k"],
+        backend=be, comm_volume=False)
+    res = None
+    for batch in np.array_split(e[half:], int(dyn.get("epochs", 2))):
+        res = be.partition_update(state, adds=batch, score=True)
+    oneshot = be.partition(EdgeStream.from_array(e, n_vertices=n),
+                           sc["k"], comm_volume=False)
+    row = {"spec": sc["spec"], "recipe": {"k": sc["k"],
+                                          "dynamic": dict(dyn)},
+           "k": int(res.k),
+           "cut_ratio": round(float(res.cut_ratio), 6),
+           "edge_cut": int(res.edge_cut),
+           "total_edges": int(res.total_edges),
+           "balance": round(float(res.balance), 4),
+           "oneshot_cut_ratio": round(float(oneshot.cut_ratio), 6),
+           "epoch": int(state.epoch)}
+    bound = float(dyn.get("bound", 0.05))
+    drift = float(res.cut_ratio) - float(oneshot.cut_ratio)
+    row["anchored_drift"] = round(drift, 6)
+    if drift > bound:
+        row["bound_exceeded"] = True
+    return row
 
 
 def run_scenario(sc: dict, backend: str) -> dict:
@@ -76,6 +128,8 @@ def run_scenario(sc: dict, backend: str) -> dict:
     from sheep_tpu.io.edgestream import open_input
     from sheep_tpu.utils.metrics import ledger_residual
 
+    if "dynamic" in sc:
+        return run_dynamic_scenario(sc, backend)
     recipe = {k: sc[k] for k in ("k", "k_levels", "refine",
                                  "final_refine", "balance") if k in sc}
     if "k_levels" in sc:
@@ -128,6 +182,12 @@ def run_sweep(out_path: str, names=None, backend: str = None) -> dict:
               f"balance {row['balance']:.3f}"
               + (f"  planted {row['planted']:.4f}"
                  if "planted" in row else ""), file=sys.stderr)
+    exceeded = sorted(name for name, row in doc["scenarios"].items()
+                      if row.get("bound_exceeded"))
+    if exceeded:
+        doc["bound_exceeded"] = exceeded
+        print(f"BOUND EXCEEDED in: {', '.join(exceeded)} (anchored "
+              f"drift past the scenario bound)", file=sys.stderr)
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -225,8 +285,8 @@ def main(argv=None) -> int:
 
         pin_platform(os.environ.get("SHEEP_QUALITY_PLATFORM") or "cpu")
         names = set(args.scenarios.split(",")) if args.scenarios else None
-        run_sweep(args.run, names=names, backend=args.backend)
-        return 0
+        doc = run_sweep(args.run, names=names, backend=args.backend)
+        return 2 if doc.get("bound_exceeded") else 0
 
     if (args.new is None) != (args.old is None):
         ap.error("pass both NEW and OLD, or neither (auto-discovery)")
