@@ -77,6 +77,41 @@ class _ChunkCache:
         self.complete = False
 
 
+class _ChunkCacheReader:
+    """Read-only view of a :class:`_ChunkCache` held by another job
+    (ISSUE 16): serves the filler's cached prefix but never appends.
+    A budget of -1 makes :func:`_device_chunks`' grow test false on
+    the first chunk, so the prefix-fill invariant keeps exactly one
+    writer while any number of interleaved jobs read — the daemon's
+    dispatch thread serializes all access, so no further locking is
+    needed. A reader that outruns the filler simply streams the rest
+    itself (same chunks, no sharing benefit past the prefix)."""
+
+    budget = -1
+
+    def __init__(self, cache: "_ChunkCache"):
+        self._cache = cache
+
+    @property
+    def chunks(self):
+        return self._cache.chunks
+
+    @property
+    def used(self):
+        return self._cache.used
+
+    @property
+    def complete(self):
+        return self._cache.complete
+
+    @complete.setter
+    def complete(self, value):
+        # unreachable via _device_chunks (a reader's grow flag drops
+        # on the first chunk); forwarded rather than raising so a
+        # future caller setting it stays benign
+        self._cache.complete = value
+
+
 def _upload_chunks(stream, cs: int, n: int, start_chunk: int,
                    ring: int = 1, stats=None):
     """Padded (cs, 2) int32 DEVICE chunks from ``start_chunk`` on.

@@ -56,6 +56,24 @@ digest) and therefore retried too. ``sheep-submit`` exposes this as
 mid-watch keeps the progress lines flowing instead of dying with a
 connection error — the exit-code contract is unchanged.
 
+Fleet mode (ISSUE 16): ``--endpoints a.sock,b.sock`` replaces
+``--server`` with a comma list of replica addresses and routes the
+submit through :class:`FleetClient` — a result-cache ``lookup`` of
+the spec digest on every live replica first (a hit is answered with
+zero build steps, so it short-circuits routing), then the replica
+with the shallowest queue / largest admission headroom (scraped from
+the live metrics gauges). A replica that dies while the job is being
+waited on gets the job re-submitted — ``reattach``-idempotent — to
+the next live replica; per-replica route counters land in the obs
+trace as ``fleet_route`` events. Fleet mode covers the submit family
+(``--wait`` / ``--watch`` included); admin verbs still address one
+replica via ``--server``.
+
+CLI (fleet)::
+
+    sheep-submit --endpoints /run/a.sock,/run/b.sock \\
+        --input g.edges --k 64 --wait
+
 Exit codes: 0 op succeeded (for --wait/--watch: job DONE), 1 usage/
 transport, 2 daemon answered ok=false, 3 job reached a non-done
 terminal state (failed / cancelled / deadline_exceeded / rejected),
@@ -244,6 +262,14 @@ class SheepClient:
         as HTTP GET /metrics on --metrics-port)."""
         return self.request({"op": "metrics"})["text"]
 
+    def lookup(self, digest: str) -> bool:
+        """Advisory result-cache probe (ISSUE 16): True when the
+        daemon can answer a submit with this spec digest straight
+        from its result store — zero build steps, zero compiles. See
+        :func:`fleet_digest` for computing the digest client-side."""
+        return bool(self.request({"op": "lookup",
+                                  "digest": digest})["hit"])
+
     # -- resident-partition verbs (ISSUE 15) ---------------------------
     def update(self, job_id: str, adds=None, dels=None,
                epoch: Optional[int] = None, score: bool = False,
@@ -305,12 +331,286 @@ class ServerError(RuntimeError):
     """The daemon answered ok=false (or went away mid-request)."""
 
 
+def fleet_digest(input: str, k, tenant: str = "default",
+                 **job_fields) -> str:
+    """The spec digest a daemon would journal for this submit,
+    computed CLIENT-side through the same ``JobSpec.from_request`` +
+    ``journal.job_digest`` pair the daemon runs (the digest folds in
+    the input file's size/mtime via os.stat, so it matches when
+    client and daemons see the same filesystem — the unix-socket
+    fleet shape). This is the result-cache / reattach key: any
+    replica holding it answers the submit without building."""
+    from sheep_tpu.server import journal as journal_mod
+
+    job = {"input": input, "k": k, **job_fields}
+    spec = protocol.JobSpec.from_request(job, tenant=tenant)
+    return journal_mod.job_digest(spec)
+
+
+class FleetClient:
+    """Routes submits across a fleet of sheepd replicas (ISSUE 16).
+
+    Per submit, in order:
+
+    1. digest short-circuit — every live replica answers ``lookup``
+       for the spec digest; a result-cache hit routes the submit
+       straight there (it completes with zero build steps);
+    2. headroom routing — otherwise the submit goes to the replica
+       with the least load, ordered by queued+active jobs then by
+       largest admission headroom, both scraped from the live
+       metrics gauges (``sheepd_queue_depth`` +
+       ``sheepd_active_jobs``, ``sheepd_headroom_bytes``);
+    3. failover — a replica that dies while one of its jobs is being
+       waited on (or status-polled) gets that job re-submitted to
+       the next live replica. Failover resubmits carry
+       ``reattach=True`` (a bounced-but-journaled daemon reattaches
+       instead of double-building); FIRST submits are plain, so a
+       repeat request reaches the result store instead of
+       reattaching to a retained terminal twin.
+
+    ``route_counts`` tallies submits per endpoint; every routing
+    decision also lands in the obs trace as a ``fleet_route`` event
+    with the running counters. ``reconnect`` is the per-endpoint
+    transport retry budget (as :class:`SheepClient`); the default 0
+    fails fast into the failover path, which is usually what a fleet
+    wants — a *dead* replica should not be backed off against when a
+    live one can take the job.
+    """
+
+    def __init__(self, endpoints, timeout_s: float = 600.0,
+                 reconnect: int = 0, reconnect_base_s: float = 0.2):
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        eps = [str(e).strip() for e in endpoints if str(e).strip()]
+        if not eps:
+            raise ValueError("FleetClient needs at least one endpoint")
+        self.endpoints = eps
+        self.timeout_s = float(timeout_s)
+        self.reconnect = int(reconnect)
+        self._reconnect_base_s = float(reconnect_base_s)
+        self._clients: dict = {}
+        self.route_counts = {ep: 0 for ep in eps}
+        # (endpoint, job_id) -> (input, k, tenant, job_fields) — what
+        # failover needs to re-place the job on a surviving replica.
+        # Keyed by BOTH because daemon job ids are per-process
+        # counters: two replicas routinely mint the same "j1".
+        self._jobs: dict = {}
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def _client(self, ep: str) -> SheepClient:
+        c = self._clients.get(ep)
+        if c is None:
+            c = SheepClient(ep, timeout_s=self.timeout_s,
+                            reconnect=self.reconnect,
+                            reconnect_base_s=self._reconnect_base_s)
+            self._clients[ep] = c
+        return c
+
+    def _down(self, ep: str) -> bool:
+        """Distinguish a dead replica from a daemon that answered an
+        error: a live one still pings."""
+        try:
+            self._client(ep).ping()
+            return False
+        except (ServerError, OSError, json.JSONDecodeError):
+            return True
+
+    def _lookup_round(self, digest: str):
+        """One lookup sweep: (live_endpoints, first_hit_endpoint)."""
+        live, hit = [], None
+        for ep in self.endpoints:
+            try:
+                r = self._client(ep).request({"op": "lookup",
+                                              "digest": digest})
+                live.append(ep)
+                if hit is None and r.get("hit"):
+                    hit = ep
+            except ServerError:
+                # the daemon answered (maybe a pre-fleet one without
+                # the lookup verb): live, treated as a miss
+                live.append(ep)
+            except (OSError, json.JSONDecodeError):
+                pass
+        return live, hit
+
+    def _load(self, ep: str):
+        """(queued+active, -headroom) load key; None if unreachable."""
+        try:
+            text = self._client(ep).metrics()
+        except (ServerError, OSError, json.JSONDecodeError):
+            return None
+        from sheep_tpu.obs.metrics import parse_prometheus
+
+        gauges = parse_prometheus(text)
+
+        def one(name, default):
+            rows = gauges.get(name) or []
+            return float(rows[0][1]) if rows else default
+
+        depth = one("sheepd_queue_depth", 0.0) \
+            + one("sheepd_active_jobs", 0.0)
+        headroom = one("sheepd_headroom_bytes", float("inf"))
+        return (depth, -headroom)
+
+    def _route(self, live):
+        scored = []
+        for i, ep in enumerate(live):
+            load = self._load(ep)
+            if load is not None:
+                scored.append((load, i, ep))
+        if not scored:
+            return live[0] if live else None
+        scored.sort()
+        return scored[0][2]
+
+    def _submit_to(self, ep: str, why: str, digest: str, input: str,
+                   k, tenant: str, job_fields: dict,
+                   reattach: bool = False) -> dict:
+        from sheep_tpu import obs
+
+        resp = self._client(ep).submit(input, k=k, tenant=tenant,
+                                       reattach=reattach, **job_fields)
+        self.route_counts[ep] = self.route_counts.get(ep, 0) + 1
+        jid = resp.get("job_id")
+        if jid:
+            self._jobs[(ep, jid)] = (input, k, tenant,
+                                     dict(job_fields))
+        obs.event("fleet_route", endpoint=ep, why=why, digest=digest,
+                  job_id=jid, counts=dict(self.route_counts))
+        resp["endpoint"] = ep
+        return resp
+
+    def submit(self, input: str, k, tenant: str = "default",
+               reattach: bool = False, **job_fields) -> dict:
+        """Route one submit per the class policy. ``reattach`` is
+        accepted for :class:`SheepClient` signature compatibility but
+        ignored: first submits are plain (a repeat digest must reach
+        the result store, not reattach to a retained terminal twin);
+        failover resubmission adds ``reattach=True`` itself."""
+        del reattach
+        digest = fleet_digest(input, k, tenant=tenant, **job_fields)
+        tried: set = set()
+        while True:
+            live, hit = self._lookup_round(digest)
+            live = [e for e in live if e not in tried]
+            if hit is not None and hit not in tried:
+                ep, why = hit, "cache_hit"
+            else:
+                ep, why = self._route(live), "headroom"
+            if ep is None:
+                raise ServerError("no live endpoint among "
+                                  + ",".join(self.endpoints))
+            try:
+                return self._submit_to(ep, why, digest, input, k,
+                                       tenant, dict(job_fields))
+            except (OSError, json.JSONDecodeError):
+                # died between lookup and submit: strike it, reroute
+                tried.add(ep)
+
+    def _resolve(self, job):
+        """(endpoint, job_id) key for a job handle.
+
+        The handle is either a submit/status DESCRIPTOR (preferred —
+        its ``endpoint`` + ``job_id`` pin the replica) or a bare job
+        id, honored only while unambiguous: daemon job ids are
+        per-process counters, so two replicas routinely mint the same
+        ``j1``, and guessing between them could answer a wait with a
+        DIFFERENT tenant's job."""
+        if isinstance(job, dict):
+            ep, jid = job.get("endpoint"), job.get("job_id")
+            if ep is not None and (ep, jid) in self._jobs:
+                return ep, jid
+            job = jid
+        matches = [key for key in self._jobs if key[1] == job]
+        if len(matches) == 1:
+            return matches[0]
+        if not matches:
+            raise ServerError(f"unknown fleet job {job}")
+        raise ServerError(
+            f"job id {job} is ambiguous across replicas "
+            f"({', '.join(ep for ep, _ in matches)}) — pass the "
+            f"submit descriptor (it carries the endpoint) instead "
+            f"of the bare id")
+
+    def _failover(self, key, exc) -> dict:
+        """The job's home replica is gone: re-place it on a survivor
+        (reattach-idempotent) and return the NEW descriptor."""
+        home, job_id = key
+        sub = self._jobs.get(key)
+        if sub is None:
+            raise exc
+        self._jobs.pop(key, None)
+        input, k, tenant, job_fields = sub
+        digest = fleet_digest(input, k, tenant=tenant, **job_fields)
+        for ep in self.endpoints:
+            if ep == home or self._down(ep):
+                continue
+            try:
+                return self._submit_to(ep, "failover", digest, input,
+                                       k, tenant, job_fields,
+                                       reattach=True)
+            except (ServerError, OSError, json.JSONDecodeError):
+                continue
+        raise ServerError(
+            f"job {job_id}: home replica {home} died and no live "
+            f"replica accepted the failover resubmit") from exc
+
+    def status(self, job) -> dict:
+        """Job descriptor, following failover: when the home replica
+        died the job is re-placed and the returned descriptor carries
+        the NEW job_id/endpoint — poll loops should track the
+        descriptor, not the bare id."""
+        while True:
+            ep, jid = self._resolve(job)
+            try:
+                return self._client(ep).status(jid)
+            except (ServerError, OSError,
+                    json.JSONDecodeError) as e:
+                if isinstance(e, ServerError) and not self._down(ep):
+                    raise
+                job = self._failover((ep, jid), e)
+
+    def wait(self, job, timeout_s: Optional[float] = None) -> dict:
+        """Block until terminal, following failover like
+        :meth:`status` (the returned descriptor is authoritative)."""
+        while True:
+            ep, jid = self._resolve(job)
+            try:
+                return self._client(ep).wait(jid, timeout_s)
+            except (ServerError, OSError,
+                    json.JSONDecodeError) as e:
+                if isinstance(e, ServerError) and not self._down(ep):
+                    raise
+                job = self._failover((ep, jid), e)
+
+    def result_assignment(self, job: dict, k: Optional[int] = None):
+        return SheepClient.result_assignment(self, job, k)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="sheep-submit",
         description="submit partition jobs to a running sheepd")
-    p.add_argument("--server", required=True,
+    p.add_argument("--server",
                    help="daemon address: unix socket path or host:port")
+    p.add_argument("--endpoints", metavar="A,B,...", default=None,
+                   help="fleet mode: comma list of replica addresses; "
+                        "submits route to a result-cache digest hit "
+                        "first, else the least-loaded replica, with "
+                        "failover resubmission if a replica dies "
+                        "(submit family only — admin verbs use "
+                        "--server)")
     p.add_argument("--input", help="graph path or synthetic spec "
                                    "(as the main CLI's --input)")
     p.add_argument("--k", help="part count, or comma list for multi-k "
@@ -322,6 +622,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="staged H2D ring depth for host-format inputs "
                         "(0 = auto; device-generated specs skip "
                         "staging)")
+    p.add_argument("--inflight", type=int, default=None,
+                   help="in-job dispatch pipeline depth: confirmed "
+                        "executions in flight per engine step (0 = "
+                        "auto: 1 on cpu, 2 on accelerators)")
     p.add_argument("--alpha", type=float, default=None)
     p.add_argument("--weights", choices=["unit", "degree"], default=None)
     p.add_argument("--comm-volume", action="store_true")
@@ -401,22 +705,28 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _watch_job(c: "SheepClient", job_id: str, poll_s: float,
+def _watch_job(c: "SheepClient", job, poll_s: float,
                timeout_s: Optional[float]) -> dict:
     """Poll status until terminal (or timeout), rendering one progress
-    line per change on stderr; returns the last descriptor. Daemon
-    bounces are absorbed below in ``request`` when the client was
-    built with ``reconnect`` (the --watch default): each poll retries
-    transports with backoff, so a restarting daemon shows up as a few
-    stderr retry notes and then the resumed job's progress — not a
-    dead watch."""
+    line per change on stderr; returns the last descriptor. ``job``
+    is a bare id (SheepClient) or the submit descriptor (FleetClient
+    — replica job ids collide, the descriptor pins the endpoint).
+    Daemon bounces are absorbed below in ``request`` when the client
+    was built with ``reconnect`` (the --watch default): each poll
+    retries transports with backoff, so a restarting daemon shows up
+    as a few stderr retry notes and then the resumed job's progress —
+    not a dead watch."""
     import time
 
     t0 = time.monotonic()
     deadline = None if timeout_s is None else t0 + timeout_s
     last_line = None
     while True:
-        desc = c.status(job_id)
+        desc = c.status(job)
+        # fleet failover re-places a job on a surviving replica under
+        # a NEW id; the descriptor's job_id is authoritative
+        job = desc.get("job_id") or job
+        job_id = job if isinstance(job, str) else job.get("job_id")
         state = desc.get("state")
         bits = [f"{time.monotonic() - t0:7.1f}s", job_id, state]
         if desc.get("phase"):
@@ -450,6 +760,11 @@ def main(argv=None) -> int:
         p.error("pass exactly one of --input (submit), --status, "
                 "--cancel, --stats, --ping, --metrics, --profile, "
                 "--update, --epoch-of, --compact, --shutdown")
+    if bool(args.server) == bool(args.endpoints):
+        p.error("pass exactly one of --server or --endpoints")
+    if args.endpoints and not args.input:
+        p.error("--endpoints (fleet mode) routes submits; point "
+                "--server at one replica for admin verbs")
     if args.update and not args.deltas:
         p.error("--update needs --deltas LOG")
     reconnect = args.reconnect if args.reconnect is not None \
@@ -457,7 +772,11 @@ def main(argv=None) -> int:
     if reconnect < 0:
         p.error("--reconnect must be >= 0")
     try:
-        with SheepClient(args.server, reconnect=reconnect) as c:
+        if args.endpoints:
+            client = FleetClient(args.endpoints, reconnect=reconnect)
+        else:
+            client = SheepClient(args.server, reconnect=reconnect)
+        with client as c:
             if args.ping:
                 print(json.dumps(c.ping()))
                 return 0
@@ -529,6 +848,7 @@ def main(argv=None) -> int:
             for field, val in (("chunk_edges", args.chunk_edges),
                                ("dispatch_batch", args.dispatch_batch),
                                ("h2d_ring", args.h2d_ring),
+                               ("inflight", args.inflight),
                                ("alpha", args.alpha),
                                ("weights", args.weights),
                                ("num_vertices", args.num_vertices),
@@ -548,11 +868,13 @@ def main(argv=None) -> int:
             if not (args.wait or args.watch):
                 print(json.dumps(resp))
                 return 0
+            # fleet handles are the full descriptor (replica job ids
+            # collide across daemons; the endpoint disambiguates)
+            handle = resp if args.endpoints else resp["job_id"]
             if args.watch:
-                desc = _watch_job(c, resp["job_id"], args.poll,
-                                  args.timeout)
+                desc = _watch_job(c, handle, args.poll, args.timeout)
             else:
-                desc = c.wait(resp["job_id"], timeout_s=args.timeout)
+                desc = c.wait(handle, timeout_s=args.timeout)
             print(json.dumps(desc))
             if desc.get("state") == "done":
                 return 0

@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "--checkpoint-dir); on startup the journal "
                         "replays, queued jobs re-admit and running "
                         "jobs RESUME from their checkpoints")
+    p.add_argument("--result-cache-bytes", type=int,
+                   default=256 << 20, metavar="N",
+                   help="with --state-dir: byte cap of the content-"
+                        "addressed result store (STATE_DIR/results) — "
+                        "repeat submits for an identical spec+input "
+                        "digest answer from it with zero build steps "
+                        "and zero recompiles; entries evict oldest-"
+                        "first (default 256 MiB; 0 disables)")
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="with --state-dir: per-job checkpoint root "
                         "(default STATE_DIR/ckpt)")
@@ -334,6 +342,16 @@ class Daemon:
             return {"ok": True,
                     "content_type": metrics_mod.CONTENT_TYPE,
                     "text": sched.render_metrics()}
+        if op == "lookup":
+            # fleet verb (ISSUE 16): does this replica's result store
+            # hold the digest? A multi-endpoint client probes every
+            # replica; any hit short-circuits headroom routing.
+            digest = req.get("digest")
+            if not digest or not isinstance(digest, str):
+                raise protocol.ProtocolError(
+                    "lookup needs a 'digest' string")
+            return {"ok": True, "digest": digest,
+                    "hit": bool(sched.lookup_digest(digest))}
         if op in ("update", "epoch", "compact"):
             # resident-partition verbs (ISSUE 15): executed on the
             # dispatch thread; this handler just parks on the answer
@@ -407,11 +425,21 @@ class Daemon:
         a = self.args
         journal_path = None
         ckpt_dir = a.checkpoint_dir
+        result_store = None
         if a.state_dir is not None:
             os.makedirs(a.state_dir, exist_ok=True)
             journal_path = os.path.join(a.state_dir, "journal.jsonl")
             if ckpt_dir is None:
                 ckpt_dir = os.path.join(a.state_dir, "ckpt")
+            if getattr(a, "result_cache_bytes", 0) > 0:
+                # fleet warm path (ISSUE 16): the content-addressed
+                # result store shares the durability root — entries
+                # publish only after the journal terminal lands
+                from sheep_tpu.server.resultstore import ResultStore
+
+                result_store = ResultStore(
+                    os.path.join(a.state_dir, "results"),
+                    max_bytes=a.result_cache_bytes)
         elif ckpt_dir is not None:
             raise SystemExit("sheepd: --checkpoint-dir needs "
                              "--state-dir (checkpoints cannot resume "
@@ -431,7 +459,8 @@ class Daemon:
                 budget_bytes=a.budget_bytes,
                 root_span_id=getattr(root_span, "id", None),
                 journal=journal_path, checkpoint_dir=ckpt_dir,
-                checkpoint_every=a.checkpoint_every)
+                checkpoint_every=a.checkpoint_every,
+                result_store=result_store)
             if tracer is not None and a.heartbeat_secs:
                 # started after the scheduler exists so each beat can
                 # sample its queue depth / active jobs: soak logs show

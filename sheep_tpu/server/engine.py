@@ -56,6 +56,7 @@ save lands, ``suspend_ready`` flips and the scheduler parks the job.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -66,7 +67,8 @@ from sheep_tpu import obs
 from sheep_tpu.backends.tpu_backend import (_device_chunk_groups,
                                             _device_chunks,
                                             resolve_dispatch_batch,
-                                            resolve_h2d_ring)
+                                            resolve_h2d_ring,
+                                            resolve_inflight)
 from sheep_tpu.io.devicestream import is_device_stream
 from sheep_tpu.io.edgestream import open_input
 from sheep_tpu.ops import degrees as degrees_ops
@@ -208,10 +210,15 @@ class JobEngine:
             # tpu backend's ring_model rule)
             self._dev_stream = is_device_stream(es)
             self.ring = resolve_h2d_ring(spec.h2d_ring)
+            # in-job pipeline depth (ISSUE 16): D issued executions'
+            # staging blocks live at once — resolved BEFORE the batch
+            # so auto sizing reserves them in the HBM model
+            depth = resolve_inflight(spec.inflight)
             self.batch = resolve_dispatch_batch(
-                spec.dispatch_batch, n, cs,
+                spec.dispatch_batch, n, cs, inflight=depth,
                 h2d_ring=0 if self._dev_stream else self.ring)
             stats["dispatch_batch"] = self.batch
+            stats["inflight_depth"] = depth
             job.n_vertices = n
 
             # ---- durable resume (ISSUE 14) --------------------------
@@ -323,12 +330,103 @@ class JobEngine:
                     P = jnp.full(n + 1, n, dtype=jnp.int32)
                     self._build_idx = 0
                 sentinel_chunk = None
+                # ---- in-job pipelined dispatch (ISSUE 16): compose
+                # the PR-3 depth-D pipeline into the served engine.
+                # Each fifo entry is one ISSUED but unconfirmed
+                # execution — (p_in, loB, hiB, gl, rounds_dev), with
+                # p_in the carried table BEFORE that fold
+                # (donate=False keeps it and the staged blocks valid).
+                # CONFIRMING pulls the rounds scalar — the only
+                # per-group host sync; deferring it depth-1 groups
+                # lets the host issue ahead of the device and lets
+                # interleaved jobs overlap H2D + compute instead of
+                # serializing every step on the dispatch thread. The
+                # confirmed table after entry i is entry i+1's p_in
+                # (the tip when nothing younger is in flight) — what
+                # checkpoints save, so a resume re-folds exactly the
+                # unconfirmed groups, bit-identically.
+                fifo: deque = deque()
+                issued_idx = self._build_idx
+
+                def fold_retrying(p, lo, hi):
+                    while True:
+                        try:
+                            # classify/budget/count/backoff on fault —
+                            # degrade THIS job, never the daemon;
+                            # donate=False keeps p/lo/hi valid for
+                            # the retry
+                            return elim_ops.fold_segments_batch(
+                                p, lo, hi, n,
+                                segment_rounds=spec.segment_rounds,
+                                stats=stats, donate=False)
+                        except Exception as exc:
+                            retry_mod.handle_build_fault(
+                                policy, exc, f"sheepd.{job.id}.build",
+                                stats,
+                                on_resource=self._on_resource,
+                                on_device_loss=self._on_device_loss)
+
+                def issue(group, gl):
+                    nonlocal P
+                    loB, hiB = elim_ops.orient_chunks_batch_pos(
+                        jnp.stack(group), pos, n)
+                    P2, rounds = fold_retrying(P, loB, hiB)
+                    fifo.append((P, loB, hiB, gl, rounds))
+                    P = P2
+
+                def confirm():
+                    # one confirmed execution. A fault surfacing at
+                    # the sync (an async failure materializing late)
+                    # re-drives every unconfirmed fold synchronously
+                    # from the oldest staged inputs — bit-identical:
+                    # the same folds in the same order into the same
+                    # confirmed table.
+                    nonlocal P, total_rounds
+                    p_in, loB, hiB, gl, rounds = fifo.popleft()
+                    try:
+                        r = int(rounds)
+                    except Exception as exc:
+                        retry_mod.handle_build_fault(
+                            policy, exc, f"sheepd.{job.id}.build",
+                            stats, on_resource=self._on_resource,
+                            on_device_loss=self._on_device_loss)
+                        pending = [(p_in, loB, hiB, gl)]
+                        pending += [(e[0], e[1], e[2], e[3])
+                                    for e in fifo]
+                        fifo.clear()
+                        P = pending[0][0]
+                        r, gl = 0, 0
+                        for _p, lo2, hi2, g2 in pending:
+                            P2, rr = fold_retrying(P, lo2, hi2)
+                            r += int(rr)
+                            P = P2
+                            gl += g2
+                    total_rounds += r
+                    prev_idx = self._build_idx
+                    self._build_idx += gl
+                    if self.ckpt is not None and (
+                            self.ckpt.due_span(prev_idx,
+                                               self._build_idx)
+                            or self._ckpt_request):
+                        # the pull IS the flush barrier: the confirmed
+                        # table (the next in-flight entry's input, or
+                        # the tip with an empty pipe) syncs only
+                        # confirmed work, so the saved table can never
+                        # over-represent build_idx (PR-3 semantics)
+                        p_conf = fifo[0][0] if fifo else P
+                        self._save(
+                            "build", self._build_idx,
+                            {"p": np.asarray(p_conf),  # sheeplint: sync-ok
+                             "deg": deg_host,
+                             "rounds": np.int64(total_rounds)},
+                            meta)
+
                 try:
                     while True:
                         batch = self.batch
                         ring = self.ring
                         groups = _device_chunk_groups(
-                            es, cs, n, self.cache, self._build_idx,
+                            es, cs, n, self.cache, issued_idx,
                             batch, ring, stats)
                         restage = False
                         try:
@@ -340,51 +438,10 @@ class JobEngine:
                                             (cs, 2), n, jnp.int32)
                                     group = group + [sentinel_chunk] * \
                                         (batch - gl)
-                                loB, hiB = \
-                                    elim_ops.orient_chunks_batch_pos(
-                                        jnp.stack(group), pos, n)
-                                while True:
-                                    try:
-                                        P2, rounds = \
-                                            elim_ops.fold_segments_batch(
-                                                P, loB, hiB, n,
-                                                segment_rounds=spec
-                                                .segment_rounds,
-                                                stats=stats,
-                                                donate=False)
-                                        break
-                                    except Exception as exc:
-                                        # classify/budget/count/backoff
-                                        # — degrade THIS job, never the
-                                        # daemon; donate=False keeps
-                                        # P/loB/hiB valid for the retry
-                                        retry_mod.handle_build_fault(
-                                            policy, exc,
-                                            f"sheepd.{job.id}.build",
-                                            stats,
-                                            on_resource=self
-                                            ._on_resource,
-                                            on_device_loss=self
-                                            ._on_device_loss)
-                                P = P2
-                                total_rounds += int(rounds)
-                                prev_idx = self._build_idx
-                                self._build_idx += gl
-                                if self.ckpt is not None and (
-                                        self.ckpt.due_span(
-                                            prev_idx, self._build_idx)
-                                        or self._ckpt_request):
-                                    # the pull IS the flush barrier:
-                                    # the saved table is confirmed,
-                                    # nothing queued can under-
-                                    # represent it (PR-3 semantics)
-                                    self._save(
-                                        "build", self._build_idx,
-                                        {"p": np.asarray(P),  # sheeplint: sync-ok
-                                         "deg": deg_host,
-                                         "rounds":
-                                             np.int64(total_rounds)},
-                                        meta)
+                                issue(group, gl)
+                                issued_idx += gl
+                                if len(fifo) >= depth:
+                                    confirm()
                                 stats_acc.absorb(stats)
                                 yield "build"
                                 if self.batch != batch \
@@ -392,13 +449,21 @@ class JobEngine:
                                     # degraded mid-stream: restage the
                                     # remainder at the new shape (and
                                     # the abandoned supplier's finally
-                                    # drains its staged ring blocks)
+                                    # drains its staged ring blocks);
+                                    # in-flight entries stay in the
+                                    # pipe and confirm on later steps
                                     restage = True
                                     break
                         finally:
                             groups.close()
                         if not restage:
                             break
+                    while fifo:
+                        # drain the pipe: a step stays one confirmed
+                        # execution, so the tail confirms one per yield
+                        confirm()
+                        stats_acc.absorb(stats)
+                        yield "build"
                 finally:
                     sp.end(rounds=int(total_rounds))
                 minp = P[pos]

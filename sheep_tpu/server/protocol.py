@@ -24,6 +24,14 @@ Requests (``op`` selects):
     {"op": "epoch",   "job_id": "j3"}
     {"op": "compact", "job_id": "j3", "mode": "auto", "score": false}
     {"op": "shutdown", "drain": false, "suspend": false}
+    {"op": "lookup", "digest": "<hex job digest>"}
+
+Fleet verbs (ISSUE 16): ``lookup`` asks whether this replica's
+content-addressed result store holds an entry for a job digest —
+``{"ok": true, "hit": true|false}`` — without submitting anything. A
+multi-endpoint client probes every replica with it first; a hit
+short-circuits headroom routing entirely (the repeat submit answers
+from the store with zero build steps and zero recompiles).
 
 Incremental verbs (ISSUE 15): a job submitted with ``"resident":
 true`` keeps its converged partition state resident after DONE —
@@ -115,7 +123,8 @@ JOB_STATES = (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
 TERMINAL_STATES = (DONE, FAILED, CANCELLED, DEADLINE_EXCEEDED, REJECTED)
 
 OPS = ("ping", "submit", "status", "wait", "cancel", "list", "stats",
-       "metrics", "profile", "update", "epoch", "compact", "shutdown")
+       "metrics", "profile", "update", "epoch", "compact", "shutdown",
+       "lookup")
 
 MAX_REQUEST_BYTES = 1 << 20  # one request line; jobs are specs, not data
 
@@ -135,6 +144,7 @@ class JobSpec:
     chunk_edges: int = 1 << 22
     dispatch_batch: int = 0        # 0 = auto (membudget-sized)
     h2d_ring: int = 0              # 0 = auto (staged H2D ring depth)
+    inflight: int = 0              # 0 = auto (in-job pipeline depth)
     segment_rounds: int = 2
     alpha: float = 1.0
     weights: str = "unit"
@@ -164,9 +174,9 @@ class JobSpec:
                                "non-empty list of them")
         ks = list(dict.fromkeys(ks))  # dupes would alias result rows
         known = {"input", "k", "ks", "chunk_edges", "dispatch_batch",
-                 "h2d_ring", "segment_rounds", "alpha", "weights",
-                 "comm_volume", "num_vertices", "deadline_s", "output",
-                 "return_assignment", "resident"}
+                 "h2d_ring", "inflight", "segment_rounds", "alpha",
+                 "weights", "comm_volume", "num_vertices", "deadline_s",
+                 "output", "return_assignment", "resident"}
         unknown = set(body) - known
         if unknown:
             raise ProtocolError(f"unknown job field(s): {sorted(unknown)}")
@@ -175,6 +185,7 @@ class JobSpec:
             chunk_edges=int(body.get("chunk_edges", 1 << 22)),
             dispatch_batch=int(body.get("dispatch_batch", 0)),
             h2d_ring=int(body.get("h2d_ring", 0)),
+            inflight=int(body.get("inflight", 0)),
             segment_rounds=int(body.get("segment_rounds", 2)),
             alpha=float(body.get("alpha", 1.0)),
             weights=str(body.get("weights", "unit")),
@@ -195,6 +206,8 @@ class JobSpec:
                                "(0 = auto)")
         if spec.h2d_ring < 0:
             raise ProtocolError("job.h2d_ring must be >= 0 (0 = auto)")
+        if spec.inflight < 0:
+            raise ProtocolError("job.inflight must be >= 0 (0 = auto)")
         if spec.weights not in ("unit", "degree"):
             raise ProtocolError("job.weights must be 'unit' or 'degree'")
         if spec.deadline_s is not None and spec.deadline_s <= 0:

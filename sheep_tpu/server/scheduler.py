@@ -255,7 +255,7 @@ class Scheduler:
     def __init__(self, budget_bytes: Optional[int] = None,
                  root_span_id=None, journal=None,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 16):
+                 checkpoint_every: int = 16, result_store=None):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self.budget = resolve_budget_bytes(budget_bytes)
@@ -286,6 +286,17 @@ class Scheduler:
         self._suspend_deadline = 0.0
         self._restarts = 0
         self._caches: "OrderedDict[tuple, dict]" = OrderedDict()
+        # ---- fleet warm path (ISSUE 16): content-addressed result
+        # store. A repeat submit whose digest hits answers DONE at
+        # admission — zero dispatch steps, zero recompiles, the exact
+        # packed assignment the original build produced. Accepts a
+        # ResultStore or a directory path.
+        if isinstance(result_store, str):
+            from sheep_tpu.server.resultstore import ResultStore
+
+            result_store = ResultStore(result_store)
+        self.result_store = result_store
+        self._rc_evictions_seen = 0
         self.totals = {"submitted": 0, "done": 0, "failed": 0,
                        "cancelled": 0, "rejected": 0,
                        "deadline_exceeded": 0}
@@ -336,6 +347,18 @@ class Scheduler:
             "sheepd_submits_reattached_total",
             "idempotent resubmissions matched to an existing job by "
             "digest", ("tenant",))
+        # ---- fleet plane (ISSUE 16): result-cache visibility --------
+        self._m_rc_hits = self.metrics.counter(
+            "sheepd_result_cache_hits_total",
+            "submits answered from the content-addressed result store "
+            "(zero build steps, zero recompiles)", ("tenant",))
+        self._m_rc_misses = self.metrics.counter(
+            "sheepd_result_cache_misses_total",
+            "submits that probed the result store and built", ("tenant",))
+        self._m_rc_evictions = self.metrics.counter(
+            "sheepd_result_cache_evictions_total",
+            "result-store entries evicted oldest-first under the "
+            "byte cap")
         # ---- incremental plane (ISSUE 15): resident partitions ------
         self._m_updates = self.metrics.counter(
             "sheep_updates_total",
@@ -474,6 +497,20 @@ class Scheduler:
             digest = journal_mod.job_digest(spec)
         n = self._probe_num_vertices(spec)
         modeled, batch, rejected_why = self._model(spec, n)
+        hit = None
+        if self.result_store is not None and not spec.resident:
+            # fleet warm path (ISSUE 16): a digest hit answers DONE
+            # from the store before admission ever reserves device
+            # memory. Resident jobs never consult the store — their
+            # value is the carried incremental state, which a cached
+            # answer lacks. The read happens OFF-lock (file IO).
+            try:
+                hit = self.result_store.get(digest)
+            except ValueError as e:
+                # strict IO policy: a damaged entry refuses to serve —
+                # this submit fails loudly instead of silently
+                # rebuilding (quarantine policy reports a miss instead)
+                raise protocol.ProtocolError(str(e)) from None
         with self._lock:
             if self._stop or self._draining or self._suspending:
                 raise protocol.ProtocolError("daemon is shutting down")
@@ -487,7 +524,9 @@ class Scheduler:
             self._jobs[job.id] = job
             self.totals["submitted"] += 1
             self._m_submitted.inc(tenant=spec.tenant)
-            if rejected_why is not None:
+            if hit is not None:
+                pass  # served from the store after the submit WAL below
+            elif rejected_why is not None:
                 job.state = REJECTED
                 job.error = rejected_why
                 job.end_t = time.time()
@@ -495,6 +534,8 @@ class Scheduler:
                 self._m_rejected.inc(tenant=spec.tenant)
                 self._m_terminal.inc(tenant=spec.tenant, state=REJECTED)
             else:
+                if self.result_store is not None and not spec.resident:
+                    self._m_rc_misses.inc(tenant=spec.tenant)
                 self._pending.append(job)
             if self.journal is not None:
                 # the WAL's admission promise: once the client holds
@@ -511,8 +552,40 @@ class Scheduler:
             obs.event("job_submit", job=job.id, tenant=spec.tenant,
                       input=spec.input, k=list(spec.ks), state=job.state,
                       modeled_bytes=modeled)
+            if hit is not None:
+                self._serve_from_store_locked(job, hit)
             self._cond.notify_all()
             return job
+
+    def _serve_from_store_locked(self, job: Job, entry: dict) -> None:
+        """Adopt a result-store hit as this job's DONE terminal
+        (ISSUE 16): reconstruct the PartitionResult rows from the
+        stored summaries + packed assignments (bit-identical — the
+        store kept the exact payload the original build answered),
+        then run the normal finalize: terminal WAL, output write,
+        quality series, retention. Zero dispatch steps and zero jit
+        compiles by construction — the job never enters the queue."""
+        from sheep_tpu.types import PartitionResult
+
+        results = []
+        for row in entry.get("results") or []:
+            results.append(PartitionResult(
+                assignment=protocol.decode_assignment(row["assignment"]),
+                k=int(row["k"]), edge_cut=int(row["edge_cut"]),
+                total_edges=int(row["total_edges"]),
+                cut_ratio=float(row["cut_ratio"]),
+                balance=float(row["balance"]),
+                comm_volume=row.get("comm_volume"),
+                phase_times=dict(row.get("phase_times") or {}),
+                backend=str(row.get("backend", "sheepd")),
+                diagnostics=dict(row.get("diagnostics") or {})))
+        job.results = results
+        job.jit_compiles = 0
+        job.stats["result_cache_hit"] = 1
+        self._m_rc_hits.inc(tenant=job.spec.tenant)
+        obs.event("result_cache_hit", job=job.id,
+                  tenant=job.spec.tenant, digest=job.digest)
+        self._finalize_locked(job, DONE)
 
     def reattach_or_submit(self, spec: JobSpec):
         """Idempotent resubmission (ISSUE 14): match the spec's digest
@@ -557,7 +630,8 @@ class Scheduler:
         term would admit jobs whose real footprint exceeds the budget
         and re-create the OOM churn admission exists to prevent."""
         from sheep_tpu.backends.tpu_backend import (resolve_dispatch_batch,
-                                                    resolve_h2d_ring)
+                                                    resolve_h2d_ring,
+                                                    resolve_inflight)
         from sheep_tpu.io.devicestream import is_device_stream
         from sheep_tpu.io.edgestream import open_input
         from sheep_tpu.utils import membudget
@@ -570,14 +644,19 @@ class Scheduler:
         except Exception:
             dev_stream = False  # _probe_num_vertices already rejected
         ring = 0 if dev_stream else resolve_h2d_ring(spec.h2d_ring)
+        # the in-job pipeline (ISSUE 16) keeps D issued executions'
+        # staging blocks live at once — admission must reserve them or
+        # a full pipe re-creates the OOM churn it exists to prevent
+        infl = resolve_inflight(spec.inflight)
         batch = resolve_dispatch_batch(spec.dispatch_batch, n, cs,
-                                       h2d_ring=ring)
+                                       inflight=infl, h2d_ring=ring)
         if self.budget is None:
             return None, None, None
 
         def total(b):
             return membudget.build_phase_bytes(
-                n, cs, dispatch_batch=b, h2d_ring=ring)["total_bytes"]
+                n, cs, dispatch_batch=b, inflight=infl,
+                h2d_ring=ring)["total_bytes"]
 
         m = total(batch)
         shed = None
@@ -879,6 +958,11 @@ class Scheduler:
                                     labels, float(r.cut_ratio)))
                     samples.append(("sheep_quality_job_balance",
                                     labels, float(r.balance)))
+        store = self.result_store
+        if store is not None:
+            # file IO (listdir + stat) — outside the lock by design
+            samples.append(("sheepd_result_cache_bytes", {},
+                            store.bytes_used))
         for name, n in compile_cache_sizes().items():
             samples.append(("sheepd_compile_cache_entries",
                             {"program": name}, n))
@@ -1479,6 +1563,13 @@ class Scheduler:
             # the adopted resident partition's initial snapshot —
             # outside the lock, on the dispatch thread (ISSUE 15)
             self._persist_resident(job, journal_epoch=False)
+        if outcome == DONE:
+            # fleet warm path (ISSUE 16): publish strictly AFTER the
+            # fsync'd journal terminal, outside the lock, on the
+            # dispatch thread — a kill -9 between the two resolves to
+            # a rebuild on the next identical submit, never a torn or
+            # unjournaled answer
+            self._publish_result(job)
         if outcome == FAILED:
             # forensics: the job's last N buffered events (terminal
             # event included — job_done landed in the ring at
@@ -1487,6 +1578,49 @@ class Scheduler:
             self.flight.dump(job.id, reason="job_failed:"
                              f"{(error or '?')[:120]}")
         self._close_gen(job)
+
+    def _publish_result(self, job: Job) -> None:
+        """Persist a DONE job's results into the content-addressed
+        store (ISSUE 16). Best-effort: a failed publish costs the next
+        identical submit a rebuild, never an error."""
+        store = self.result_store
+        if store is None or job.spec.resident or not job.results \
+                or not job.digest:
+            return
+        rows = []
+        for r in job.results:
+            row = r.summary()
+            row["assignment"] = protocol.encode_assignment(r.assignment)
+            rows.append(row)
+        try:
+            ok = store.put(job.digest, {
+                "t": job.end_t or time.time(),
+                "tenant": job.spec.tenant,
+                "n_vertices": int(job.n_vertices), "results": rows})
+        except (OSError, ValueError) as e:
+            obs.event("result_cache_error", job=job.id,
+                      error=f"{type(e).__name__}: {str(e)[:200]}")
+            return
+        delta = store.evictions - self._rc_evictions_seen
+        if delta > 0:
+            self._m_rc_evictions.inc(delta)
+            self._rc_evictions_seen = store.evictions
+        if ok:
+            obs.event("result_cache_store", job=job.id,
+                      digest=job.digest, bytes=store.bytes_used)
+
+    def lookup_digest(self, digest) -> bool:
+        """The ``lookup`` verb (ISSUE 16): does this replica's result
+        store hold an entry for ``digest``? Advisory — a damaged entry
+        reports a miss here (the submit path applies the full
+        strict/quarantine contract when it actually serves)."""
+        store = self.result_store
+        if store is None or not isinstance(digest, str):
+            return False
+        try:
+            return store.get(digest) is not None
+        except ValueError:
+            return False
 
     # terminal jobs retained for status/wait queries; beyond this the
     # oldest are evicted (with their result arrays) — a resident
@@ -1624,17 +1758,22 @@ class Scheduler:
                          f"{type(e).__name__}: {str(e)[:200]}")
 
     # ------------------------------------------------------------------
-    # shared device chunk cache (one lease at a time per input)
+    # shared device chunk cache (one filler + any readers per input)
     # ------------------------------------------------------------------
     def _lease_cache_locked(self, job: Job):
         """The daemon-held device chunk cache for this job's input, or
-        None. One lease at a time per cache: the backends' prefix-fill
-        invariant assumes a single filler, and the dispatch loop
-        interleaves jobs on one thread, so a second simultaneous
-        reader could double-append — the second job just streams.
-        Budget comes from the backends' own rule (0 on cpu-jax, where
-        "device" memory is the host's)."""
+        None. The backends' prefix-fill invariant assumes a single
+        FILLER, so the first job on an input leases the cache itself
+        (it appends); concurrent jobs on the same input get a
+        read-only view (ISSUE 16) that serves the cached prefix and
+        streams the rest without ever appending — interleaved jobs
+        share the resident chunks instead of the second one
+        re-streaming everything. All access stays on the one dispatch
+        thread, so reads and fills never race. Budget comes from the
+        backends' own rule (0 on cpu-jax, where "device" memory is
+        the host's)."""
         from sheep_tpu.backends.tpu_backend import (_ChunkCache,
+                                                    _ChunkCacheReader,
                                                     _chunk_cache_budget)
 
         with self._lock:
@@ -1647,29 +1786,35 @@ class Scheduler:
                 if budget <= 0:
                     return None
                 entry = {"cache": _ChunkCache(budget),
-                         "leased_by": None}
+                         "filler": None, "readers": set()}
                 self._caches[key] = entry
-                # bound resident inputs — but never evict a LEASED
-                # entry: its chunks are pinned by the running engine
+                # bound resident inputs — but never evict a HELD
+                # entry: its chunks are pinned by the running engines
                 # anyway, and dropping the entry would orphan the
                 # lease and invite a duplicate cache for the same key
                 evictable = [k for k, e in self._caches.items()
-                             if e["leased_by"] is None and k != key]
+                             if e["filler"] is None
+                             and not e["readers"] and k != key]
                 while len(self._caches) > 4 and evictable:
                     del self._caches[evictable.pop(0)]
-            if entry["leased_by"] is not None:
-                return None
-            entry["leased_by"] = job.id
-            return entry["cache"]
+            if entry["filler"] is None:
+                entry["filler"] = job.id
+                return entry["cache"]
+            entry["readers"].add(job.id)
+            return _ChunkCacheReader(entry["cache"])
 
     def _release_cache_locked(self, job: Job) -> None:
         with self._lock:
             for key, entry in list(self._caches.items()):
-                if entry["leased_by"] == job.id:
-                    entry["leased_by"] = None
+                if entry["filler"] == job.id:
+                    entry["filler"] = None
                     if job.cache_shed:
                         # the engine detached under memory pressure:
                         # drop the entry so the HBM dies with the
-                        # engine's references and the next job on this
+                        # engines' references and the next job on this
                         # input starts a fresh, freshly-budgeted cache
+                        # (live readers keep serving their view — it
+                        # references the cache object directly)
                         del self._caches[key]
+                else:
+                    entry["readers"].discard(job.id)

@@ -1,0 +1,212 @@
+"""Result-store durability contract (ISSUE 16 satellite).
+
+The content-addressed result cache may only ever cost a rebuild —
+never serve a torn, stale or wrong answer. These tests pin that down:
+torn/partial entries are quarantined or refused (per SHEEP_IO_POLICY,
+the journal's damage contract), eviction under a tiny byte cap drops
+oldest-first, and a kill -9 landing between the journal terminal and
+the store publish resolves to a bit-identical rebuild on the next
+submit of the same digest.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sheep_tpu.server.resultstore import ResultStore, ResultStoreError
+
+
+def dig(i: int) -> str:
+    return f"{i:040x}"
+
+
+def entry(i: int = 0, pad: int = 0) -> dict:
+    return {"t": 1.0, "tenant": "t", "n_vertices": 8,
+            "results": [{"k": 4, "edge_cut": i, "pad": "x" * pad}]}
+
+
+def entry_path(rs: ResultStore, digest: str) -> str:
+    return os.path.join(rs.root, digest + ".json")
+
+
+def test_round_trip_and_miss(tmp_path):
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.get(dig(1)) is None
+    assert rs.put(dig(1), entry(1))
+    doc = rs.get(dig(1))
+    assert doc["digest"] == dig(1)
+    assert doc["results"][0]["edge_cut"] == 1
+    assert rs.bytes_used > 0
+
+
+def test_bad_digest_refused(tmp_path):
+    rs = ResultStore(str(tmp_path / "r"))
+    for bad in ("", "../../etc/passwd", "ABC", "a/b"):
+        with pytest.raises(ValueError):
+            rs.get(bad)
+
+
+def test_torn_entry_is_a_miss_under_quarantine(tmp_path, monkeypatch):
+    """A partial write / torn tail NEVER serves: quarantine policy
+    reports a miss and drops the carcass so the job rebuilds."""
+    monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.put(dig(2), entry(2))
+    path = entry_path(rs, dig(2))
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert rs.get(dig(2)) is None
+    assert not os.path.exists(path), "damaged entry must be dropped"
+
+
+def test_torn_entry_raises_under_strict(tmp_path, monkeypatch):
+    """Default (strict) policy refuses to silently rebuild: damage is
+    an error the operator sees, exactly like journal replay."""
+    monkeypatch.setenv("SHEEP_IO_POLICY", "strict")
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.put(dig(3), entry(3))
+    path = entry_path(rs, dig(3))
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("garbage-tail")
+    with pytest.raises(ResultStoreError):
+        rs.get(dig(3))
+    assert os.path.exists(path), "strict policy must not destroy evidence"
+
+
+def test_bitrot_checksum_mismatch_is_damage(tmp_path, monkeypatch):
+    """Valid JSON whose body no longer matches the embedded sha (bit
+    rot, hand edits) is damage, not an answer."""
+    monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.put(dig(4), entry(4))
+    path = entry_path(rs, dig(4))
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    assert '"tenant":"t"' in text
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text.replace('"tenant":"t"', '"tenant":"u"'))
+    assert rs.get(dig(4)) is None
+    assert not os.path.exists(path)
+
+
+def test_entry_under_wrong_digest_is_damage(tmp_path, monkeypatch):
+    """A (checksum-valid) entry filed under a different digest must
+    not serve — content addressing is the whole correctness story."""
+    monkeypatch.setenv("SHEEP_IO_POLICY", "quarantine")
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.put(dig(5), entry(5))
+    os.replace(entry_path(rs, dig(5)), entry_path(rs, dig(6)))
+    assert rs.get(dig(6)) is None
+
+
+def test_newer_version_entry_skipped_not_fatal(tmp_path):
+    rs = ResultStore(str(tmp_path / "r"))
+    assert rs.put(dig(7), entry(7))
+    path = entry_path(rs, dig(7))
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    # a future daemon's entry: version bumped, checksum recomputed
+    import json as json_mod
+
+    from sheep_tpu.server import resultstore as rs_mod
+
+    doc = json_mod.loads(text)
+    doc.pop("sha")
+    doc["v"] = rs_mod.STORE_VERSION + 1
+    doc["sha"] = rs_mod._body_sha(doc)
+    with open(path, "w", encoding="utf-8") as f:
+        json_mod.dump(doc, f)
+    assert rs.get(dig(7)) is None  # skipped, no raise either policy
+
+
+def test_tmp_orphans_swept_on_open(tmp_path):
+    root = str(tmp_path / "r")
+    os.makedirs(root)
+    orphan = os.path.join(root, dig(8) + ".json.tmp")
+    with open(orphan, "w", encoding="utf-8") as f:
+        f.write('{"half-written":')
+    ResultStore(root)
+    assert not os.path.exists(orphan)
+
+
+def test_eviction_oldest_first_under_tiny_cap(tmp_path):
+    probe = ResultStore(str(tmp_path / "probe"))
+    assert probe.put(dig(0), entry(0, pad=64))
+    size = probe.bytes_used
+    # room for two entries plus slack, never three
+    rs = ResultStore(str(tmp_path / "r"), max_bytes=2 * size + size // 2)
+    for i in (1, 2, 3):
+        assert rs.put(dig(i), entry(i, pad=64))
+        # publish order == mtime order even on coarse filesystem clocks
+        os.utime(entry_path(rs, dig(i)), ns=(i * 10**9, i * 10**9))
+    assert rs.get(dig(1)) is None, "oldest entry must be the evictee"
+    assert rs.get(dig(2)) is not None
+    assert rs.get(dig(3)) is not None
+    assert rs.evictions == 1
+    assert rs.bytes_used <= rs.max_bytes
+
+
+def test_entry_larger_than_cap_refused(tmp_path):
+    rs = ResultStore(str(tmp_path / "r"), max_bytes=128)
+    assert rs.put(dig(9), entry(9, pad=4096)) is False
+    assert rs.get(dig(9)) is None
+    assert rs.bytes_used == 0
+
+
+def test_disabled_store_is_inert(tmp_path):
+    rs = ResultStore(str(tmp_path / "r"), max_bytes=0)
+    assert rs.put(dig(1), entry(1)) is False
+    assert rs.get(dig(1)) is None
+    assert rs.bytes_used == 0
+
+
+def test_crash_between_terminal_and_publish_rebuilds_identically(tmp_path):
+    """kill -9 after the journal's fsync'd DONE terminal but before
+    the store publish leaves NO entry (at worst a .tmp orphan, swept
+    on open). The next identical submit must miss the store and
+    rebuild — bit-identical to the original — never serve a torn or
+    wrong answer."""
+    import threading
+
+    from sheep_tpu.server.protocol import JobSpec
+    from sheep_tpu.server.scheduler import Scheduler
+
+    store_root = str(tmp_path / "results")
+    body = {"input": "rmat:8:4:3", "k": [4], "chunk_edges": 512}
+
+    def run_one():
+        sched = Scheduler(result_store=store_root)
+        t = threading.Thread(target=sched.run, daemon=True)
+        t.start()
+        try:
+            job = sched.submit(JobSpec.from_request(body, tenant="t"))
+            job = sched.wait(job.id, timeout_s=240)
+            assert job.state == "done", job.error
+            deadline = time.time() + 30
+            while not sched.lookup_digest(job.digest) \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+            assert sched.lookup_digest(job.digest)
+            return (job.digest, job.results[0].assignment.copy(),
+                    int(job.results[0].edge_cut),
+                    int(job.stats.get("result_cache_hit", 0)))
+        finally:
+            sched.shutdown()
+            t.join(timeout=30)
+
+    digest, a0, cut0, hit0 = run_one()
+    assert hit0 == 0
+    # simulate the crash window: the publish never landed — drop the
+    # entry and leave a torn publish orphan behind
+    os.unlink(os.path.join(store_root, digest + ".json"))
+    with open(os.path.join(store_root, digest + ".json.tmp"), "w",
+              encoding="utf-8") as f:
+        f.write('{"torn":')
+    _, a1, cut1, hit1 = run_one()
+    assert hit1 == 0, "a missing entry must rebuild, not hit"
+    assert cut1 == cut0
+    assert np.array_equal(a1, a0), "rebuild must be bit-identical"

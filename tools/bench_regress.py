@@ -88,9 +88,14 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # the warm_request_s convention (a rise is the update path slowing);
 # its companion `compactions` count is info-only below (compactions
 # are workload consequences, not regressions).
+# cached_request_s (ISSUE 16) is the content-addressed result-store
+# answer wall — a repeat submit served with zero build steps; its
+# contract bar is >= 10x under warm_request_s, so a rise means the
+# store read/decode path itself is slowing, gated like the warm path.
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
                 "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
-                "warm_request_s", "update_request_s")
+                "warm_request_s", "cached_request_s",
+                "update_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory

@@ -617,7 +617,129 @@ python tools/trace_report.py "$TRACE11" --check \
 grep -q '"event": "delta_epoch_applied"' "$TRACE11"
 grep -q '"event": "resident_resumed"' "$TRACE11"
 
+# twelfth leg: fleet serving (ISSUE 16) — two replicas, each with a
+# content-addressed result store under its state dir. A cold submit
+# through the new `--endpoints` CLI plumbing builds and publishes on
+# replica A; a repeat submit pointed at B FIRST is digest-routed back
+# to the store holder and answered with ZERO build steps and ZERO
+# compiles (sheepd_result_cache_{hits,misses}_total and
+# sheepd_result_cache_bytes on A's /metrics record it); then replica
+# B is SIGKILLed mid-build of a third job and the fleet client must
+# fail over (reattach-idempotent resubmit) to A, completing the job —
+# with every routing decision (cache_hit / headroom / failover) in
+# the CLIENT-side trace as fleet_route events.
+TRACE12A="$OUT/trace_fleet_a.jsonl"
+TRACE12B="$OUT/trace_fleet_b.jsonl"
+TRACE12C="$OUT/trace_fleet_client.jsonl"
+SOCK12A="$OUT/sheepd_fleet_a.sock"
+SOCK12B="$OUT/sheepd_fleet_b.sock"
+STATE12A="$OUT/fleet_state_a"
+STATE12B="$OUT/fleet_state_b"
+rm -f "$TRACE12A" "$TRACE12B" "$TRACE12C" "$SOCK12A" "$SOCK12B"
+rm -rf "$STATE12A" "$STATE12B"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK12A" --trace "$TRACE12A" --heartbeat-secs 0.2 \
+    --state-dir "$STATE12A" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_fleet_a.err" &
+SHEEPD12A_PID=$!
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK12B" --trace "$TRACE12B" --heartbeat-secs 0.2 \
+    --state-dir "$STATE12B" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_fleet_b.err" &
+SHEEPD12B_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do
+    [ -S "$SOCK12A" ] && [ -S "$SOCK12B" ] && break; sleep 0.2
+done
+[ -S "$SOCK12A" ] || { echo "fleet sheepd A never bound" >&2; exit 1; }
+[ -S "$SOCK12B" ] || { echo "fleet sheepd B never bound" >&2; exit 1; }
+# cold fill through the --endpoints CLI (fleet of one): builds on A
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --endpoints "$SOCK12A" --input rmat:10:8:1 --k 4 --tenant fleet \
+    --chunk-edges 1024 --wait > "$OUT/fleet_cold.json"
+if ! JAX_PLATFORMS=cpu python - "$SOCK12A" "$SOCK12B" \
+        "$SHEEPD12B_PID" "$OUT/fleet_cold.json" "$TRACE12C" \
+        > "$OUT/fleet.json" 2> "$OUT/fleet.err" <<'PYEOF'
+import json
+import os
+import signal
+import sys
+import time
+
+from sheep_tpu import obs
+from sheep_tpu.obs.metrics import parse_prometheus
+from sheep_tpu.server.client import FleetClient, SheepClient, fleet_digest
+
+sock_a, sock_b, pid_b, cold_path, trace = sys.argv[1:6]
+cold = json.load(open(cold_path))
+assert cold["state"] == "done", cold
+dg = fleet_digest("rmat:10:8:1", [4], tenant="fleet", chunk_edges=1024)
+# the store publish is post-terminal on A's dispatch thread
+with SheepClient(sock_a) as ca:
+    deadline = time.monotonic() + 30
+    while not ca.lookup(dg) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert ca.lookup(dg), "cold result never published to A's store"
+with obs.tracing(trace):
+    with FleetClient([sock_b, sock_a]) as fleet:
+        # digest hit on A short-circuits routing even though B is
+        # listed first — answered from the store, zero work
+        rep = fleet.submit("rmat:10:8:1", k=[4], tenant="fleet",
+                           chunk_edges=1024)
+        assert rep["endpoint"] == sock_a, rep
+        desc = fleet.wait(rep, timeout_s=120)
+        assert desc["state"] == "done", desc
+        assert desc.get("steps", 0) == 0, \
+            f"cache hit dispatched {desc.get('steps')} steps"
+        assert desc.get("jit_compiles") == 0, desc
+        assert desc["results"][0]["edge_cut"] \
+            == cold["results"][0]["edge_cut"], (cold, desc)
+        # third job (new digest): headroom routing ties break to B
+        # (listed first); SIGKILL it mid-build and fail over to A
+        third = fleet.submit("rmat:12:8:5", k=[4], tenant="fleet",
+                             chunk_edges=512, dispatch_batch=1)
+        assert third["endpoint"] == sock_b, third
+        with SheepClient(sock_b) as cb:
+            for _ in range(4000):
+                st = cb.status(third["job_id"])
+                if st.get("phase") == "build" and st.get("steps", 0) >= 3:
+                    break
+                time.sleep(0.005)
+            else:
+                raise SystemExit("third job never reached build")
+        os.kill(int(pid_b), signal.SIGKILL)
+        fin = fleet.wait(third, timeout_s=300)
+        assert fin["state"] == "done", fin
+        counts = dict(fleet.route_counts)
+        assert counts[sock_a] >= 1 and counts[sock_b] >= 1, counts
+with SheepClient(sock_a) as ca:
+    m = parse_prometheus(ca.metrics())
+    hits = sum(v for _, v in m.get("sheepd_result_cache_hits_total", []))
+    misses = sum(v for _, v in
+                 m.get("sheepd_result_cache_misses_total", []))
+    rc_bytes = sum(v for _, v in m.get("sheepd_result_cache_bytes", []))
+    assert hits >= 1, m.get("sheepd_result_cache_hits_total")
+    assert misses >= 1, m.get("sheepd_result_cache_misses_total")
+    assert rc_bytes > 0, m.get("sheepd_result_cache_bytes")
+    ca.shutdown()
+print(json.dumps({"cache_hits": hits, "cache_misses": misses,
+                  "route_counts": counts}))
+PYEOF
+then
+    echo "fleet smoke client failed:" >&2
+    cat "$OUT/fleet.err" >&2
+    exit 1
+fi
+wait "$SHEEPD12A_PID"
+wait "$SHEEPD12B_PID" 2>/dev/null || true
+python tools/trace_report.py "$TRACE12A" --check > "$OUT/report_fleet.txt"
+grep -q '"event": "result_cache_store"' "$TRACE12A"  # the publish
+grep -q '"event": "result_cache_hit"' "$TRACE12A"    # the served hit
+grep -q '"why": "cache_hit"' "$TRACE12C"   # client-side route record
+grep -q '"why": "headroom"' "$TRACE12C"
+grep -q '"why": "failover"' "$TRACE12C"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A"

@@ -1,14 +1,15 @@
 #!/usr/bin/env python
 """Served-job mini-soak (ISSUE 10 satellite; chaos_soak's pattern
 applied to sheepd): inject one OOM-class fault, one read fault, one
-SIGKILL and one SIGTERM drain into served jobs and assert the DAEMON
-(or its restarted incarnation) survives with the job verdict
+SIGKILL, one SIGTERM drain and one replica kill under fleet routing
+into served jobs and assert the DAEMON (or its restarted incarnation,
+or the surviving replica) delivers the job with the verdict
 ``identical`` or ``degraded_documented``.
 
     python tools/served_soak.py [--out DIR]
 
-Four legs, each a REAL ``sheepd`` subprocess on a unix socket over a
-real on-disk graph (so the edgestream read points are live):
+Five legs, each against REAL ``sheepd`` subprocesses on unix sockets
+over a real on-disk graph (so the edgestream read points are live):
 
     oom      SHEEP_FAULT_INJECT=oom@dispatch:1 — RESOURCE_EXHAUSTED at
              the first issued dispatch of the served build; the per-job
@@ -27,6 +28,12 @@ real on-disk graph (so the edgestream read points are live):
              exit rc=0 after checkpointing the job at its next flush
              barrier (the graceful drain), and the restarted daemon
              must resume it to a bit-identical finish.
+    fleet    (ISSUE 16) two replicas behind the FleetClient: headroom
+             routing must SPLIT concurrent jobs across both (route
+             counters nonzero on each), then one replica is SIGKILLed
+             mid-build and EVERY job must still complete via the
+             reattach-idempotent failover resubmit, each forest
+             bit-equal to the clean oracle.
 
 Per leg the verdict is exactly chaos_soak's taxonomy:
 
@@ -289,10 +296,138 @@ def run_durable_leg(name: str, sig: int, graph: str, out_dir: str,
         err_f.close()
 
 
+def run_fleet_leg(graph: str, out_dir: str, oracle) -> dict:
+    """ISSUE 16: two replicas behind the fleet client. Headroom
+    routing must SPLIT concurrent jobs across both replicas, then
+    replica a is SIGKILLed mid-build and every job must still finish
+    via the reattach-idempotent failover resubmit — each served
+    forest bit-equal to the clean oracle (including any answered from
+    the survivor's result store)."""
+    import numpy as np
+
+    from sheep_tpu.server.client import (FleetClient, ServerError,
+                                         SheepClient)
+
+    rec = {"leg": "fleet", "inject": "SIGKILL replica a mid-build"}
+    socks, procs, errs = [], [], []
+    try:
+        for tag in ("a", "b"):
+            sock = os.path.join(out_dir, f"soak_fleet_{tag}.sock")
+            trace = os.path.join(out_dir, f"soak_fleet_{tag}.jsonl")
+            state = os.path.join(out_dir, f"soak_fleet_{tag}.state")
+            err_f = open(os.path.join(out_dir,
+                                      f"soak_fleet_{tag}.err"), "w")
+            errs.append(err_f)
+            socks.append(sock)
+            procs.append(_spawn_durable_daemon(sock, trace, state,
+                                               err_f))
+        for _ in range(300):
+            if all(os.path.exists(s) for s in socks):
+                break
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.2)
+        if not all(os.path.exists(s) for s in socks):
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = "a fleet replica never bound"
+            return rec
+        with FleetClient(socks) as fleet:
+            # three concurrent jobs; the short sleep lets each
+            # replica's load gauges see the previous admit so the
+            # headroom sort actually alternates
+            jobs = []
+            for _ in range(3):
+                jobs.append(fleet.submit(
+                    graph, k=4, tenant="fleet",
+                    chunk_edges=DURABLE_CHUNK, num_vertices=DURABLE_V,
+                    dispatch_batch=1, return_assignment=True))
+                time.sleep(0.5)
+            rec["route_counts"] = dict(fleet.route_counts)
+            if len({r["endpoint"] for r in jobs}) < 2:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = (f"headroom routing never split: "
+                                f"{rec['route_counts']}")
+                return rec
+            # land the kill INSIDE a replica-a build (a kill after
+            # completion would prove reattach, not failover)
+            victim = next(r for r in jobs
+                          if r["endpoint"] == socks[0])
+            with SheepClient(socks[0]) as c:
+                landed = False
+                for _ in range(4000):
+                    st = c.status(victim["job_id"])
+                    if st["state"] in ("done", "failed"):
+                        break
+                    if st.get("phase") == "build" \
+                            and st.get("steps", 0) >= 3:
+                        landed = True
+                        break
+                    time.sleep(0.005)
+            if not landed:
+                rec["verdict"] = "unhandled_crash"
+                rec["error"] = (f"kill window missed: victim reached "
+                                f"{st.get('state')}/{st.get('phase')}")
+                return rec
+            rec["killed_at_steps"] = st.get("steps")
+            pre_kill_counts = dict(fleet.route_counts)
+            procs[0].kill()
+            procs[0].wait(timeout=30)
+            # every job must complete: replica-b's directly, replica
+            # a's via failover resubmission to the survivor
+            # wait on DESCRIPTORS: both replicas mint per-process job
+            # ids, so the bare ids collide across the fleet
+            for r in jobs:
+                try:
+                    job = fleet.wait(r, timeout_s=300)
+                except ServerError as e:
+                    rec["verdict"] = "unhandled_crash"
+                    rec["error"] = f"fleet lost a job: {e}"
+                    return rec
+                if job.get("state") != "done":
+                    rec["verdict"] = "unhandled_crash"
+                    rec["error"] = job.get("error", "job not done")
+                    return rec
+                served = fleet.result_assignment(job)
+                if not np.array_equal(served, np.asarray(oracle)):
+                    rec["verdict"] = "wrong_forest"
+                    return rec
+            rec["route_counts"] = dict(fleet.route_counts)
+            rec["failovers"] = sum(
+                fleet.route_counts[ep] - pre_kill_counts.get(ep, 0)
+                for ep in fleet.route_counts)
+            # the survivor must still be serving, and shut down clean
+            with SheepClient(socks[1]) as c:
+                try:
+                    c.ping()
+                except (ServerError, OSError) as e:
+                    rec["verdict"] = "unhandled_crash"
+                    rec["error"] = f"survivor dead after failover: {e}"
+                    return rec
+                try:
+                    c.shutdown()
+                except (ServerError, OSError):
+                    pass
+        procs[1].wait(timeout=60)
+        rec["daemon_rc"] = procs[1].returncode
+        if procs[1].returncode != 0:
+            rec["verdict"] = "unhandled_crash"
+            rec["error"] = f"survivor exit rc={procs[1].returncode}"
+            return rec
+        rec["verdict"] = "identical"
+        return rec
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        for f in errs:
+            f.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="sheepd fault mini-soak (oom + read + restart + "
-                    "drain legs)")
+                    "drain + fleet legs)")
     ap.add_argument("--out", default=None,
                     help="artifact dir (default: fresh temp dir)")
     args = ap.parse_args(argv)
@@ -341,6 +476,19 @@ def main(argv=None) -> int:
                                        "resumed anything"}),
                   flush=True)
             ok = False
+
+    # the fleet leg (ISSUE 16): two replicas, headroom-split jobs,
+    # SIGKILL one replica mid-build, failover finishes everything
+    rec = run_fleet_leg(big_graph, out_dir, big_oracle)
+    print(json.dumps(rec), flush=True)
+    if rec["verdict"] not in ("identical", "degraded_documented"):
+        ok = False
+    if rec.get("verdict") == "identical" and not rec.get("failovers"):
+        print(json.dumps({"leg": "fleet",
+                          "error": "no failover resubmit happened — "
+                                   "the kill proved nothing"}),
+              flush=True)
+        ok = False
     print(json.dumps({"soak": "served", "ok": ok, "out": out_dir}),
           flush=True)
     return 0 if ok else 1
