@@ -50,6 +50,24 @@ A staleness counter (``stale_deletes`` vs ``compact_threshold``,
 default 20% of the surviving edges) forces compaction inside
 :func:`apply_update` so a delete-heavy stream cannot ride a stale
 tree forever.
+
+**Incremental scoring** (ISSUE 17 tentpole): a scored epoch no longer
+pays an O(E) survivor pass. The first scored :func:`refresh` seeds a
+score cache — a symmetrized, mmap-backed adjacency index of the base
+(:class:`_SurvivorIndex`, built once via the ``io/csr.py`` machinery)
+plus per-k (cut, total) accumulators and the assignments they were
+scored under. Each :func:`apply_update` then folds the delta's exact
+effect into the accumulators under the cached assignments (O(Δ),
+:func:`sheep_tpu.ops.score.edge_effect_host`), and :func:`refresh`
+rescores ONLY the arcs incident to vertices whose assignment changed
+in the refold (:func:`sheep_tpu.ops.refine.move_rescore_host`) —
+bit-equal to the full ``score_stream`` pass by construction, pinned
+in tests, and cross-checked at runtime when ``SHEEP_SCORE_AUDIT=1``
+(the audit runs the O(E) pass too and raises on ANY divergence).
+``comm_volume=True`` refreshes keep the full pass (distinct-pair
+counting is not incrementally maintainable) and re-seed the cache.
+:func:`rebase_state` (full compaction + base rewrite) drops the cache;
+the next scored refresh re-seeds it over the fresh base.
 """
 
 from __future__ import annotations
@@ -114,6 +132,10 @@ class PartitionState:
     compact_threshold: Optional[int] = None  # None = 20% of survivors
     stats: dict = dataclasses.field(default_factory=dict)
     _order: Optional[np.ndarray] = None
+    # incremental score cache (ISSUE 17): seeded by the first scored
+    # refresh, never serialized — a reloaded snapshot re-seeds with one
+    # full pass. See _seed_score_cache for the layout.
+    _score: Optional[dict] = None
 
     @property
     def order(self) -> np.ndarray:
@@ -262,6 +284,269 @@ def _validate_delta(edges, n: int, what: str) -> np.ndarray:
     return e
 
 
+# ----------------------------------------------------------------------
+# incremental scoring (ISSUE 17 tentpole): survivor adjacency index +
+# per-k cut/total accumulators, exactly equal to the full score pass
+# ----------------------------------------------------------------------
+
+
+class _SurvivorIndex:
+    """Symmetrized, mmap-backed adjacency of the resident BASE stream
+    (the ``io/csr.py`` machinery): each base edge contributes both
+    arcs, so ``arcs_from(changed)`` enumerates every base occurrence
+    touching a changed vertex — once per direction — without streaming
+    E edges. Built once per base (one extra two-pass conversion at
+    cache-seed time), shared across ks, dropped with the state; the
+    add/tombstone overlay lives on the score cache — the index file
+    itself never mutates. A self-loop contributes two ``u -> u`` arcs,
+    so the undirected base multiplicity of {a, b} is the count of b in
+    a's arc list (halved when a == b)."""
+
+    def __init__(self, state: PartitionState):
+        import tempfile
+        import weakref
+
+        from sheep_tpu.io import csr as csr_mod
+        from sheep_tpu.io.edgestream import EdgeStream
+
+        base = state.base
+        cs = state.chunk_edges
+
+        def factory():
+            for chunk in base.chunks(cs):
+                e = np.asarray(chunk, np.int64).reshape(-1, 2)
+                if len(e):
+                    yield np.concatenate([e, e[:, ::-1]], axis=0)
+
+        fd, path = tempfile.mkstemp(prefix="sheep_symadj_",
+                                    suffix=".csr")
+        os.close(fd)
+        csr_mod.write_csr(path, EdgeStream.from_generator(
+            factory, n_vertices=state.n), n_vertices=state.n)
+        self.path = path
+        self.csr = csr_mod.CsrGraph(path)
+        self._finalizer = weakref.finalize(
+            self, _SurvivorIndex._cleanup, self.csr, path)
+
+    @staticmethod
+    def _cleanup(csr, path: str) -> None:
+        try:
+            csr.close()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def drop(self) -> None:
+        self._finalizer()
+
+    def multiplicity(self, a: int, b: int) -> int:
+        """Base multiset count of the undirected key {a, b}."""
+        nb = self.csr.neighbors(a)
+        c = int(np.count_nonzero(nb == b))
+        return c // 2 if a == b else c
+
+
+def _drop_score_cache(state: PartitionState) -> None:
+    sc = state._score
+    if sc is None:
+        return
+    idx = sc.get("index")
+    if idx is not None:
+        idx.drop()
+    state._score = None
+
+
+def _seed_score_cache(state: PartitionState, assigns: dict,
+                      scored: dict) -> None:
+    """(Re)seed the score cache from a just-completed FULL pass.
+
+    Layout: ``index`` (symmetrized base CSR), ``fired`` (normalized
+    non-self tombstone key -> occurrences actually removed from the
+    base, capped at base multiplicity — unmatched tombstones never
+    fire, matching deltalog.filter_tombstones), ``ov`` (symmetrized
+    arc chunks of the pending adds, or None to lazily rebuild from
+    ``state.adds``), ``prev`` / ``cut`` / ``total`` (the assignments
+    the accumulators are exact under). Any failure to build the index
+    leaves the cache unset — every later refresh just stays on the
+    full pass; the cache is an optimization, never a requirement."""
+    sc = state._score
+    if sc is None:
+        try:
+            index = _SurvivorIndex(state)
+        except Exception:  # noqa: BLE001 — fall back to full passes
+            state._score = None
+            return
+        fired: dict = {}
+        for a, b in state.tomb_array():
+            a, b = int(a), int(b)
+            if a == b:
+                continue  # self-loops never score (total excludes them)
+            key = (a, b) if a < b else (b, a)
+            f = fired.get(key, 0)
+            if f < index.multiplicity(a, b):
+                fired[key] = f + 1
+        sc = state._score = {"index": index, "fired": fired,
+                             "ov": None, "ov_adds": -1}
+    sc["prev"] = {k: np.array(a, copy=True)
+                  for k, a in assigns.items()}
+    sc["cut"] = {k: int(scored[k][0]) for k in assigns}
+    sc["total"] = int(next(iter(scored.values()))[1])
+
+
+def _account_adds(state: PartitionState, adds: np.ndarray) -> None:
+    """O(Δ) accumulator fold of an add batch under the CACHED
+    assignments; called right after ``state.adds.append(adds)``."""
+    sc = state._score
+    if sc is None or "prev" not in sc:
+        return
+    from sheep_tpu.ops.score import edge_effect_host
+
+    valid, cuts = edge_effect_host(adds, sc["prev"], state.n)
+    sc["total"] += valid
+    for k, c in cuts.items():
+        sc["cut"][k] += c
+    if sc.get("ov") is not None \
+            and sc.get("ov_adds") == len(state.adds) - 1:
+        sc["ov"].append(np.concatenate([adds, adds[:, ::-1]], axis=0))
+        sc["ov_adds"] = len(state.adds)
+    else:
+        sc["ov"] = None  # overlay stale — rebuilt at next rescore
+
+
+def _account_dels(state: PartitionState, dels: np.ndarray,
+                  base_tombs: np.ndarray) -> None:
+    """O(Δ) accumulator fold of a delete batch, called right after
+    ``cancel_adds`` resolved it: deletes that cancelled a pending add
+    remove an edge with the SAME endpoints (cancel_adds matches on the
+    undirected key), and a base tombstone removes one base occurrence
+    only while the base multiplicity is not exhausted — the exact
+    multiset algebra of ``filter_tombstones``, answered in O(deg) from
+    the symmetrized index instead of a stream pass."""
+    sc = state._score
+    if sc is None or "prev" not in sc:
+        return
+    from sheep_tpu.ops.score import edge_effect_host
+
+    prev, n = sc["prev"], state.n
+    dv, dc = edge_effect_host(dels, prev, n)
+    bv, bc = edge_effect_host(base_tombs, prev, n)
+    # the add-cancelled portion = dels minus the base-resolved remainder
+    sc["total"] -= dv - bv
+    for k in dc:
+        sc["cut"][k] -= dc[k] - bc[k]
+    fired, idx = sc["fired"], sc["index"]
+    for a, b in np.asarray(base_tombs, np.int64).reshape(-1, 2):
+        a, b = int(a), int(b)
+        if a == b:
+            continue  # self-loops never score
+        key = (a, b) if a < b else (b, a)
+        f = fired.get(key, 0)
+        if f < idx.multiplicity(a, b):
+            fired[key] = f + 1
+            sc["total"] -= 1
+            for k, p in prev.items():
+                if p[a] != p[b]:
+                    sc["cut"][k] -= 1
+    sc["ov"] = None  # cancel_adds rewrote state.adds
+
+
+def _drop_fired_arcs(src: np.ndarray, dst: np.ndarray, fired: dict,
+                     n: int) -> tuple:
+    """Remove the fired-tombstone occurrences from a base arc gather:
+    per ordered pair, the first ``fired`` occurrences are dropped —
+    occurrences of one pair are interchangeable for scoring, so WHICH
+    ones go is immaterial. O(A) for the key probe plus O(H log H) over
+    the arcs actually hitting a deleted key."""
+    rem: dict = {}
+    for (a, b), c in fired.items():
+        rem[a * n + b] = rem.get(a * n + b, 0) + c
+        rem[b * n + a] = rem.get(b * n + a, 0) + c
+    keys = src * np.int64(n) + dst
+    rem_keys = np.fromiter(rem.keys(), np.int64, len(rem))
+    hidx = np.flatnonzero(np.isin(keys, rem_keys))
+    if not len(hidx):
+        return src, dst
+    hk = keys[hidx]
+    order = np.argsort(hk, kind="stable")
+    sk = hk[order]
+    boundary = np.empty(len(sk), bool)
+    boundary[0] = True
+    np.not_equal(sk[1:], sk[:-1], out=boundary[1:])
+    gid = np.cumsum(boundary) - 1
+    counts = np.bincount(gid)
+    starts = np.zeros(len(counts), dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    rank = np.arange(len(sk), dtype=np.int64) - starts[gid]
+    remv = np.array([rem[int(x)] for x in sk[boundary]],
+                    dtype=np.int64)
+    keep = np.ones(len(keys), bool)
+    keep[hidx[order]] = rank >= remv[gid]
+    return src[keep], dst[keep]
+
+
+def _survivor_arcs_from(state: PartitionState,
+                        changed: np.ndarray) -> tuple:
+    """Every surviving arc leaving ``changed`` (src, dst): the base
+    gather minus fired tombstone occurrences, plus the symmetrized
+    pending-add overlay. O(arcs touched + pending adds)."""
+    sc = state._score
+    src, dst = sc["index"].csr.arcs_from(changed)
+    if sc["fired"] and len(src):
+        src, dst = _drop_fired_arcs(src, dst, sc["fired"], state.n)
+    if sc.get("ov") is None or sc.get("ov_adds") != len(state.adds):
+        sc["ov"] = [np.concatenate([a, a[:, ::-1]], axis=0)
+                    for a in state.adds]
+        sc["ov_adds"] = len(state.adds)
+    if sc["ov"]:
+        mask = np.zeros(state.n, bool)
+        mask[changed] = True
+        parts_s, parts_d = [src], [dst]
+        for arcs in sc["ov"]:
+            m = mask[arcs[:, 0]]
+            if m.any():
+                parts_s.append(arcs[m, 0])
+                parts_d.append(arcs[m, 1])
+        src = np.concatenate(parts_s)
+        dst = np.concatenate(parts_d)
+    return src, dst
+
+
+def _rescore_incremental(state: PartitionState, assigns: dict,
+                         w) -> dict:
+    """The O(Δ)-per-epoch scored refresh: accumulators already carry
+    the multiset delta (apply_update folded it under the cached
+    assignments), so only the REASSIGNMENT delta remains — rescore the
+    arcs incident to vertices whose label moved, per k. Returns the
+    same ``{k: (cut, total, balance, cv)}`` shape as score_stream;
+    balance is recomputed O(V) with the identical part_balance call,
+    so every field is bit-equal to the full pass."""
+    from sheep_tpu.core import pure
+    from sheep_tpu.ops.refine import move_rescore_host
+
+    sc = state._score
+    prev, cut = sc["prev"], sc["cut"]
+    masks = {k: prev[k] != a for k, a in assigns.items()}
+    union = np.zeros(state.n, bool)
+    for m in masks.values():
+        union |= m
+    changed = np.flatnonzero(union)
+    if len(changed):
+        src, dst = _survivor_arcs_from(state, changed)
+        for k, a in assigns.items():
+            if masks[k].any():
+                cut[k] += move_rescore_host(src, dst, prev[k], a,
+                                            masks[k])
+    out = {}
+    for k, a in assigns.items():
+        prev[k] = np.array(a, copy=True)
+        out[k] = (int(cut[k]), int(sc["total"]),
+                  pure.part_balance(a, k, w), None)
+    return out
+
+
 def apply_update(backend, state: PartitionState, adds=None,
                  deletes=None, epoch: Optional[int] = None,
                  score: bool = True, compact: str = "auto",
@@ -288,6 +573,7 @@ def apply_update(backend, state: PartitionState, adds=None,
             backend._fold_delta(state, adds)
             state.adds.append(adds)
             state.total_edges += len(adds)
+            _account_adds(state, adds)
         if len(dels):
             from sheep_tpu.io.deltalog import cancel_adds
 
@@ -303,6 +589,7 @@ def apply_update(backend, state: PartitionState, adds=None,
             state.pending_tombs.append(dels)
             state.stale_deletes += len(dels)
             state.total_edges = max(0, state.total_edges - len(dels))
+            _account_dels(state, dels, base_tombs)
         state.epoch = int(epoch) if epoch is not None \
             else state.epoch + 1
         state.stats["updates"] = state.stats.get("updates", 0) + 1
@@ -332,7 +619,11 @@ def apply_update(backend, state: PartitionState, adds=None,
 
 def refresh(backend, state: PartitionState, comm_volume: bool = False):
     """Materialize the resident table into scored results: tree split
-    per k (O(V)) + ONE scoring pass over the surviving multiset.
+    per k (O(V)), then EITHER the O(Δ) incremental rescore (cache
+    seeded, no comm_volume) or one full scoring pass over the
+    surviving multiset (which seeds/re-seeds the cache). Both produce
+    bit-equal results; ``SHEEP_SCORE_AUDIT=1`` runs the full pass
+    alongside the incremental one and raises on any divergence.
     Returns one PartitionResult, or a list for multi-k states."""
     from sheep_tpu.backends.base import score_stream
     from sheep_tpu.ops.split import tree_split_host
@@ -348,10 +639,31 @@ def refresh(backend, state: PartitionState, comm_volume: bool = False):
                for k in state.ks}
     split_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    scored = score_stream(state.survivor_stream(), assigns,
-                          chunk_edges=state.chunk_edges,
-                          comm_volume=comm_volume, weights=w)
+    sc = state._score
+    if sc is not None and "prev" in sc and not comm_volume:
+        scored = _rescore_incremental(state, assigns, w)
+        state.stats["score_incremental"] = \
+            state.stats.get("score_incremental", 0) + 1
+        if os.environ.get("SHEEP_SCORE_AUDIT", "") not in ("", "0"):
+            full = score_stream(state.survivor_stream(), assigns,
+                                chunk_edges=state.chunk_edges,
+                                comm_volume=False, weights=w)
+            for k in state.ks:
+                if tuple(scored[k]) != tuple(full[k]):
+                    raise RuntimeError(
+                        f"SHEEP_SCORE_AUDIT: incremental score "
+                        f"diverged at epoch {state.epoch} k={k}: "
+                        f"incremental={scored[k]} full={full[k]}")
+    else:
+        scored = score_stream(state.survivor_stream(), assigns,
+                              chunk_edges=state.chunk_edges,
+                              comm_volume=comm_volume, weights=w)
+        state.stats["score_full"] = \
+            state.stats.get("score_full", 0) + 1
+        _seed_score_cache(state, assigns, scored)
     score_s = time.perf_counter() - t0
+    state.stats["update_score_s"] = round(
+        state.stats.get("update_score_s", 0.0) + score_s, 6)
     diag = {"epoch": float(state.epoch),
             "stale_deletes": float(state.stale_deletes),
             "compactions": float(state.compactions),
@@ -511,6 +823,48 @@ def _compact_subtree(backend, state: PartitionState,
         state.stats.get("compact_subtree", 0) + 1
     state.stats["compact_refolded_edges"] = \
         state.stats.get("compact_refolded_edges", 0) + refolded
+
+
+def rebase_state(backend, state: PartitionState,
+                 base_out: str) -> str:
+    """Full compaction + BASE REWRITE (ISSUE 17): re-anchor on the
+    survivors, then materialize the surviving multiset into a fresh
+    mmap CSR base artifact at ``base_out`` and drop the add/tombstone
+    history — the tombstone filter and anchored history become
+    O(recent) instead of O(lifetime). The artifact write is atomic
+    (``write_csr`` lands tmp + rename); the CALLER owns the durability
+    ordering around it — snapshot referencing the new base, fsync'd
+    journal record, only then old-artifact cleanup — so kill -9 at any
+    point leaves either the old snapshot + old base or the new pair,
+    both resumable (tools/obs_smoke.sh leg 13 pins this). The score
+    cache is dropped: the next scored refresh re-seeds over the new
+    base with one full pass. Returns ``base_out``."""
+    from sheep_tpu.io import csr as csr_mod
+    from sheep_tpu.io.edgestream import EdgeStream
+
+    pending = state.tomb_array(pending_only=True)
+    sp = obs.begin("compact", mode="rebase",
+                   pending_deletes=int(len(pending)))
+    try:
+        _compact_full(backend, state)
+        csr_mod.write_csr(base_out, state.survivor_stream(),
+                          n_vertices=state.n,
+                          chunk_edges=state.chunk_edges)
+        state.base = EdgeStream.open(base_out)
+        state.base_spec = base_out
+        state.adds = []
+        state.tombs = []
+        state.pending_tombs = []
+        state.stale_deletes = 0
+        _drop_score_cache(state)
+    finally:
+        sp.end()
+    state.compactions += 1
+    state.stats["compactions"] = state.compactions
+    state.stats["rebase"] = state.stats.get("rebase", 0) + 1
+    obs.event("compacted", mode="rebase", epoch=state.epoch,
+              compactions=state.compactions, base=base_out)
+    return base_out
 
 
 # ----------------------------------------------------------------------

@@ -133,6 +133,33 @@ class CsrGraph:
             self._indices[self._indptr[u]:self._indptr[u + 1]],
             dtype=np.int64)
 
+    def arcs_from(self, vertices: np.ndarray) -> tuple:
+        """Batch adjacency gather: all arcs leaving ``vertices``, as
+        ``(src, dst)`` int64 arrays (``len == sum of out-degrees``).
+        One vectorized fancy-index over the mmapped ``indices`` region —
+        the primitive the O(Δ) incremental scorer leans on to touch
+        only the changed-vertex neighborhoods instead of re-streaming
+        every edge."""
+        vs = np.asarray(vertices, dtype=np.int64).reshape(-1)
+        if not len(vs):
+            z = np.zeros(0, dtype=np.int64)
+            return z, z
+        indptr = self._indptr
+        starts = np.asarray(indptr[vs], dtype=np.int64)
+        counts = np.asarray(indptr[vs + 1], dtype=np.int64) - starts
+        total = int(counts.sum())
+        if total == 0:
+            z = np.zeros(0, dtype=np.int64)
+            return z, z
+        src = np.repeat(vs, counts)
+        # flat edge-ids: per-vertex start broadcast along its degree run
+        cum = np.zeros(len(vs), dtype=np.int64)
+        np.cumsum(counts[:-1], out=cum[1:])
+        eid = (np.arange(total, dtype=np.int64)
+               - np.repeat(cum, counts) + np.repeat(starts, counts))
+        dst = np.asarray(self._indices[eid], dtype=np.int64)
+        return src, dst
+
     # -- edge-id addressing (the EdgeStream seek primitive) ---------------
     def edge_slice(self, start: int, end: int) -> np.ndarray:
         """Edges with ids in ``[start, end)`` as an (end-start, 2) int64

@@ -13,9 +13,20 @@ the same ``SHEEP_IO_POLICY`` quarantine-or-raise contract as
 Layout::
 
     header:  magic b"SHEEPDLG" | u32 version | u32 header_len |
-             base_spec utf-8 (header_len - 16 bytes)
+             [v2+: u64 epoch_floor] |
+             base_spec utf-8 (header_len - fixed bytes)
     records: 24-byte little-endian records, appended forever:
              u64 u | u64 v | u32 epoch | u16 op | u16 flags
+
+Version 1 has no ``epoch_floor`` (implicitly 0). Version 2 carries
+the COMPACTION FLOOR (ISSUE 17): :meth:`DeltaLogWriter.rewrite_base`
+materializes the surviving multiset into a fresh base artifact and
+rewrites the log to an empty v2 log over it with ``epoch_floor`` =
+the last applied epoch, so replay history and the tombstone filter
+stay O(recent) while epoch numbering (the served idempotency key)
+keeps advancing monotonically across the rewrite. Writers emit v1
+whenever the floor is 0, so un-compacted logs stay readable by v1
+readers.
 
 ``op`` is 0 (ADD) or 1 (DEL); ``epoch`` is non-decreasing — one epoch
 is one applied delta batch (the unit of durability and idempotency for
@@ -59,8 +70,9 @@ from typing import Iterator, Optional
 import numpy as np
 
 MAGIC = b"SHEEPDLG"
-VERSION = 1
-HEADER_FIXED = 16  # magic + u32 version + u32 header_len
+VERSION = 2
+HEADER_FIXED = 16       # magic + u32 version + u32 header_len
+HEADER_FIXED_V2 = 24    # ... + u64 epoch_floor
 
 OP_ADD = 0
 OP_DEL = 1
@@ -78,23 +90,35 @@ def _quarantine_or_raise(msg: str, **fields) -> None:
     q(msg, **fields)
 
 
-def write_header(path: str, base_spec: str) -> None:
+def write_header(path: str, base_spec: str,
+                 epoch_floor: int = 0) -> None:
+    """Write a fresh log header (fsync'd). ``epoch_floor`` > 0 emits
+    the v2 layout; a floor of 0 stays on the v1 bytes so un-compacted
+    logs remain readable by v1 readers."""
     spec_b = base_spec.encode("utf-8")
     if not spec_b or len(spec_b) > MAX_BASE_SPEC_BYTES:
         raise ValueError(f"bad delta-log base spec ({len(spec_b)} bytes)")
-    header_len = HEADER_FIXED + len(spec_b)
+    epoch_floor = int(epoch_floor)
+    if epoch_floor < 0:
+        raise ValueError(f"negative epoch floor {epoch_floor}")
+    version = 2 if epoch_floor else 1
+    fixed = HEADER_FIXED_V2 if epoch_floor else HEADER_FIXED
+    header_len = fixed + len(spec_b)
     with open(path, "wb") as f:
         f.write(MAGIC)
-        f.write(np.uint32(VERSION).tobytes())
+        f.write(np.uint32(version).tobytes())
         f.write(np.uint32(header_len).tobytes())
+        if epoch_floor:
+            f.write(np.uint64(epoch_floor).tobytes())
         f.write(spec_b)
         f.flush()
         os.fsync(f.fileno())
 
 
 def read_header(path: str) -> dict:
-    """{"version", "base_spec", "header_len"}; raises ValueError on a
-    file that is not a delta log (wrong magic / impossible header)."""
+    """{"version", "base_spec", "header_len", "epoch_floor"}; raises
+    ValueError on a file that is not a delta log (wrong magic /
+    impossible header). ``epoch_floor`` is 0 for v1 logs."""
     with open(path, "rb") as f:
         fixed = f.read(HEADER_FIXED)
         if len(fixed) < HEADER_FIXED or fixed[:8] != MAGIC:
@@ -105,16 +129,24 @@ def read_header(path: str) -> dict:
         if version > VERSION:
             raise ValueError(f"{path}: delta log v{version} is newer "
                              f"than this reader (v{VERSION})")
-        if not (HEADER_FIXED <= header_len
-                <= HEADER_FIXED + MAX_BASE_SPEC_BYTES):
+        fixed_len = HEADER_FIXED_V2 if version >= 2 else HEADER_FIXED
+        if not (fixed_len <= header_len
+                <= fixed_len + MAX_BASE_SPEC_BYTES):
             raise ValueError(f"{path}: impossible delta-log header "
                              f"length {header_len}")
-        spec_b = f.read(header_len - HEADER_FIXED)
-        if len(spec_b) != header_len - HEADER_FIXED:
+        epoch_floor = 0
+        if version >= 2:
+            floor_b = f.read(8)
+            if len(floor_b) != 8:
+                raise ValueError(f"{path}: truncated delta-log header")
+            epoch_floor = int(np.frombuffer(floor_b, "<u8")[0])
+        spec_b = f.read(header_len - fixed_len)
+        if len(spec_b) != header_len - fixed_len:
             raise ValueError(f"{path}: truncated delta-log header")
     return {"version": version,
             "base_spec": spec_b.decode("utf-8"),
-            "header_len": header_len}
+            "header_len": header_len,
+            "epoch_floor": epoch_floor}
 
 
 class DeltaLogWriter:
@@ -132,6 +164,7 @@ class DeltaLogWriter:
                     f"{path} already logs deltas over "
                     f"{hdr['base_spec']!r}, not {base_spec!r}")
             self.base_spec = hdr["base_spec"]
+            self.epoch_floor = int(hdr.get("epoch_floor", 0))
             # resuming an appender needs ONE number: the final
             # record's epoch (epochs are validated non-decreasing, so
             # the tail record holds the max). O(1) seek on an intact
@@ -141,16 +174,19 @@ class DeltaLogWriter:
                 with open(path, "rb") as f:
                     f.seek(hdr["header_len"] + body - RECORD_BYTES)
                     tail = np.fromfile(f, dtype=RECORD_DTYPE, count=1)
-                self.last_epoch = int(tail["epoch"][0])
+                self.last_epoch = max(int(tail["epoch"][0]),
+                                      self.epoch_floor)
             else:
                 recs = DeltaLogReader(path).records()
-                self.last_epoch = int(recs["epoch"][-1]) \
-                    if len(recs) else 0
+                self.last_epoch = max(
+                    int(recs["epoch"][-1]) if len(recs) else 0,
+                    self.epoch_floor)
         else:
             if base_spec is None:
                 raise ValueError("a new delta log needs base_spec")
             write_header(path, base_spec)
             self.base_spec = base_spec
+            self.epoch_floor = 0
             self.last_epoch = 0
         self._f = open(path, "ab")
 
@@ -196,6 +232,48 @@ class DeltaLogWriter:
             self.append(dels, OP_DEL, epoch=epoch)
         self.last_epoch = epoch
         return epoch
+
+    def rewrite_base(self, base_out: str,
+                     n_vertices: Optional[int] = None) -> str:
+        """Full log compaction (ISSUE 17 tentpole): materialize the
+        SURVIVING multiset (base ∪ log) into a fresh CSR base artifact
+        at ``base_out``, then rewrite this log in place to an empty v2
+        log over that artifact with ``epoch_floor`` = the last applied
+        epoch. Replay history and the tombstone filter become
+        O(recent); epoch numbering keeps advancing (the next appended
+        epoch is ``floor + 1``), so served idempotency keys survive
+        the rewrite.
+
+        Crash discipline (same tmp + rename story as resultstore): the
+        base artifact lands atomically FIRST; the log header rewrite
+        lands atomically second and is the commit point. Kill -9
+        before it: old base_spec + full log, untouched. After it:
+        fresh pair. Nothing in between is ever visible. The old base
+        artifact is NOT deleted here — the caller owns old-artifact
+        cleanup because only it knows whether the old base is a
+        user-supplied input or a previous rewrite's product."""
+        from sheep_tpu.io import csr as csr_mod
+
+        stream = DeltaLogStream(self.path)
+        n = stream.num_vertices if n_vertices is None \
+            else int(n_vertices)
+        csr_mod.write_csr(base_out, stream, n_vertices=n)
+        floor = max(self.last_epoch, stream.epoch)
+        tmp = self.path + ".rewrite.tmp"
+        write_header(tmp, base_out, epoch_floor=floor)
+        self.close()
+        os.replace(tmp, self.path)
+        dfd = os.open(os.path.dirname(os.path.abspath(self.path))
+                      or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        self._f = open(self.path, "ab")
+        self.base_spec = base_out
+        self.epoch_floor = floor
+        self.last_epoch = floor
+        return base_out
 
     def close(self) -> None:
         try:
@@ -280,7 +358,8 @@ class DeltaLogReader:
     @property
     def max_epoch(self) -> int:
         recs = self.records()
-        return int(recs["epoch"][-1]) if len(recs) else 0
+        floor = int(self.header.get("epoch_floor", 0))
+        return max(int(recs["epoch"][-1]) if len(recs) else 0, floor)
 
     def epochs(self, start_epoch: int = 0,
                up_to: Optional[int] = None) -> Iterator[tuple]:
@@ -434,10 +513,17 @@ class DeltaLogStream:
             raise ValueError(f"{path}: delta logs do not nest")
         self.base = open_input(self.base_spec)
         self.up_to = up_to
+        floor = int(self.reader.header.get("epoch_floor", 0))
+        if up_to is not None and up_to < floor:
+            raise ValueError(
+                f"{path}: epoch {up_to} predates the compaction "
+                f"floor {floor} — that history was rewritten into "
+                f"the base (rewrite_base)")
         recs = self.reader.records()
         if up_to is not None:
             recs = recs[recs["epoch"] <= up_to]
-        self.epoch = int(recs["epoch"][-1]) if len(recs) else 0
+        self.epoch = max(int(recs["epoch"][-1]) if len(recs) else 0,
+                         floor)
         self.adds, self.tombs = net_effect(recs)
         n = int(self.base.num_vertices)
         if len(self.adds):

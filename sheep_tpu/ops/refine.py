@@ -208,6 +208,34 @@ def _move_accounting(gain, before, after, parity: int, n: int):
     return wanted, applied
 
 
+def move_rescore_host(src, dst, prev, new, in_changed) -> int:
+    """Exact edge-cut delta of a batch of part moves, from the moved
+    vertices' arcs alone — the incremental scorer's move accounting
+    (ISSUE 17), same vocabulary as :func:`_move_accounting` but over
+    a symmetrized adjacency gather instead of a full stream pass.
+
+    ``(src, dst)`` are every surviving arc LEAVING the changed set
+    (``in_changed[src]`` all true is not required — arcs are masked
+    here); ``prev``/``new`` the before/after assignments;
+    ``in_changed`` a bool[V] mask of vertices whose label moved. Edges
+    with both endpoints changed appear as two arcs; their (symmetric)
+    contribution is halved, which is exact in integers because that
+    partial sum is even. Self-loop arcs contribute 0 on both sides of
+    the difference, so they need no special casing."""
+    s = np.asarray(src)
+    d = np.asarray(dst)
+    if not len(s):
+        return 0
+    keep = in_changed[s]
+    s, d = s[keep], d[keep]
+    diff = ((new[s] != new[d]).astype(np.int64)
+            - (prev[s] != prev[d]).astype(np.int64))
+    both = in_changed[d]
+    twice = int(diff[both].sum())
+    assert twice % 2 == 0  # symmetric arcs: the both-changed sum is even
+    return int(diff[~both].sum()) + twice // 2
+
+
 def spool_stream(stream, n: int, chunk_edges: int = 1 << 22,
                  spool_dir: str = None):
     """Materialize a regeneration-expensive stream to a temp binary file

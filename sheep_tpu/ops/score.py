@@ -137,6 +137,25 @@ def part_loads_accounting(assign, k: int, weights=None,
     return out
 
 
+def edge_effect_host(edges, assignments: dict, n: int) -> tuple:
+    """Host twin of :func:`score_chunk` for O(Δ) delta accounting
+    (ISSUE 17 incremental scoring): ``(valid_count, {k: cut_count})``
+    of one delta batch under EXISTING assignments. Same validity mask
+    as the streamed scorers — endpoints in [0, n), no self-loops — so
+    an incrementally maintained (cut, total) stays bit-equal to a full
+    ``score_stream`` pass over the mutated survivor multiset."""
+    import numpy as np
+
+    e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    u, v = e[:, 0], e[:, 1]
+    valid = (u >= 0) & (u < n) & (v >= 0) & (v < n) & (u != v)
+    cuts = {}
+    for k, a in assignments.items():
+        uc, vc = u[valid], v[valid]
+        cuts[k] = int(np.count_nonzero(a[uc] != a[vc]))
+    return int(np.count_nonzero(valid)), cuts
+
+
 def cut_pair_keys_host(chunk, assign, n: int, k: int):
     """Run cut_pairs on a (C, 2) or (D, C, 2) chunk and return the encoded
     int64 keys (vertex * k + foreign_part) on host — the shared comm-volume

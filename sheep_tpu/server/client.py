@@ -66,8 +66,19 @@ the live metrics gauges). A replica that dies while the job is being
 waited on gets the job re-submitted — ``reattach``-idempotent — to
 the next live replica; per-replica route counters land in the obs
 trace as ``fleet_route`` events. Fleet mode covers the submit family
-(``--wait`` / ``--watch`` included); admin verbs still address one
-replica via ``--server``.
+(``--wait`` / ``--watch`` included) and, since ISSUE 17, the
+resident verbs: ``--update`` / ``--epoch-of`` / ``--compact`` route
+to the replica OWNING the resident job (pinned after a status sweep)
+and deliberately never fail over — resident state is replica-local.
+Other admin verbs still address one replica via ``--server``.
+
+Chunked updates (ISSUE 17): :meth:`SheepClient.update` payloads too
+large for the 1 MiB request line switch automatically to a
+``begin`` / ``chunk`` / ``commit`` transaction over one connection,
+applied by the daemon as ONE epoch at commit — a single call streams
+an arbitrarily large epoch, and a client death mid-stream (no
+commit) leaves the resident at its prior epoch, retryable from
+scratch.
 
 CLI (fleet)::
 
@@ -90,6 +101,10 @@ import sys
 from typing import Optional
 
 from sheep_tpu.server import protocol
+
+# chunked-update slicing (ISSUE 17): 32768 edges base64-encode to
+# ~700 KiB — comfortably under protocol.MAX_REQUEST_BYTES per line
+UPDATE_CHUNK_EDGES = 32768
 
 
 def _connect(server: str, timeout_s: float) -> socket.socket:
@@ -189,6 +204,12 @@ class SheepClient:
         if op == "submit":
             return bool(doc.get("reattach"))
         if op == "update":
+            if doc.get("stream") is not None:
+                # chunked sub-verbs are transaction-scoped: resending
+                # one on a FRESH connection can only hit "unknown
+                # txn" — the whole-transaction retry in
+                # _update_chunked owns recovery instead
+                return False
             return doc.get("epoch") is not None \
                 or doc.get("log") is not None
         return op not in ("shutdown", "compact")
@@ -273,14 +294,27 @@ class SheepClient:
     # -- resident-partition verbs (ISSUE 15) ---------------------------
     def update(self, job_id: str, adds=None, dels=None,
                epoch: Optional[int] = None, score: bool = False,
-               compact: str = "auto",
-               log: Optional[str] = None) -> dict:
+               compact: str = "auto", log: Optional[str] = None,
+               chunk_edges: Optional[int] = None) -> dict:
         """Stream one delta epoch at a resident partition: ``adds`` /
-        ``dels`` are (m, 2) edge arrays (base64 on the wire, bounded
-        by the 1 MiB request line), or ``log`` names a DAEMON-side
-        delta log whose epochs past the resident epoch all apply.
-        Explicit ``epoch`` numbers make the call idempotent (an
-        already-applied epoch answers ``applied: false``)."""
+        ``dels`` are (m, 2) edge arrays (base64 on the wire), or
+        ``log`` names a DAEMON-side delta log whose epochs past the
+        resident epoch all apply. Explicit ``epoch`` numbers make the
+        call idempotent (an already-applied epoch answers
+        ``applied: false``).
+
+        Payloads too large for the 1 MiB request line switch to the
+        chunked wire form automatically (ISSUE 17): one begin /
+        chunk* / commit transaction over this connection, applied by
+        the daemon as ONE epoch at commit — so a single call streams
+        an arbitrarily large epoch. ``chunk_edges`` overrides the
+        per-chunk edge count (default ``UPDATE_CHUNK_EDGES``)."""
+        ce = int(chunk_edges) if chunk_edges else UPDATE_CHUNK_EDGES
+        n = (0 if adds is None else len(adds)) \
+            + (0 if dels is None else len(dels))
+        if log is None and n > ce:
+            return self._update_chunked(job_id, adds, dels, epoch,
+                                        score, compact, ce)
         req = {"op": "update", "job_id": job_id,
                "score": bool(score), "compact": compact}
         if adds is not None:
@@ -292,6 +326,45 @@ class SheepClient:
         if log is not None:
             req["log"] = log
         return self.request(req)
+
+    def _update_chunked(self, job_id: str, adds, dels, epoch,
+                        score: bool, compact: str,
+                        chunk_edges: int) -> dict:
+        """One chunked update transaction. Retries (when armed AND the
+        epoch is explicit, i.e. idempotent) restart from ``begin``:
+        transactions are connection-scoped, so a transport drop
+        anywhere mid-stream discards the staged chunks server-side
+        and the only safe resume point is a fresh transaction."""
+        pol = self._policy() if self.reconnect > 0 \
+            and epoch is not None else None
+        while True:
+            try:
+                txn = self.request({"op": "update", "job_id": job_id,
+                                    "stream": "begin"})["txn"]
+                for key, arr in (("adds", adds), ("dels", dels)):
+                    if arr is None:
+                        continue
+                    for lo in range(0, len(arr), chunk_edges):
+                        part = arr[lo:lo + chunk_edges]
+                        self.request({
+                            "op": "update", "stream": "chunk",
+                            "txn": txn,
+                            key: protocol.encode_edges(part)})
+                commit = {"op": "update", "stream": "commit",
+                          "txn": txn, "score": bool(score),
+                          "compact": compact}
+                if epoch is not None:
+                    commit["epoch"] = int(epoch)
+                return self.request(commit)
+            except (OSError, ServerError) as e:
+                if isinstance(e, ServerError) \
+                        and "connection closed" not in str(e) \
+                        and "unknown update txn" not in str(e):
+                    raise  # a real daemon answer, not a torn stream
+                if pol is None:
+                    raise
+                self._drop()
+                self._retry_or_raise(pol, e, "update.stream")
 
     def epoch(self, job_id: str) -> dict:
         """Resident-partition epoch/staleness descriptor."""
@@ -395,6 +468,10 @@ class FleetClient:
         # Keyed by BOTH because daemon job ids are per-process
         # counters: two replicas routinely mint the same "j1".
         self._jobs: dict = {}
+        # job_id -> endpoint pins for the resident verbs (ISSUE 17):
+        # resident state is replica-local, so update/epoch/compact
+        # must keep hitting the owning replica and NEVER fail over
+        self._resident: dict = {}
 
     def close(self) -> None:
         for c in self._clients.values():
@@ -597,6 +674,87 @@ class FleetClient:
     def result_assignment(self, job: dict, k: Optional[int] = None):
         return SheepClient.result_assignment(self, job, k)
 
+    # -- resident-partition verbs across the fleet (ISSUE 17) ----------
+    def _locate_resident(self, job) -> "tuple":
+        """Pin the replica owning a resident job.
+
+        The handle is a submit descriptor (its ``endpoint`` pins
+        directly) or a bare id, resolved by sweeping every replica's
+        ``status`` — exactly one owner pins it, zero or several is an
+        error. Unlike the submit family these verbs NEVER fail over:
+        the resident table lives in the owning replica's memory and
+        state dir, so another replica cannot answer for it."""
+        if isinstance(job, dict):
+            ep, jid = job.get("endpoint"), job.get("job_id")
+            if ep is not None and jid is not None:
+                self._resident[jid] = ep
+                return ep, jid
+            job = jid
+        job_id = str(job)
+        ep = self._resident.get(job_id)
+        if ep is not None:
+            return ep, job_id
+        owners = []
+        for cand in self.endpoints:
+            try:
+                self._client(cand).status(job_id)
+                owners.append(cand)
+            except ServerError:
+                continue  # live replica, doesn't know the job
+            except (OSError, json.JSONDecodeError):
+                continue  # dead replica: nothing servable there
+        if not owners:
+            raise ServerError(
+                f"no live replica knows job {job_id!r} (swept "
+                f"{','.join(self.endpoints)}); resident partitions "
+                f"are replica-local — if the owning replica died, "
+                f"restart it (durable daemons resume residents) or "
+                f"resubmit --resident elsewhere")
+        if len(owners) > 1:
+            raise ServerError(
+                f"job id {job_id!r} is ambiguous across replicas "
+                f"({', '.join(owners)}) — daemon job ids are "
+                f"per-process counters; pass the submit descriptor "
+                f"(it carries the endpoint) instead of the bare id")
+        self._resident[job_id] = owners[0]
+        return owners[0], job_id
+
+    def _resident_call(self, job, fn):
+        ep, job_id = self._locate_resident(job)
+        try:
+            return fn(self._client(ep), job_id)
+        except (OSError, json.JSONDecodeError) as e:
+            self._resident.pop(job_id, None)
+            raise ServerError(
+                f"replica {ep} owning resident job {job_id} went "
+                f"away mid-request ({e}); resident state is "
+                f"replica-local so this verb cannot fail over — "
+                f"restart that replica (a durable daemon resumes its "
+                f"resident partitions at their last epoch) and "
+                f"retry") from e
+
+    def update(self, job, adds=None, dels=None,
+               epoch: Optional[int] = None, score: bool = False,
+               compact: str = "auto", log: Optional[str] = None,
+               chunk_edges: Optional[int] = None) -> dict:
+        """Apply a delta epoch to a resident job's OWNING replica
+        (pinned; see :meth:`_locate_resident`). Signature and chunked
+        streaming as :meth:`SheepClient.update`."""
+        return self._resident_call(
+            job, lambda c, jid: c.update(
+                jid, adds=adds, dels=dels, epoch=epoch, score=score,
+                compact=compact, log=log, chunk_edges=chunk_edges))
+
+    def epoch(self, job) -> dict:
+        return self._resident_call(
+            job, lambda c, jid: c.epoch(jid))
+
+    def compact(self, job, mode: str = "auto",
+                score: bool = False) -> dict:
+        return self._resident_call(
+            job, lambda c, jid: c.compact(jid, mode=mode,
+                                          score=score))
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -608,9 +766,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fleet mode: comma list of replica addresses; "
                         "submits route to a result-cache digest hit "
                         "first, else the least-loaded replica, with "
-                        "failover resubmission if a replica dies "
-                        "(submit family only — admin verbs use "
-                        "--server)")
+                        "failover resubmission if a replica dies. "
+                        "Resident verbs (--update/--epoch-of/"
+                        "--compact) route to the replica OWNING the "
+                        "job and never fail over; other admin verbs "
+                        "use --server")
     p.add_argument("--input", help="graph path or synthetic spec "
                                    "(as the main CLI's --input)")
     p.add_argument("--k", help="part count, or comma list for multi-k "
@@ -682,11 +842,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compact", metavar="JOB", default=None,
                    help="compact a resident partition's tombstones")
     p.add_argument("--compact-mode", default="auto",
-                   choices=["auto", "full", "subtree"],
+                   choices=["auto", "full", "subtree", "rebase"],
                    help="with --compact: full re-anchors and rebuilds "
                         "everything (exact), subtree repairs only the "
-                        "dirty tree-split parts (score-bounded), auto "
-                        "picks (default)")
+                        "dirty tree-split parts (score-bounded), "
+                        "rebase additionally rewrites base+deltas "
+                        "into a fresh on-disk artifact (durable "
+                        "daemons only; explicit opt-in), auto picks "
+                        "between full/subtree (default)")
     p.add_argument("--status", metavar="JOB")
     p.add_argument("--cancel", metavar="JOB")
     p.add_argument("--stats", action="store_true")
@@ -762,9 +925,12 @@ def main(argv=None) -> int:
                 "--update, --epoch-of, --compact, --shutdown")
     if bool(args.server) == bool(args.endpoints):
         p.error("pass exactly one of --server or --endpoints")
-    if args.endpoints and not args.input:
-        p.error("--endpoints (fleet mode) routes submits; point "
-                "--server at one replica for admin verbs")
+    if args.endpoints and not (args.input or args.update
+                               or args.epoch_of or args.compact):
+        p.error("--endpoints (fleet mode) covers submits and the "
+                "resident verbs (--update/--epoch-of/--compact, "
+                "routed to the replica owning the job); point "
+                "--server at one replica for other admin verbs")
     if args.update and not args.deltas:
         p.error("--update needs --deltas LOG")
     reconnect = args.reconnect if args.reconnect is not None \

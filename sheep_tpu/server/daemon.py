@@ -42,6 +42,7 @@ import signal
 import socket
 import sys
 import threading
+from typing import Optional
 
 from sheep_tpu.server import protocol
 
@@ -265,6 +266,11 @@ class Daemon:
     def _handle(self, conn: socket.socket) -> None:
         with conn:
             rf = conn.makefile("rb")
+            # chunked-update staging (ISSUE 17): transactions live on
+            # THIS connection's stack frame and nowhere else — a client
+            # dying mid-stream (no commit) drops its uncommitted chunks
+            # with the frame, leaving the resident at its prior epoch
+            txns: dict = {}
             try:
                 while True:
                     try:
@@ -279,7 +285,7 @@ class Daemon:
                         continue
                     try:
                         req = protocol.parse_request(line)
-                        resp = self._dispatch(req)
+                        resp = self._dispatch(req, txns=txns)
                     except protocol.ProtocolError as e:
                         resp = {"ok": False, "error": str(e)}
                     except Exception as e:  # noqa: BLE001 — answered
@@ -294,9 +300,12 @@ class Daemon:
                 rf.close()
 
     # -- ops -----------------------------------------------------------
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req: dict,
+                  txns: Optional[dict] = None) -> dict:
         op = req["op"]
         sched = self.scheduler
+        if op == "update" and req.get("stream") is not None:
+            return self._update_stream(req, txns)
         if op == "ping":
             return {"ok": True, "pid": os.getpid(),
                     "uptime_s": sched.stats()["uptime_s"]}
@@ -411,6 +420,89 @@ class Daemon:
             self._shutdown_evt.set()
             return {"ok": True, "draining": drain}
         raise protocol.ProtocolError(f"unhandled op {op!r}")
+
+    def _update_stream(self, req: dict,
+                       txns: Optional[dict]) -> dict:
+        """Chunked ``update`` framing (ISSUE 17).
+
+        Staged payloads live in ``txns`` — the calling connection's
+        dict — so a torn stream (client death, no commit) is discarded
+        with the connection and changes nothing server-side. Only
+        ``commit`` touches the scheduler, and it does so through the
+        exact same ``sched.update`` path as a single-shot update.
+        """
+        import numpy as np
+
+        if txns is None:
+            raise protocol.ProtocolError(
+                "chunked update is connection-scoped")
+        verb = req.get("stream")
+        if verb not in protocol.UPDATE_STREAM_VERBS:
+            raise protocol.ProtocolError(
+                f"update.stream must be one of "
+                f"{protocol.UPDATE_STREAM_VERBS}, got {verb!r}")
+        if verb == "begin":
+            job_id = req.get("job_id")
+            if not job_id:
+                raise protocol.ProtocolError(
+                    "update stream begin needs job_id")
+            txns["seq"] = txns.get("seq", 0) + 1
+            txn = f"u{txns['seq']}"
+            txns.setdefault("open", {})[txn] = {
+                "job_id": job_id, "adds": [], "dels": [], "bytes": 0}
+            return {"ok": True, "txn": txn, "job_id": job_id}
+        txn = req.get("txn")
+        st = txns.get("open", {}).get(txn)
+        if st is None:
+            raise protocol.ProtocolError(
+                f"unknown update txn {txn!r} (transactions are "
+                f"connection-scoped: begin/chunk/commit must share "
+                f"one connection)")
+        if verb == "abort":
+            del txns["open"][txn]
+            return {"ok": True, "txn": txn, "aborted": True}
+        if verb == "chunk":
+            adds = protocol.decode_edges(req.get("adds")) \
+                if req.get("adds") is not None else None
+            dels = protocol.decode_edges(req.get("dels")) \
+                if req.get("dels") is not None else None
+            if adds is None and dels is None:
+                raise protocol.ProtocolError(
+                    "update stream chunk needs adds and/or dels")
+            nbytes = 16 * ((0 if adds is None else len(adds)) +
+                           (0 if dels is None else len(dels)))
+            if st["bytes"] + nbytes > protocol.MAX_UPDATE_TXN_BYTES:
+                del txns["open"][txn]  # poisoned — force a fresh begin
+                raise protocol.ProtocolError(
+                    f"update txn {txn} exceeds "
+                    f"{protocol.MAX_UPDATE_TXN_BYTES} staged bytes; "
+                    f"txn aborted")
+            if adds is not None and len(adds):
+                st["adds"].append(adds)
+            if dels is not None and len(dels):
+                st["dels"].append(dels)
+            st["bytes"] += nbytes
+            return {"ok": True, "txn": txn,
+                    "adds": int(sum(len(a) for a in st["adds"])),
+                    "dels": int(sum(len(d) for d in st["dels"]))}
+        # commit: fold every staged chunk as ONE epoch
+        del txns["open"][txn]
+        adds = np.concatenate(st["adds"]) if st["adds"] else None
+        dels = np.concatenate(st["dels"]) if st["dels"] else None
+        if adds is None and dels is None:
+            raise protocol.ProtocolError(
+                f"update txn {txn} committed with no staged edges")
+        epoch = req.get("epoch")
+        if epoch is not None:
+            try:
+                epoch = int(epoch)
+            except (TypeError, ValueError):
+                raise protocol.ProtocolError(
+                    "update.epoch must be an integer") from None
+        return {"ok": True, "txn": txn, **self.scheduler.update(
+            st["job_id"], adds=adds, dels=dels, epoch=epoch,
+            score=bool(req.get("score", False)),
+            compact=str(req.get("compact", "auto")))}
 
     # -- lifecycle -----------------------------------------------------
     def serve(self) -> int:

@@ -558,6 +558,15 @@ class JobEngine:
                     es, spec.ks, spec.weights, spec.alpha, cs,
                     "sheepd", pos_host, deg_host, minp_host, total,
                     base_spec=spec.input)
+                # seed the incremental score cache from the build's
+                # own full scoring pass (ISSUE 17): the tenant's
+                # FIRST scored epoch is then O(delta) too, instead of
+                # paying a seeding O(E) pass on the update path. Best
+                # effort — a failed seed just means refresh() stays
+                # on full passes until one seeds it.
+                inc_mod._seed_score_cache(
+                    job.incremental_state, assigns,
+                    {k: (cut[k], total) for k in spec.ks})
 
         from sheep_tpu.core import pure
 

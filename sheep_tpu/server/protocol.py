@@ -21,6 +21,12 @@ Requests (``op`` selects):
     {"op": "update",  "job_id": "j3", "adds": {edges b64},
      "dels": {edges b64}, "epoch": 7, "score": false}
     {"op": "update",  "job_id": "j3", "log": "/path/g.dlog"}
+    {"op": "update",  "job_id": "j3", "stream": "begin"}
+    {"op": "update",  "txn": "u1", "stream": "chunk",
+     "adds": {edges b64}, "dels": {edges b64}}
+    {"op": "update",  "txn": "u1", "stream": "commit", "epoch": 7,
+     "score": false, "compact": "auto"}
+    {"op": "update",  "txn": "u1", "stream": "abort"}
     {"op": "epoch",   "job_id": "j3"}
     {"op": "compact", "job_id": "j3", "mode": "auto", "score": false}
     {"op": "shutdown", "drain": false, "suspend": false}
@@ -45,10 +51,28 @@ resident epoch all apply). Explicit ``epoch`` numbers make updates
 IDEMPOTENT: an epoch at or below the resident epoch answers
 ``applied: false`` without refolding — the retry/replay contract.
 ``epoch`` queries the resident epoch/staleness; ``compact`` runs the
-tombstone compaction (``mode`` auto/full/subtree). On a durable
-daemon every applied epoch checkpoints the resident state and
-journals a ``delta_epoch`` record, so a SIGKILL'd daemon resumes the
-resident partition at its last applied epoch bit-identically.
+tombstone compaction (``mode`` auto/full/subtree, plus ``rebase`` on
+a durable daemon: full compaction that REWRITES the base into a fresh
+CSR artifact under the checkpoint dir, so the tombstone filter and
+anchored history stay O(recent)). On a durable daemon every applied
+epoch checkpoints the resident state and journals a ``delta_epoch``
+record, so a SIGKILL'd daemon resumes the resident partition at its
+last applied epoch bit-identically.
+
+Chunked update framing (ISSUE 17): one epoch larger than the 1 MiB
+request line streams through ``update`` sub-verbs selected by
+``stream``. ``begin`` (carries ``job_id``) opens a transaction and
+answers ``{"txn": "u1"}``; any number of ``chunk`` requests append
+inline ``adds``/``dels`` payloads (each request still under the line
+cap) to that txn; ``commit`` applies the accumulated delta as ONE
+epoch through the normal update path (same answer shape, same
+idempotent ``epoch`` semantics) and ``abort`` discards it.
+Transactions are connection-scoped and staged host-side only: a
+client that dies mid-stream (no commit) changes NOTHING — the
+resident stays at its prior epoch and the whole txn is idempotently
+retryable from ``begin``. Accumulation per txn is capped
+(:data:`MAX_UPDATE_TXN_BYTES`) so a runaway stream cannot balloon the
+daemon's host memory.
 
 Durability verbs (ISSUE 14): ``submit`` with ``"reattach": true`` is
 IDEMPOTENT — the daemon digests the spec (plus the input's content
@@ -127,6 +151,12 @@ OPS = ("ping", "submit", "status", "wait", "cancel", "list", "stats",
        "lookup")
 
 MAX_REQUEST_BYTES = 1 << 20  # one request line; jobs are specs, not data
+
+# chunked-update framing (ISSUE 17): the sub-verbs of {"op": "update",
+# "stream": ...} and the per-transaction staging cap — 256 MiB of raw
+# edge payload (16 bytes/edge, ~16M edges) per uncommitted txn
+UPDATE_STREAM_VERBS = ("begin", "chunk", "commit", "abort")
+MAX_UPDATE_TXN_BYTES = 256 << 20
 
 
 class ProtocolError(ValueError):

@@ -373,6 +373,18 @@ class Scheduler:
             "sheep_compactions_total",
             "resident-partition compactions (tombstone repair)",
             ("tenant", "mode"))
+        # ---- O(delta) plane (ISSUE 17): streamed epochs + fairness --
+        self._m_update_throttled = self.metrics.counter(
+            "sheepd_update_throttled_total",
+            "update items deferred to a later dispatch cycle by the "
+            "per-tenant byte budget", ("tenant",))
+        self._m_update_score = self.metrics.histogram(
+            "sheepd_update_score_seconds",
+            "scored-refresh wall per update epoch (incremental "
+            "rescoring makes this O(delta), not O(edges))",
+            ("tenant",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
         # ---- quality plane (ISSUE 13): partition QUALITY is a live,
         # scrapeable series, not just a number in a result payload —
         # per-tenant cut/balance distributions at DONE, plus per-job
@@ -1108,6 +1120,14 @@ class Scheduler:
 
     def _submit_item(self, item: dict, timeout_s: float) -> dict:
         item["evt"] = threading.Event()
+        # fairness bookkeeping (ISSUE 17): every queued item carries
+        # its tenant and payload size so _service_updates can enforce
+        # per-tenant byte budgets without re-locking the job table
+        nb = 0
+        for k in ("adds", "dels"):
+            if item.get(k) is not None:
+                nb += 16 * len(item[k])
+        item["bytes"] = nb
         with self._lock:
             if self._stop or self._suspending:
                 raise protocol.ProtocolError("daemon is shutting down")
@@ -1115,6 +1135,7 @@ class Scheduler:
             if job is None:
                 raise protocol.ProtocolError(
                     f"unknown job {item['job_id']!r}")
+            item["tenant"] = job.spec.tenant
             self._updates.append(item)
             self._cond.notify_all()
         if not item["evt"].wait(timeout=timeout_s):
@@ -1144,14 +1165,45 @@ class Scheduler:
 
     def _service_updates(self) -> None:
         """Dispatch-thread drain of the resident-partition work queue
-        (between job-step cycles, same thread as every device fold)."""
+        (between job-step cycles, same thread as every device fold).
+
+        Fairness (ISSUE 17): ``SHEEP_UPDATE_BYTES_PER_CYCLE`` caps the
+        delta bytes each tenant may fold per drain cycle. A tenant
+        streaming huge epochs exhausts its budget and its remaining
+        items are DEFERRED to the next cycle (counted in
+        ``sheepd_update_throttled_total``), letting other tenants' —
+        and the build queue's — work interleave. Budgets reset every
+        cycle, so deferred items always make progress; unset or 0
+        means unlimited (the pre-ISSUE-17 FIFO drain)."""
+        try:
+            budget = int(os.environ.get(
+                "SHEEP_UPDATE_BYTES_PER_CYCLE", "0") or "0")
+        except ValueError:
+            budget = 0
+        spent: dict = {}
         while True:
             with self._lock:
-                if not self._updates:
+                item = None
+                for i, it in enumerate(self._updates):
+                    t = it.get("tenant", "default")
+                    if budget <= 0 or spent.get(t, 0) < budget \
+                            or it.get("abandoned"):
+                        item = it
+                        del self._updates[i]
+                        break
+                if item is None:
+                    # every queued tenant exhausted its cycle budget:
+                    # leave the rest queued, one throttle tick per
+                    # deferred item, pick them up next cycle
+                    for it in self._updates:
+                        self._m_update_throttled.inc(
+                            tenant=it.get("tenant", "default"))
                     return
-                item = self._updates.popleft()
                 if item.get("abandoned"):
                     continue  # its waiter already gave up
+                spent[item.get("tenant", "default")] = \
+                    spent.get(item.get("tenant", "default"), 0) \
+                    + int(item.get("bytes", 0))
             try:
                 with self.flight.job_context(item["job_id"]):
                     item["result"] = self._do_item(item)
@@ -1269,18 +1321,34 @@ class Scheduler:
         backend = self._update_backend_for(job)
         if item["kind"] == "compact":
             t0 = time.perf_counter()
-            mode = incremental.compact_state(backend, state,
-                                             mode=item["mode"])
+            old_base = None
+            if item["mode"] == "rebase":
+                mode, old_base = self._rebase_resident(state, job,
+                                                       backend)
+            else:
+                mode = incremental.compact_state(backend, state,
+                                                 mode=item["mode"])
             if mode != "noop":
                 self._m_compactions.inc(tenant=tenant, mode=mode)
             out = {"job_id": job.id, "mode": mode,
                    "epoch": int(state.epoch),
                    "compactions": int(state.compactions),
                    "wall_s": round(time.perf_counter() - t0, 4)}
+            if mode == "rebase":
+                out["base"] = state.base_spec
             if item.get("score"):
                 out["results"] = self._refresh_results(
                     backend, state, job)
             self._persist_resident(job)
+            if old_base is not None:
+                # drop the superseded rebase artifact only AFTER the
+                # snapshot + journal referencing the new base are
+                # durable — a crash in between leaves both bases on
+                # disk, never neither
+                try:
+                    os.unlink(old_base)
+                except OSError:
+                    pass
             return out
         # ---- update -------------------------------------------------
         t0 = time.perf_counter()
@@ -1323,7 +1391,10 @@ class Scheduler:
                "stale_deletes": int(state.stale_deletes),
                "compactions": int(state.compactions)}
         if item.get("score"):
+            ts = time.perf_counter()
             out["results"] = self._refresh_results(backend, state, job)
+            self._m_update_score.observe(time.perf_counter() - ts,
+                                         tenant=tenant)
         self._m_update_latency.observe(time.perf_counter() - t0,
                                        tenant=tenant)
         obs.event("job_update", job=job.id, tenant=tenant,
@@ -1350,6 +1421,35 @@ class Scheduler:
                       balance=round(float(r.balance), 4),
                       edge_cut=int(r.edge_cut))
         return [r.summary() for r in results]
+
+    def _rebase_resident(self, state, job: Job, backend):
+        """Compact mode ``rebase`` (ISSUE 17): rewrite the resident
+        base + folded deltas into a fresh CSR artifact under the
+        checkpoint dir, so the served partition's read path stops
+        paying for history. Explicit opt-in only — ``auto`` never
+        escalates to it. Returns ``("rebase", old_artifact_or_None)``;
+        the caller unlinks the superseded artifact only after the new
+        snapshot + journal record are durable."""
+        from sheep_tpu import incremental
+
+        if self.ckpt_dir is None:
+            raise protocol.ProtocolError(
+                "compact mode 'rebase' needs a durable daemon "
+                "(--state-dir / --checkpoint-dir): the rewritten "
+                "base is a disk artifact")
+        old = state.base_spec
+        base_out = os.path.join(
+            self.ckpt_dir, f"{job.id}.base.e{int(state.epoch)}.csr")
+        incremental.rebase_state(backend, state, base_out)
+        owned = None
+        if isinstance(old, str) and old != base_out \
+                and os.path.isfile(old) \
+                and os.path.dirname(os.path.abspath(old)) \
+                == os.path.abspath(self.ckpt_dir):
+            # only reap artifacts WE wrote (a prior rebase): a base
+            # outside the ckpt dir is user input, never ours to delete
+            owned = old
+        return "rebase", owned
 
     # ------------------------------------------------------------------
     # the dispatch loop (one thread)

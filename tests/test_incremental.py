@@ -63,7 +63,15 @@ class TestDeltaLog:
         dl.write_header(log, "base.bin64")
         hdr = dl.read_header(log)
         assert hdr["base_spec"] == "base.bin64"
-        assert hdr["version"] == dl.VERSION
+        # an un-compacted log (floor 0) stays on the v1 bytes so v1
+        # readers keep working; the v2 layout appears only once a
+        # compaction stamps an epoch floor
+        assert hdr["version"] == 1
+        assert hdr["epoch_floor"] == 0
+        dl.write_header(log, "base.bin64", epoch_floor=3)
+        hdr2 = dl.read_header(log)
+        assert hdr2["version"] == dl.VERSION
+        assert hdr2["epoch_floor"] == 3
 
     def test_not_a_delta_log(self, tmp_path):
         p = str(tmp_path / "junk")
@@ -609,3 +617,362 @@ def test_jobspec_resident_field():
     assert spec.resident is True
     assert JobSpec.from_request({"input": "x", "k": 4}).resident \
         is False
+
+
+# ----------------------------------------------------------------------
+# incremental scoring (ISSUE 17): O(Δ) rescoring bit-equals full passes
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("backend", _backends())
+def test_incremental_rescore_bit_equals_full(tmp_path, backend,
+                                             monkeypatch):
+    """Add / delete / compaction churn under SHEEP_SCORE_AUDIT (every
+    incremental rescore cross-checked against a full score_stream
+    pass, raising on ANY divergence), then the same state rescored
+    with the cache dropped: the two paths must be bit-equal — same
+    ints, same floats, not approx."""
+    monkeypatch.setenv("SHEEP_SCORE_AUDIT", "1")
+    e = _graph(4000)
+    base = _base_file(tmp_path, e[:2000])
+    be = get_backend(backend, chunk_edges=512)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), [4, 8], backend=be)
+    inc.refresh(be, state)  # the one-time full pass seeds the index
+    assert state.stats.get("score_full", 0) >= 1
+    rng = np.random.default_rng(11)
+    adds1 = rng.integers(0, N, (700, 2)).astype(np.int64)
+    adds1[:6] = adds1[6:12]        # duplicate adds
+    adds1[20, 1] = adds1[20, 0]    # self-loop
+    be.partition_update(state, adds=adds1, score=True)
+    dels = np.concatenate([
+        e[100:160], e[100:110],    # base hits + duplicated deletes
+        adds1[:30],                # cancel pending adds
+        np.array([[0, 0]], np.int64),            # self-loop delete
+        rng.integers(0, N, (20, 2)),             # mostly unmatched
+    ]).astype(np.int64)
+    be.partition_update(state, deletes=dels, score=True,
+                        compact="never")
+    be.partition_update(
+        state, adds=rng.integers(0, N, (400, 2)).astype(np.int64),
+        score=True, compact="force")  # compaction, then more churn
+    res_inc = be.partition_update(
+        state, adds=rng.integers(0, N, (200, 2)).astype(np.int64),
+        score=True)
+    assert state.stats.get("score_incremental", 0) >= 3
+    state._score = None  # drop the cache: force the full path
+    res_full = inc.refresh(be, state)
+    for a, b in zip(res_inc, res_full):
+        assert a.k == b.k
+        assert a.edge_cut == b.edge_cut
+        assert a.total_edges == b.total_edges
+        assert a.balance == b.balance
+        assert a.cut_ratio == b.cut_ratio
+        np.testing.assert_array_equal(a.assignment, b.assignment)
+
+
+def test_audit_catches_a_poisoned_cache(tmp_path, monkeypatch):
+    """Negative control: the audit must actually RUN and raise — a
+    deliberately corrupted cut accumulator cannot survive a scored
+    refresh under SHEEP_SCORE_AUDIT."""
+    e = _graph(2000)
+    base = _base_file(tmp_path, e)
+    be = get_backend("tpu", chunk_edges=512)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 4, backend=be)
+    inc.refresh(be, state)
+    assert state._score is not None and "prev" in state._score
+    state._score["cut"][4] += 1  # sabotage
+    monkeypatch.setenv("SHEEP_SCORE_AUDIT", "1")
+    with pytest.raises(RuntimeError, match="SHEEP_SCORE_AUDIT"):
+        inc.refresh(be, state)
+
+
+def test_comm_volume_requests_run_the_full_pass(tmp_path):
+    """comm_volume needs per-part neighbor sets the O(Δ) accumulators
+    don't carry: such a refresh takes the full pass (and re-seeds the
+    cache) instead of silently answering without the volume."""
+    e = _graph(1500)
+    base = _base_file(tmp_path, e)
+    be = get_backend("tpu", chunk_edges=512)
+    state, _ = inc.begin_incremental(
+        open_input(base, n_vertices=N), 4, backend=be)
+    inc.refresh(be, state)
+    f0 = state.stats["score_full"]
+    r = inc.refresh(be, state, comm_volume=True)
+    assert state.stats["score_full"] == f0 + 1
+    assert r.comm_volume is not None
+    # and the cache is re-seeded: the next plain refresh is O(Δ)
+    i0 = state.stats.get("score_incremental", 0)
+    inc.refresh(be, state)
+    assert state.stats["score_incremental"] == i0 + 1
+
+
+# ----------------------------------------------------------------------
+# log compaction (ISSUE 17): DeltaLogWriter.rewrite_base
+# ----------------------------------------------------------------------
+class TestRewriteBase:
+    def test_round_trip_floor_and_epoch_continuation(self, tmp_path):
+        e = _graph(1200)
+        base = _base_file(tmp_path, e[:600])
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append(e[600:900])
+            w.append_epoch(dels=e[:100])
+        with open_input(f"delta:{log}", n_vertices=N) as es:
+            before = np.sort(es.read_all().view("i8,i8"), axis=0)
+        nb = str(tmp_path / "rebased.csr")
+        with dl.DeltaLogWriter(log) as w:
+            w.rewrite_base(nb, n_vertices=N)
+            assert (w.base_spec, w.epoch_floor, w.last_epoch) \
+                == (nb, 2, 2)
+            w.append(e[900:1000])  # epochs continue PAST the floor
+            assert w.last_epoch == 3
+        hdr = dl.read_header(log)
+        assert hdr["version"] == 2
+        assert hdr["epoch_floor"] == 2
+        assert hdr["base_spec"] == nb
+        # the surviving multiset is preserved exactly
+        with open_input(f"delta:{log}", n_vertices=N) as es:
+            after = np.sort(es.read_all().view("i8,i8"), axis=0)
+        want = np.sort(np.concatenate(
+            [before.view(np.int64).reshape(-1, 2),
+             e[900:1000]]).view("i8,i8"), axis=0)
+        assert np.array_equal(after, want)
+        # readers respect the floor
+        r = dl.DeltaLogReader(log)
+        assert r.max_epoch == 3
+        assert [ep for ep, _, _ in r.epochs(start_epoch=2)] == [3]
+        with pytest.raises(ValueError, match="compaction floor"):
+            dl.DeltaLogStream(log, up_to=1)
+        # a reopened writer resumes past the floor, not at 0
+        with dl.DeltaLogWriter(log) as w2:
+            assert (w2.last_epoch, w2.epoch_floor) == (3, 2)
+        # and a build over the rewritten log still works end to end
+        # (total_edges counts VALID edges: self-loops score nothing)
+        be = get_backend("tpu", chunk_edges=512)
+        res = be.partition(open_input(f"delta:{log}", n_vertices=N),
+                           4, comm_volume=False)
+        aa = after.view(np.int64).reshape(-1, 2)
+        assert res.total_edges \
+            == int(np.count_nonzero(aa[:, 0] != aa[:, 1]))
+
+    def test_rewrite_equals_filtered_multiset(self, tmp_path):
+        """The rewritten base holds exactly filter_tombstones' answer
+        — matched tombstones remove ONE occurrence, unmatched remove
+        nothing — so duplicate and unmatched deletes round-trip."""
+        e = _graph(800)
+        dup = np.concatenate([e, e[:50]])  # duplicated edges
+        base = _base_file(tmp_path, dup)
+        log = str(tmp_path / "g.dlog")
+        dels = np.concatenate([e[:60], e[:10],  # 10 doubled deletes
+                               np.array([[N - 1, N - 1]], np.int64)])
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append_epoch(dels=dels)
+        nb = str(tmp_path / "rb.csr")
+        with dl.DeltaLogWriter(log) as w:
+            w.rewrite_base(nb, n_vertices=N)
+        with open_input(f"delta:{log}", n_vertices=N) as es:
+            got = np.sort(es.read_all().view("i8,i8"), axis=0)
+        surv = np.concatenate(list(dl.filter_tombstones([dup], dels)))
+        want = np.sort(surv.view("i8,i8"), axis=0)
+        assert np.array_equal(got, want)
+
+    def test_leftover_rewrite_tmp_is_harmless(self, tmp_path):
+        """A crash BEFORE the header os.replace leaves `.rewrite.tmp`
+        beside an untouched v1 log: readers and writers ignore it."""
+        e = _graph(300)
+        base = _base_file(tmp_path, e)
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append(e[:20])
+        with open(log + ".rewrite.tmp", "wb") as f:
+            f.write(b"torn header bytes")
+        r = dl.DeltaLogReader(log)
+        assert r.max_epoch == 1
+        assert r.header["epoch_floor"] == 0
+        with dl.DeltaLogWriter(log) as w2:
+            w2.append(e[20:40])
+            assert w2.last_epoch == 2
+
+
+# ----------------------------------------------------------------------
+# streaming delta framing (ISSUE 17): chunked update wire form
+# ----------------------------------------------------------------------
+def _start_daemon(tmp_path, *extra):
+    import time
+
+    from sheep_tpu.server.daemon import Daemon, build_parser
+
+    sock = str(tmp_path / "d.sock")
+    d = Daemon(build_parser().parse_args(["--socket", sock,
+                                          *extra]))
+    t = threading.Thread(target=d.serve, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(sock) and d.scheduler is not None:
+            return d, t, sock
+        time.sleep(0.05)
+    raise AssertionError("daemon never bound")
+
+
+def test_chunked_update_applies_one_epoch_and_torn_stream_is_noop(
+        tmp_path):
+    import json
+    import socket as socket_mod
+
+    from sheep_tpu.server import protocol
+    from sheep_tpu.server.client import SheepClient
+
+    e = _graph(3000)
+    base = _base_file(tmp_path, e[:1500])
+    d, t, sock = _start_daemon(tmp_path)
+    with SheepClient(sock, timeout_s=120) as c:
+        jid = c.submit(base, k=[4], tenant="inc", resident=True,
+                       chunk_edges=512, num_vertices=N)["job_id"]
+        assert c.wait(jid, timeout_s=120)["state"] == "done"
+        # tiny chunk_edges forces the chunked form: 1500 edges stream
+        # as 6 chunks, fold + score as ONE epoch at commit
+        r = c.update(jid, adds=e[1500:], epoch=1, score=True,
+                     chunk_edges=256)
+        assert r["applied"] and r["epoch"] == 1 and r["txn"]
+        assert r["epochs_applied"] == 1
+        # ...bit-identical to the one-shot build of the same delta
+        log = str(tmp_path / "g.dlog")
+        with dl.DeltaLogWriter(log, base_spec=base) as w:
+            w.append(e[1500:])
+        one = get_backend("tpu", chunk_edges=512).partition(
+            open_input(f"delta:{log}", n_vertices=N), 4,
+            comm_volume=False)
+        assert r["results"][0]["edge_cut"] == one.edge_cut
+        # idempotent chunked replay of an applied epoch
+        assert c.update(jid, adds=e[1500:], epoch=1,
+                        chunk_edges=256)["applied"] is False
+        # torn stream: begin + one chunk on a RAW connection, then
+        # the client dies with no commit — the resident must stay at
+        # its prior epoch, nothing staged survives the connection
+        s = socket_mod.socket(socket_mod.AF_UNIX)
+        s.connect(sock)
+        rf = s.makefile("rb")
+        s.sendall(protocol.dumps({"op": "update", "job_id": jid,
+                                  "stream": "begin"}))
+        txn = json.loads(rf.readline())["txn"]
+        s.sendall(protocol.dumps({
+            "op": "update", "stream": "chunk", "txn": txn,
+            "adds": protocol.encode_edges(e[:200])}))
+        assert json.loads(rf.readline())["adds"] == 200
+        rf.close()
+        s.close()  # torn: no commit ever sent
+        assert c.epoch(jid)["epoch"] == 1
+        # transactions are connection-scoped: the dead txn cannot be
+        # committed from anywhere else
+        from sheep_tpu.server.client import ServerError
+
+        with pytest.raises(ServerError, match="unknown update txn"):
+            c.request({"op": "update", "stream": "commit",
+                       "txn": txn, "epoch": 2})
+        with pytest.raises(ServerError, match="stream must be one"):
+            c.request({"op": "update", "stream": "flush",
+                       "job_id": jid})
+        with pytest.raises(ServerError, match="begin needs job_id"):
+            c.request({"op": "update", "stream": "begin"})
+        # abort discards explicitly
+        txn2 = c.request({"op": "update", "job_id": jid,
+                          "stream": "begin"})["txn"]
+        assert c.request({"op": "update", "stream": "abort",
+                          "txn": txn2})["aborted"] is True
+        # ...and the torn/aborted chunks changed nothing: the whole
+        # epoch 2 retries cleanly from scratch
+        r2 = c.update(jid, adds=e[:400], epoch=2, chunk_edges=128)
+        assert r2["applied"] and r2["epoch"] == 2
+        c.shutdown()
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+
+def test_chunked_update_txn_byte_cap(tmp_path, monkeypatch):
+    from sheep_tpu.server import protocol
+    from sheep_tpu.server.client import ServerError, SheepClient
+
+    e = _graph(1000)
+    base = _base_file(tmp_path, e[:500])
+    monkeypatch.setattr(protocol, "MAX_UPDATE_TXN_BYTES", 2048)
+    d, t, sock = _start_daemon(tmp_path)
+    with SheepClient(sock, timeout_s=120) as c:
+        jid = c.submit(base, k=[4], tenant="inc", resident=True,
+                       chunk_edges=512, num_vertices=N)["job_id"]
+        assert c.wait(jid, timeout_s=120)["state"] == "done"
+        txn = c.request({"op": "update", "job_id": jid,
+                         "stream": "begin"})["txn"]
+        with pytest.raises(ServerError, match="staged bytes"):
+            c.request({"op": "update", "stream": "chunk",
+                       "txn": txn,
+                       "adds": protocol.encode_edges(e[:200])})
+        # the oversized txn was aborted server-side
+        with pytest.raises(ServerError, match="unknown update txn"):
+            c.request({"op": "update", "stream": "commit",
+                       "txn": txn, "epoch": 1})
+        assert c.epoch(jid)["epoch"] == 0
+        c.shutdown()
+    t.join(timeout=60)
+
+
+# ----------------------------------------------------------------------
+# per-tenant fairness (ISSUE 17): byte budgets in the update drain
+# ----------------------------------------------------------------------
+def test_update_byte_budget_defers_backlog_and_counts(tmp_path,
+                                                      monkeypatch):
+    """White-box drain-cycle semantics: with a byte budget armed, one
+    _service_updates cycle admits a tenant's items only up to the
+    budget, DEFERS the rest (counted in
+    sheepd_update_throttled_total), and the deferred items complete
+    in later cycles because budgets reset per cycle."""
+    import time
+
+    from sheep_tpu.server.scheduler import Scheduler
+
+    # 1000-edge epochs are 16000 bytes: the first admitted item
+    # exhausts this budget for the cycle
+    monkeypatch.setenv("SHEEP_UPDATE_BYTES_PER_CYCLE", "4096")
+    e = _graph(3000)
+    base = _base_file(tmp_path, e[:1500])
+    sched = Scheduler()
+    job = sched.submit(_spec(base))
+    with sched._lock:
+        sched._admit_locked()
+    for _ in range(20000):  # drive the build inline: no dispatch
+        if job.state != "running":  # thread exists to race the drain
+            break
+        sched._step(job)
+    assert job.state == "done", (job.state, job.error)
+    results = []
+
+    def push(ep):
+        results.append(sched.update(job.id, adds=e[1500:2500],
+                                    epoch=ep, timeout_s=120))
+
+    ths = [threading.Thread(target=push, args=(ep,), daemon=True)
+           for ep in (1, 2, 3)]
+    for th in ths:
+        th.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with sched._lock:
+            if len(sched._updates) == 3:
+                break
+        time.sleep(0.01)
+    with sched._lock:
+        assert len(sched._updates) == 3
+    sched._service_updates()  # cycle 1: budget admits exactly one
+    with sched._lock:
+        left = len(sched._updates)
+    assert left == 2
+    assert 'sheepd_update_throttled_total{tenant="inc"} 2' \
+        in sched.render_metrics()
+    for _ in range(4):  # later cycles drain the rest (budget resets)
+        sched._service_updates()
+    with sched._lock:
+        assert not sched._updates
+    for th in ths:
+        th.join(timeout=120)
+    assert len(results) == 3
+    assert job.resident_state.epoch == 3

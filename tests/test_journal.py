@@ -592,3 +592,128 @@ def test_resident_release_survives_replay(tmp_path):
 
         with pytest.raises(ProtocolError, match="released"):
             sched2.epoch_info(jid)
+
+
+# ----------------------------------------------------------------------
+# rebase compaction + torn chunked streams (ISSUE 17)
+# ----------------------------------------------------------------------
+def test_rebase_compaction_rewrites_base_and_survives_restart(
+        tmp_path):
+    """compact mode='rebase': the base+log rewrite lands a fresh base
+    artifact under the checkpoint dir, scores identically to a clean
+    rebuild of the survivors, and the rebased resident survives a
+    daemon bounce — epochs keep counting past the compaction floor."""
+    from sheep_tpu.backends.base import get_backend
+    from sheep_tpu.io import deltalog as dl
+    from sheep_tpu.io.edgestream import open_input
+
+    jp, ck = durable_paths(tmp_path)
+    rng = np.random.default_rng(37)
+    n = 512
+    E = rng.integers(0, n, (3000, 2)).astype(np.int64)
+    base = str(tmp_path / "base.bin64")
+    with open(base, "wb") as f:
+        f.write(E[:1500].astype("<u8").tobytes())
+    sp = spec(input=base, ks=(4,), chunk_edges=CHUNK,
+              num_vertices=n, resident=True)
+
+    with running_scheduler(journal=jp, checkpoint_dir=ck,
+                           checkpoint_every=1) as sched:
+        job = sched.submit(sp)
+        assert sched.wait(job.id, timeout_s=120).state == "done"
+        jid = job.id
+        sched.update(jid, adds=E[1500:2400], epoch=1)
+        sched.update(jid, dels=E[200:500], epoch=2)
+        r = sched.compact_resident(jid, mode="rebase", score=True)
+        assert r["mode"] == "rebase"
+        newbase = r["base"]
+        assert os.path.dirname(newbase) == ck and os.path.isfile(
+            newbase)
+        # the rebased score IS a clean rebuild of the survivors
+        surv = np.concatenate(list(dl.filter_tombstones(
+            [E[:2400]], E[200:500])))
+        ref_file = str(tmp_path / "ref.bin64")
+        with open(ref_file, "wb") as f:
+            f.write(surv.astype("<u8").tobytes())
+        one = get_backend("tpu", chunk_edges=CHUNK).partition(
+            open_input(ref_file, n_vertices=n), 4, comm_volume=False)
+        assert r["results"][0]["edge_cut"] == one.edge_cut
+    # <- daemon gone; the rebase must already be durable
+
+    with running_scheduler(journal=jp, checkpoint_dir=ck,
+                           checkpoint_every=1) as sched2:
+        assert sched2.epoch_info(jid)["epoch"] == 2
+        # numbering continues past the floor after restart
+        r3 = sched2.update(jid, adds=E[2400:], epoch=3, score=True)
+        assert r3["applied"] and r3["epoch"] == 3
+
+
+def test_torn_chunked_stream_then_restart_is_retryable(tmp_path):
+    """A client that dies mid-chunked-stream (no commit) leaves the
+    resident at its prior epoch — across a daemon bounce too — and
+    the whole epoch retries cleanly as a fresh transaction."""
+    import socket as socket_mod
+
+    from sheep_tpu.server import protocol as proto
+    from sheep_tpu.server.client import SheepClient
+    from sheep_tpu.server.daemon import Daemon, build_parser
+
+    sock = str(tmp_path / "d.sock")
+    state = str(tmp_path / "state")
+    rng = np.random.default_rng(43)
+    n = 512
+    E = rng.integers(0, n, (3000, 2)).astype(np.int64)
+    base = str(tmp_path / "base.bin64")
+    with open(base, "wb") as f:
+        f.write(E[:1500].astype("<u8").tobytes())
+
+    def start_daemon():
+        d = Daemon(build_parser().parse_args(
+            ["--socket", sock, "--state-dir", state,
+             "--checkpoint-every", "1"]))
+        t = threading.Thread(target=d.serve, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists(sock) and d.scheduler is not None:
+                return d, t
+            time.sleep(0.05)
+        raise AssertionError("daemon never bound")
+
+    d1, t1 = start_daemon()
+    c = SheepClient(sock, timeout_s=120)
+    try:
+        jid = c.submit(base, k=[4], tenant="inc", resident=True,
+                       chunk_edges=CHUNK, num_vertices=n)["job_id"]
+        assert c.wait(jid, timeout_s=120)["state"] == "done"
+        assert c.update(jid, adds=E[1500:2000], epoch=1)["applied"]
+        # torn stream: begin + chunk on a raw connection, then die
+        s = socket_mod.socket(socket_mod.AF_UNIX)
+        s.connect(sock)
+        rf = s.makefile("rb")
+        s.sendall(proto.dumps({"op": "update", "job_id": jid,
+                               "stream": "begin"}))
+        txn = json.loads(rf.readline())["txn"]
+        s.sendall(proto.dumps({
+            "op": "update", "stream": "chunk", "txn": txn,
+            "adds": proto.encode_edges(E[2000:2600])}))
+        assert json.loads(rf.readline())["adds"] == 600
+        rf.close()
+        s.close()  # no commit, ever
+        assert c.epoch(jid)["epoch"] == 1
+        # bounce the daemon: staged chunks must not resurrect
+        d1.scheduler.shutdown_suspend(grace_s=60)
+        t1.join(timeout=120)
+        assert not t1.is_alive()
+        c._drop()
+        d2, t2 = start_daemon()
+        assert c.epoch(jid)["epoch"] == 1
+        # the whole epoch retries as a fresh chunked transaction
+        r = c.update(jid, adds=E[2000:2600], epoch=2, score=True,
+                     chunk_edges=128)
+        assert r["applied"] and r["epoch"] == 2 and r["txn"]
+        c.shutdown()
+        t2.join(timeout=60)
+        assert not t2.is_alive()
+    finally:
+        c.close()

@@ -16,7 +16,11 @@ each compared only when present in BOTH captures:
     host_syncs, device_rounds,        lower is better (relative rise
     host_blocked_ms, h2d_blocked_ms,  beyond --threshold regresses —
     update_request_s,                 the resident-partition delta-fold
-                                      wall (ISSUE 15);
+                                      wall (ISSUE 15), split since
+    update_fold_s, update_score_s,    ISSUE 17 into the device fold vs
+                                      the O(Δ) scored refresh (its
+                                      epoch_scale_x2 probe rides
+                                      info-only);
     warm_up_s, warm_request_s,        warm_up_s is the cold-request jit
                                       tax and warm_request_s the warm
                                       served-request wall — the pair
@@ -88,6 +92,13 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # the warm_request_s convention (a rise is the update path slowing);
 # its companion `compactions` count is info-only below (compactions
 # are workload consequences, not regressions).
+# update_fold_s / update_score_s (ISSUE 17) split that wall: the
+# device delta-fold vs the scored refresh. update_score_s is THE
+# number incremental scoring exists for — O(Δ) accounting holds it
+# flat where full rescoring pays O(edges) per epoch — so both halves
+# gate lower-better; their epoch_scale_x2 companion (scored-epoch
+# wall on a 2x base, ~1.0 when the O(Δ) path holds) rides info-only
+# as a property probe, not a perf series.
 # cached_request_s (ISSUE 16) is the content-addressed result-store
 # answer wall — a repeat submit served with zero build steps; its
 # contract bar is >= 10x under warm_request_s, so a rise means the
@@ -95,7 +106,8 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
                 "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
                 "warm_request_s", "cached_request_s",
-                "update_request_s")
+                "update_request_s", "update_fold_s",
+                "update_score_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory
@@ -107,7 +119,7 @@ INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
              "degraded_dispatch_batch", "degraded_inflight",
              "degraded_h2d_ring",
              "device_loss_recoveries", "checkpoint_degraded",
-             "cold_request_s", "compactions")
+             "cold_request_s", "compactions", "epoch_scale_x2")
 
 
 def load_capture(path: str):

@@ -739,7 +739,192 @@ grep -q '"why": "cache_hit"' "$TRACE12C"   # client-side route record
 grep -q '"why": "headroom"' "$TRACE12C"
 grep -q '"why": "failover"' "$TRACE12C"
 
+# thirteenth leg: O(delta) end-to-end epochs (ISSUE 17) — a resident
+# partition absorbs concurrent un-epoched updates under a tiny
+# per-cycle byte budget (sheepd_update_throttled_total must tick and
+# sheepd_update_score_seconds must join the HTTP /metrics catalog),
+# then streams a >1 MiB epoch through the chunked update wire form
+# (one txn, folded + scored as ONE epoch), then the daemon is
+# SIGKILLed while a rebase compaction is in flight: the restart must
+# come back at the same epoch — with SHEEP_SCORE_AUDIT cross-checking
+# every incremental score — and a final scored epoch must bit-match
+# the one-shot build of the reconstructed delta log.
+TRACE13="$OUT/trace_odelta.jsonl"
+SOCK13="$OUT/sheepd_odelta.sock"
+STATE13="$OUT/sheepd_odelta_state"
+rm -f "$TRACE13" "$SOCK13"
+rm -rf "$STATE13"
+JAX_PLATFORMS=cpu SHEEP_SCORE_AUDIT=1 SHEEP_UPDATE_BYTES_PER_CYCLE=16384 \
+python -m sheep_tpu.server.daemon \
+    --socket "$SOCK13" --trace "$TRACE13" --heartbeat-secs 0.2 \
+    --state-dir "$STATE13" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_odelta.err" &
+SHEEPD13_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID $SHEEPD13_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do [ -S "$SOCK13" ] && break; sleep 0.2; done
+[ -S "$SOCK13" ] || { echo "odelta sheepd never bound $SOCK13" >&2; exit 1; }
+JAX_PLATFORMS=cpu python - "$SOCK13" "$OUT" "$OUT/sheepd_odelta.err" \
+    > "$OUT/odelta_stream.json" <<'PYEOF'
+import json
+import os
+import re
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+from sheep_tpu.server.client import SheepClient
+
+sock, out, errf = sys.argv[1:4]
+rng = np.random.default_rng(13)
+n = 2048
+E = rng.integers(0, n, (200000, 2)).astype(np.int64)
+base = os.path.join(out, "odelta_base.bin64")
+with open(base, "wb") as f:
+    f.write(E[:40000].astype("<u8").tobytes())
+np.save(os.path.join(out, "odelta_edges.npy"), E)
+
+
+def metrics_text():
+    ports = re.findall(r"metrics on http://[^:]+:(\d+)",
+                       open(errf).read())
+    url = f"http://127.0.0.1:{ports[-1]}/metrics"
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def throttled():
+    m = re.search(
+        r'sheepd_update_throttled_total\{tenant="odelta"\} (\d+)',
+        metrics_text())
+    return int(m.group(1)) if m else 0
+
+
+applied = []
+lock = threading.Lock()
+with SheepClient(sock, timeout_s=600) as c:
+    jid = c.submit(base, k=[4], tenant="odelta", resident=True,
+                   chunk_edges=4096, num_vertices=n)["job_id"]
+    assert c.wait(jid, timeout_s=600)["state"] == "done"
+
+    def push(lo, hi):
+        with SheepClient(sock, timeout_s=600) as cc:
+            r = cc.update(jid, adds=E[lo:hi])
+            assert r["applied"], r
+            with lock:
+                applied.append((int(r["epoch"]), lo, hi))
+
+    # concurrent un-epoched updates against a 16 KiB/cycle budget:
+    # each 2000-edge item stages 32 KB, so any drain cycle that sees
+    # a backlog defers all but one item and ticks the throttle
+    # counter; bounded retry rounds make the race with the drain
+    # loop benign (a fast drain just means another round)
+    nxt = 40000
+    for _ in range(10):
+        ths = [threading.Thread(
+            target=push, args=(nxt + 2000 * i, nxt + 2000 * (i + 1)))
+            for i in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        nxt += 6000
+        if throttled() >= 1:
+            break
+    assert throttled() >= 1, "update drain never throttled a backlog"
+
+    # >1 MiB epoch through the chunked wire form: auto-chunking kicks
+    # in past UPDATE_CHUNK_EDGES and the commit answers with the txn
+    big_lo, big_hi = 100000, 184000
+    big = E[big_lo:big_hi]
+    assert big.nbytes > (1 << 20), big.nbytes
+    r = c.update(jid, adds=big, score=True)
+    assert r["applied"] and r.get("txn"), r
+    applied.append((int(r["epoch"]), big_lo, big_hi))
+    assert 'sheepd_update_score_seconds_count{tenant="odelta"}' \
+        in metrics_text(), "scored-refresh histogram missing"
+    eps = sorted(e for e, _, _ in applied)
+    assert eps == list(range(1, len(applied) + 1)), applied
+    json.dump({"job_id": jid, "epochs": sorted(applied)},
+              open(os.path.join(out, "odelta_plan.json"), "w"))
+    print(json.dumps({"job_id": jid, "last_epoch": eps[-1],
+                      "scored_cut": r["results"][0]["edge_cut"],
+                      "throttled": throttled()}))
+PYEOF
+JID13=$(python -c "import json,sys; \
+print(json.load(open(sys.argv[1]))['job_id'])" "$OUT/odelta_plan.json")
+# SIGKILL the daemon while a rebase compaction is in flight: whether
+# the base rewrite committed or not, the restart must be consistent
+(JAX_PLATFORMS=cpu python -m sheep_tpu.server.client \
+    --server "$SOCK13" --compact "$JID13" --compact-mode rebase \
+    > "$OUT/odelta_compact.json" 2>&1 || true) &
+COMPACT13_PID=$!
+sleep 0.6
+kill -9 "$SHEEPD13_PID"
+wait "$SHEEPD13_PID" 2>/dev/null || true
+wait "$COMPACT13_PID" 2>/dev/null || true
+JAX_PLATFORMS=cpu SHEEP_SCORE_AUDIT=1 SHEEP_UPDATE_BYTES_PER_CYCLE=16384 \
+python -m sheep_tpu.server.daemon \
+    --socket "$SOCK13" --trace "$TRACE13" --heartbeat-secs 0.2 \
+    --state-dir "$STATE13" --checkpoint-every 1 --metrics-port 0 \
+    2>> "$OUT/sheepd_odelta.err" &
+SHEEPD13_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID $SHEEPD13_PID 2>/dev/null || true' EXIT
+JAX_PLATFORMS=cpu python - "$SOCK13" "$OUT" \
+    > "$OUT/odelta_resume.json" <<'PYEOF'
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from sheep_tpu.io import deltalog as dl
+from sheep_tpu.server.client import SheepClient
+
+sock, out = sys.argv[1], sys.argv[2]
+plan = json.load(open(os.path.join(out, "odelta_plan.json")))
+jid = plan["job_id"]
+E = np.load(os.path.join(out, "odelta_edges.npy"))
+last = max(e for e, _, _ in plan["epochs"])
+fin_lo, fin_hi = 184000, 186000
+with SheepClient(sock, reconnect=40, reconnect_base_s=0.3,
+                 timeout_s=600) as c:
+    ep = c.epoch(jid)
+    assert ep["epoch"] == last, (ep, last)  # the SIGKILL lost nothing
+    r = c.update(jid, adds=E[fin_lo:fin_hi], epoch=last + 1,
+                 score=True)
+    assert r["applied"] and r["epoch"] == last + 1, r
+    served_cut = r["results"][0]["edge_cut"]
+    c.shutdown()
+# the one-shot reference: replay the exact applied epoch order into a
+# fresh delta log and build it cold — served must bit-match, straight
+# through the backlog, the chunked epoch, the (maybe-torn) rebase
+# compaction, and the restart
+log = os.path.join(out, "odelta_ref.dlog")
+with dl.DeltaLogWriter(
+        log, base_spec=os.path.join(out, "odelta_base.bin64")) as w:
+    for _, lo, hi in sorted(plan["epochs"]):
+        w.append(E[lo:hi])
+    w.append(E[fin_lo:fin_hi])
+one = subprocess.run(
+    [sys.executable, "-m", "sheep_tpu.cli", "--input",
+     f"delta:{log}", "--k", "4", "--num-vertices", "2048",
+     "--chunk-edges", "4096", "--json"],
+    capture_output=True, text=True,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+assert one.returncode == 0, one.stderr[-800:]
+oneshot = json.loads(one.stdout.strip().splitlines()[-1])
+assert served_cut == oneshot["edge_cut"], (served_cut, oneshot)
+print(json.dumps({"epoch": last + 1, "served_cut": served_cut,
+                  "oneshot_cut": oneshot["edge_cut"]}))
+PYEOF
+wait "$SHEEPD13_PID"
+python tools/trace_report.py "$TRACE13" --check \
+    > "$OUT/report_odelta.txt"
+grep -q '"event": "delta_epoch_applied"' "$TRACE13"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A $TRACE13"

@@ -88,6 +88,8 @@ def run_dynamic_scenario(sc: dict, backend: str) -> dict:
     from sheep_tpu.backends.base import get_backend
     from sheep_tpu.io.edgestream import EdgeStream, open_input
 
+    import os
+
     dyn = sc["dynamic"]
     with open_input(sc["spec"]) as es:
         edges = es.read_all()
@@ -100,8 +102,28 @@ def run_dynamic_scenario(sc: dict, backend: str) -> dict:
         EdgeStream.from_array(e[:half], n_vertices=n), sc["k"],
         backend=be, comm_volume=False)
     res = None
-    for batch in np.array_split(e[half:], int(dyn.get("epochs", 2))):
-        res = be.partition_update(state, adds=batch, score=True)
+    # the epochs run under SHEEP_SCORE_AUDIT (ISSUE 17): every
+    # incremental rescore is cross-checked against a full score_stream
+    # pass and RAISES on any divergence — so the gated cut_ratio below
+    # is simultaneously a proof the O(delta) score path is exact here
+    prev_audit = os.environ.get("SHEEP_SCORE_AUDIT")
+    os.environ["SHEEP_SCORE_AUDIT"] = "1"
+    try:
+        for batch in np.array_split(e[half:],
+                                    int(dyn.get("epochs", 2))):
+            res = be.partition_update(state, adds=batch, score=True)
+    finally:
+        if prev_audit is None:
+            os.environ.pop("SHEEP_SCORE_AUDIT", None)
+        else:
+            os.environ["SHEEP_SCORE_AUDIT"] = prev_audit
+    if int(state.stats.get("score_incremental", 0)) < 1:
+        # the first scored refresh seeds the cache (full pass); every
+        # later epoch must take the incremental path — a silent
+        # fallback to full rescoring would void the audit's coverage
+        raise RuntimeError(
+            f"dynamic scenario never exercised the incremental-score "
+            f"path (stats={state.stats})")
     oneshot = be.partition(EdgeStream.from_array(e, n_vertices=n),
                            sc["k"], comm_volume=False)
     row = {"spec": sc["spec"], "recipe": {"k": sc["k"],
