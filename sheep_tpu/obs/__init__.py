@@ -131,18 +131,29 @@ def begin(name: str, **attrs):
     return t.begin(name, **attrs) if t is not None else NULL_SPAN
 
 
-def begin_detached(name: str, parent=None, **attrs):
+def begin_detached(name: str, parent=None, remote_parent=None, **attrs):
     """Explicitly-started DETACHED span: parented to the given span id
     (or a root when None) instead of the calling thread's span stack,
     and never pushed onto that stack. The form for intervals that
     interleave rather than nest — e.g. per-job spans on the server
-    scheduler thread. ``parent`` accepts a Span too (its id is used)."""
+    scheduler thread. ``parent`` accepts a Span too (its id is used).
+    ``remote_parent`` is a propagated cross-process trace context
+    ``{"trace": ..., "span": ...}`` (see Tracer.begin_detached)."""
     t = _TRACER
     if t is None:
         return NULL_SPAN
     if isinstance(parent, (Span, NullSpan)):
         parent = getattr(parent, "id", None)
-    return t.begin_detached(name, parent=parent, **attrs)
+    return t.begin_detached(name, parent=parent,
+                            remote_parent=remote_parent, **attrs)
+
+
+def current_span_id():
+    """The calling thread's innermost open span id under the active
+    tracer (None when untraced or at root) — the remote-parent half of
+    an outgoing wire trace context (ISSUE 18)."""
+    t = _TRACER
+    return t.current_span_id() if t is not None else None
 
 
 def absorb(stats: dict) -> None:

@@ -1,0 +1,395 @@
+"""Cross-replica metric federation (ISSUE 18 tentpole, layer 2).
+
+A sheep fleet is N independent sheepd daemons, each answering its own
+``metrics`` scrape. Dashboards and the SLO gate need ONE view, and the
+merge must be exact, not impressionistic:
+
+- **counters** (``# TYPE ... counter``, plus histogram ``_sum`` /
+  ``_count`` components) SUM across replicas per label set — a fleet
+  total is the sum of replica totals, full stop;
+- **gauges** do NOT sum (adding two queue depths fabricates a queue
+  nobody has); every gauge sample instead gains a ``replica`` label so
+  per-replica levels stay distinguishable in one document;
+- **histograms** merge bucket-by-bucket: cumulative ``le`` counts add
+  when every replica reporting the series uses the SAME boundaries —
+  the registry pins its bucket sets precisely so this holds
+  (``metrics.DEFAULT_LATENCY_BUCKETS`` et al.). A boundary mismatch
+  raises :class:`FederationError` LOUDLY; silently interpolating
+  mismatched buckets would skew every fleet quantile downstream.
+
+Unreachable or empty replicas DEGRADE rather than fail: the merge
+covers the replicas that answered and the record carries a warning per
+missing one (also rendered as ``# federation-warning`` comments and a
+``sheep_federated_up{replica=...}`` gauge, so a scrape of the
+federated document shows who was absent).
+
+Scrape sources: a unix socket path (the sheepd wire ``metrics`` verb),
+an ``http(s)://`` URL (the ``--metrics-port`` listener), or a plain
+file of saved exposition text — mix freely. Stdlib only, like the rest
+of the metrics plane.
+
+CLI (console script ``sheep-fleet-metrics``)::
+
+    sheep-fleet-metrics /tmp/a.sock /tmp/b.sock          # merged text
+    sheep-fleet-metrics --endpoints A,B \\
+        --quantile sheepd_request_latency_seconds:0.99   # fleet p99
+
+``sheeptop --endpoints A,B`` and ``tools/slo_check.py`` consume the
+same :func:`federate` record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import stat
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from sheep_tpu.obs.metrics import (_escape_label, _fmt,
+                                   histogram_series_quantile,
+                                   parse_prometheus)
+
+
+class FederationError(ValueError):
+    """A merge that cannot be exact — histogram bucket boundaries
+    disagree across replicas. Raised loudly on purpose: every quantile
+    computed over a silently-approximated merge would be skew."""
+
+
+_TYPE_RE = re.compile(
+    r"^#\s*TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+(\S+)\s*$", re.M)
+
+
+def parse_types(text: str) -> Dict[str, str]:
+    """``{name: kind}`` from the exposition ``# TYPE`` comments —
+    parse_prometheus drops comments, but federation needs the kind to
+    pick the merge rule."""
+    return {m.group(1): m.group(2) for m in _TYPE_RE.finditer(text)}
+
+
+def _le_key(le: str) -> float:
+    return float(str(le).replace("+Inf", "inf"))
+
+
+def _labels_key(labels: dict, drop: Tuple[str, ...] = ()) -> tuple:
+    return tuple(sorted((k, v) for k, v in labels.items()
+                        if k not in drop))
+
+
+def scrape_endpoint(endpoint: str, timeout_s: float = 10.0) -> str:
+    """Fetch one replica's exposition text. ``endpoint`` is a unix
+    socket path (wire ``metrics`` verb), an http(s) URL, or a plain
+    file of saved text. Raises on failure — the caller decides whether
+    that degrades or aborts."""
+    if endpoint.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(endpoint, timeout=timeout_s) as r:
+            return r.read().decode("utf-8", "replace")
+    try:
+        mode = os.stat(endpoint).st_mode
+    except OSError:
+        mode = None
+    if mode is not None and stat.S_ISREG(mode):
+        with open(endpoint) as f:
+            return f.read()
+    from sheep_tpu.server.client import SheepClient
+
+    with SheepClient(endpoint, timeout_s=timeout_s) as c:
+        return c.metrics()
+
+
+def federate(scrapes: List[Tuple[str, Optional[str]]]) -> dict:
+    """Merge replica scrapes into one record::
+
+        {"samples": {name: [(labels, value)]},   # parse_prometheus shape
+         "kinds":   {name: "counter"|"gauge"|"histogram"},
+         "replicas": [every replica name given],
+         "answered": [replicas whose scrape merged],
+         "warnings": ["replica B: ...", ...]}
+
+    ``scrapes`` is ``[(replica_name, exposition_text_or_None)]`` —
+    pass None (or empty text) for a replica whose fetch failed; it
+    degrades to a warning instead of poisoning the merge. ``samples``
+    keeps the parse_prometheus shape so
+    :func:`~sheep_tpu.obs.metrics.histogram_series_quantile` runs on a
+    federated ``<name>_bucket`` list unchanged."""
+    parsed: List[Tuple[str, dict]] = []
+    warnings: List[str] = []
+    kinds: Dict[str, str] = {}
+    for replica, text in scrapes:
+        if not text or not text.strip():
+            warnings.append(f"replica {replica}: no scrape "
+                            f"(unreachable or empty) — fleet view "
+                            f"covers the others only")
+            continue
+        p = parse_prometheus(text)
+        if not p:
+            warnings.append(f"replica {replica}: scrape held no "
+                            f"samples — fleet view covers the others "
+                            f"only")
+            continue
+        for name, kind in parse_types(text).items():
+            kinds.setdefault(name, kind)
+        parsed.append((replica, p))
+
+    # histogram families: the base name of every *_bucket series with
+    # an le label (TYPE comments alone cannot be trusted — a saved
+    # scrape may have been stripped of comments)
+    hist_bases = set()
+    for _, p in parsed:
+        for name, samples in p.items():
+            if name.endswith("_bucket") \
+                    and any("le" in ls for ls, _ in samples):
+                hist_bases.add(name[:-len("_bucket")])
+    for base in hist_bases:
+        kinds[base] = "histogram"
+
+    def kind_of(name: str) -> str:
+        for base in hist_bases:
+            if name in (base + "_bucket", base + "_sum",
+                        base + "_count"):
+                return "histogram-part"
+        k = kinds.get(name)
+        if k in ("counter", "gauge"):
+            return k
+        return "counter" if name.endswith("_total") else "gauge"
+
+    merged: Dict[str, List[Tuple[dict, float]]] = {}
+
+    # -- histograms: exact bucket-wise merge ---------------------------
+    for base in sorted(hist_bases):
+        bname = base + "_bucket"
+        per_series: Dict[tuple, dict] = {}
+        for replica, p in parsed:
+            for labels, value in p.get(bname, []):
+                le = labels.get("le")
+                if le is None:
+                    continue
+                key = _labels_key(labels, drop=("le",))
+                per_series.setdefault(key, {}) \
+                    .setdefault(replica, {})[str(le)] = value
+        out_buckets: List[Tuple[dict, float]] = []
+        for key, by_rep in sorted(per_series.items()):
+            boundary_sets = {
+                rep: tuple(sorted(d, key=_le_key))
+                for rep, d in by_rep.items()}
+            distinct = sorted(set(boundary_sets.values()))
+            if len(distinct) > 1:
+                detail = "; ".join(
+                    f"{rep}: le={list(bs)}"
+                    for rep, bs in sorted(boundary_sets.items()))
+                raise FederationError(
+                    f"histogram {base}{dict(key)} has MISMATCHED "
+                    f"bucket boundaries across replicas — refusing "
+                    f"to merge (quantiles over interpolated buckets "
+                    f"are silent skew). {detail}")
+            for le in distinct[0]:
+                total = sum(d[le] for d in by_rep.values())
+                out_buckets.append((dict(key, le=le), total))
+        if out_buckets:
+            merged[bname] = out_buckets
+        for part in ("_sum", "_count"):
+            acc: Dict[tuple, float] = {}
+            for replica, p in parsed:
+                for labels, value in p.get(base + part, []):
+                    key = _labels_key(labels)
+                    acc[key] = acc.get(key, 0.0) + value
+            if acc:
+                merged[base + part] = [(dict(k), v)
+                                       for k, v in sorted(acc.items())]
+
+    # -- counters sum; gauges gain a replica label ---------------------
+    for replica, p in parsed:
+        for name, samples in p.items():
+            k = kind_of(name)
+            if k == "histogram-part":
+                continue
+            if k == "counter":
+                rows = merged.setdefault(name, [])
+                for labels, value in samples:
+                    key = _labels_key(labels)
+                    for i, (ls, v) in enumerate(rows):
+                        if _labels_key(ls) == key:
+                            rows[i] = (ls, v + value)
+                            break
+                    else:
+                        rows.append((dict(labels), value))
+            else:
+                rows = merged.setdefault(name, [])
+                for labels, value in samples:
+                    rows.append((dict(labels, replica=replica), value))
+
+    # who answered, as a scrapeable series on the merged document
+    answered = [r for r, _ in parsed]
+    kinds["sheep_federated_up"] = "gauge"
+    merged["sheep_federated_up"] = [
+        ({"replica": r}, 1.0 if r in answered else 0.0)
+        for r, _t in scrapes]
+
+    return {"samples": merged, "kinds": kinds,
+            "replicas": [r for r, _t in scrapes],
+            "answered": answered, "warnings": warnings}
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f) or f != int(f) or abs(f) >= 1e15:
+        return _fmt(f)
+    return str(int(f))
+
+
+def render_federated(fed: dict) -> str:
+    """One exposition document from a :func:`federate` record:
+    warnings as comments, families sorted by name (histogram parts
+    grouped under their base), buckets ordered by ``le``."""
+    out: List[str] = []
+    for w in fed["warnings"]:
+        out.append(f"# federation-warning: {w}")
+    samples = fed["samples"]
+    kinds = fed["kinds"]
+    bases = {n[:-len("_bucket")] for n in samples
+             if n.endswith("_bucket")
+             and kinds.get(n[:-len("_bucket")]) == "histogram"}
+    done = set()
+    for name in sorted(samples):
+        base = next((b for b in bases
+                     if name in (b + "_bucket", b + "_sum",
+                                 b + "_count")), None)
+        if base is not None:
+            if base in done:
+                continue
+            done.add(base)
+            out.append(f"# TYPE {base} histogram")
+            for labels, value in sorted(
+                    samples.get(base + "_bucket", []),
+                    key=lambda s: (_labels_key(s[0], drop=("le",)),
+                                   _le_key(s[0].get("le", "inf")))):
+                out.append(_sample_line(base + "_bucket", labels,
+                                        value))
+            for part in ("_sum", "_count"):
+                for labels, value in samples.get(base + part, []):
+                    out.append(_sample_line(base + part, labels, value))
+            continue
+        kind = kinds.get(name) or \
+            ("counter" if name.endswith("_total") else "gauge")
+        out.append(f"# TYPE {name} {kind}")
+        for labels, value in sorted(
+                samples[name], key=lambda s: _labels_key(s[0])):
+            out.append(_sample_line(name, labels, value))
+    return "\n".join(out) + "\n"
+
+
+def _sample_line(name: str, labels: dict, value: float) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_escape_label(v)}"'
+                         for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {_fmt_value(value)}"
+    return f"{name} {_fmt_value(value)}"
+
+
+def fleet_quantile(fed: dict, name: str, q: float,
+                   match: Optional[dict] = None) -> Optional[float]:
+    """A quantile over the FEDERATED histogram — computed from the
+    merged cumulative buckets, i.e. over the union of every replica's
+    observations (exact to bucket resolution)."""
+    return histogram_series_quantile(
+        fed["samples"].get(name + "_bucket", []), q, match)
+
+
+def scrape_fleet(endpoints: List[str],
+                 timeout_s: float = 10.0) -> List[Tuple[str, Optional[str]]]:
+    """Fetch every endpoint, mapping per-replica failures to None (the
+    degrade-with-warning input shape :func:`federate` expects)."""
+    out: List[Tuple[str, Optional[str]]] = []
+    for ep in endpoints:
+        try:
+            out.append((ep, scrape_endpoint(ep, timeout_s=timeout_s)))
+        except Exception:
+            out.append((ep, None))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sheep-fleet-metrics",
+        description="Merge N sheepd replica scrapes into one exact "
+                    "fleet exposition document (counters sum, gauges "
+                    "gain a replica label, same-boundary histogram "
+                    "buckets add).")
+    ap.add_argument("endpoint", nargs="*",
+                    help="replica endpoints: unix socket path, "
+                         "http(s)://host:port/metrics URL, or a saved "
+                         "scrape text file")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated endpoints (sheeptop-style "
+                         "alternative to positionals)")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="per-replica scrape timeout seconds")
+    ap.add_argument("--quantile", action="append", default=[],
+                    metavar="NAME:Q[:label=v,...]",
+                    help="also print a fleet quantile over the merged "
+                         "histogram NAME (repeatable), e.g. "
+                         "sheepd_request_latency_seconds:0.99 or "
+                         "...:0.5:tenant=t0")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the federate record as JSON instead of "
+                         "exposition text")
+    args = ap.parse_args(argv)
+
+    endpoints = list(args.endpoint)
+    if args.endpoints:
+        endpoints += [e.strip() for e in args.endpoints.split(",")
+                      if e.strip()]
+    if not endpoints:
+        ap.error("no endpoints given")
+
+    scrapes = scrape_fleet(endpoints, timeout_s=args.timeout)
+    try:
+        fed = federate(scrapes)
+    except FederationError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for w in fed["warnings"]:
+        print(f"warning: {w}", file=sys.stderr)
+    if not fed["answered"]:
+        print("error: no replica answered a scrape", file=sys.stderr)
+        return 1
+
+    quantiles = {}
+    for spec in args.quantile:
+        parts = spec.split(":")
+        if len(parts) < 2:
+            ap.error(f"--quantile wants NAME:Q, got {spec!r}")
+        name, q = parts[0], float(parts[1])
+        match = None
+        if len(parts) > 2 and parts[2]:
+            match = dict(kv.split("=", 1)
+                         for kv in parts[2].split(","))
+        quantiles[spec] = fleet_quantile(fed, name, q, match)
+
+    if args.json:
+        json.dump({"replicas": fed["replicas"],
+                   "answered": fed["answered"],
+                   "warnings": fed["warnings"],
+                   "quantiles": quantiles,
+                   "samples": {n: [[ls, v] for ls, v in rows]
+                               for n, rows in fed["samples"].items()}},
+                  sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        sys.stdout.write(render_federated(fed))
+        for spec, v in quantiles.items():
+            print(f"# quantile {spec} = "
+                  f"{'NaN' if v is None else _fmt_value(round(v, 9))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,9 @@ class FlightRecorder:
         self._rings: "OrderedDict[str, deque]" = OrderedDict()
         self._global: deque = deque(maxlen=int(global_events))
         self._ctx = threading.local()
+        # job id -> propagated trace id (ISSUE 18): bounded by the
+        # ring eviction below, so it cannot grow with traffic either
+        self._traces: dict = {}
         self.dumps = 0  # dumps emitted (scrape-able via collector)
 
     # -- context -------------------------------------------------------
@@ -107,7 +110,8 @@ class FlightRecorder:
                     ring = deque(maxlen=self.per_job)
                     self._rings[job] = ring
                     while len(self._rings) > self.max_jobs:
-                        self._rings.popitem(last=False)
+                        evicted, _ = self._rings.popitem(last=False)
+                        self._traces.pop(evicted, None)
                 ring.append(rec)
         if kind in DUMP_TRIGGER_EVENTS:
             self.dump(job, reason=f"{kind}:"
@@ -119,9 +123,19 @@ class FlightRecorder:
                 return list(self._global)
             return list(self._rings.get(job_id, ()))
 
+    def set_trace(self, job_id: str, trace_id: Optional[str]) -> None:
+        """Associate a propagated trace id with ``job_id`` (ISSUE 18)
+        so that job's dumps can name the fleet request the ring
+        belonged to (``trace_report --last-errors`` prints it)."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._traces[job_id] = str(trace_id)
+
     def forget(self, job_id: str) -> None:
         with self._lock:
             self._rings.pop(job_id, None)
+            self._traces.pop(job_id, None)
 
     def jobs(self) -> List[str]:
         with self._lock:
@@ -140,7 +154,10 @@ class FlightRecorder:
         rec = {"job": job_id or GLOBAL_RING, "reason": reason,
                "n_events": len(evs), "events": evs}
         with self._lock:
+            trace = self._traces.get(job_id) if job_id else None
             self.dumps += 1
+        if trace:
+            rec["trace"] = trace
         from sheep_tpu import obs
 
         tr = obs.get_tracer()

@@ -261,12 +261,38 @@ class Tracer:
     def begin(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs).start()
 
-    def begin_detached(self, name: str, parent=None, **attrs) -> Span:
+    def begin_detached(self, name: str, parent=None,
+                       remote_parent=None, **attrs) -> Span:
         """Start a DETACHED span: explicit ``parent`` span id (or None
         for a root), never on any thread's span stack — for intervals
-        that interleave in time instead of nesting (see Span)."""
+        that interleave in time instead of nesting (see Span).
+
+        ``remote_parent`` (ISSUE 18) is a CROSS-PROCESS parent:
+        ``{"trace": <hex trace id>, "span": <hex remote span id>}``
+        from a propagated wire trace context. The local tree is
+        untouched (``parent`` still names the local parent id); the
+        span records additionally carry ``trace`` and
+        ``remote_parent`` attrs, which is what lets
+        ``tools/trace_report.py --stitch`` graft this process's
+        subtree under the originating client span in a DIFFERENT
+        process's trace file. An all-zero remote span id means "the
+        caller had no span of its own" — the trace id still lands."""
+        if remote_parent:
+            attrs = dict(attrs)
+            tid = remote_parent.get("trace")
+            if tid:
+                attrs.setdefault("trace", tid)
+            rp = remote_parent.get("span")
+            if rp and set(str(rp)) != {"0"}:
+                attrs.setdefault("remote_parent", str(rp))
         return Span(self, name, attrs, parent=parent,
                     attach=False).start()
+
+    def current_span_id(self) -> Optional[int]:
+        """The calling thread's innermost open span id (None at root)
+        — what a client stamps into an outgoing wire trace context as
+        the remote parent span (ISSUE 18)."""
+        return self._current_id()
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)

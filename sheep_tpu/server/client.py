@@ -72,6 +72,18 @@ to the replica OWNING the resident job (pinned after a status sweep)
 and deliberately never fail over — resident state is replica-local.
 Other admin verbs still address one replica via ``--server``.
 
+Fleet observability (ISSUE 18): every submit mints a
+W3C-traceparent-shaped trace context (``protocol.make_traceparent``)
+sent as the request's ``trace`` field and re-sent on every later
+wait/status/cancel/update naming that job; a FleetClient failover
+resubmit REUSES the logical request's trace, so one trace id
+correlates the client's ``fleet_request``/``fleet_failover`` spans
+and every replica's job spans (``trace_report --stitch`` renders the
+cross-process tree). The routing scrape is TTL-cached
+(``SHEEP_FLEET_SCRAPE_TTL_S``, default 1 s) so submit bursts pay one
+``/metrics`` round-trip per replica per window, with scrape wall cost
+on the ``fleet_scrape_ms`` obs counter.
+
 Chunked updates (ISSUE 17): :meth:`SheepClient.update` payloads too
 large for the 1 MiB request line switch automatically to a
 ``begin`` / ``chunk`` / ``commit`` transaction over one connection,
@@ -96,8 +108,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import socket
 import sys
+import time
 from typing import Optional
 
 from sheep_tpu.server import protocol
@@ -140,6 +154,10 @@ class SheepClient:
         self._reconnect_base_s = float(reconnect_base_s)
         self._sock = None
         self._rf = None
+        # job_id -> the traceparent minted at submit (ISSUE 18): every
+        # later wait/status/cancel/update on that job re-sends the
+        # SAME trace context, so the whole logical request correlates
+        self._job_traces: dict = {}
         pol = self._policy()
         while True:
             try:
@@ -215,6 +233,10 @@ class SheepClient:
         return op not in ("shutdown", "compact")
 
     def request(self, doc: dict) -> dict:
+        if "trace" not in doc:
+            tp = self._job_traces.get(doc.get("job_id"))
+            if tp is not None:
+                doc = dict(doc, trace=tp)
         pol = self._policy() if self.reconnect > 0 \
             and self._retriable(doc) else None
         while True:
@@ -246,19 +268,40 @@ class SheepClient:
     def ping(self) -> dict:
         return self.request({"op": "ping"})
 
+    def _mint_trace(self) -> str:
+        """One fresh wire trace context per logical request (ISSUE
+        18), parented to the calling thread's current obs span when
+        one is open — the daemon's job span then stitches under it
+        (``trace_report --stitch``)."""
+        from sheep_tpu import obs
+
+        return protocol.make_traceparent(protocol.mint_trace_id(),
+                                         obs.current_span_id())
+
     def submit(self, input: str, k, tenant: str = "default",
-               reattach: bool = False, **job_fields) -> dict:
+               reattach: bool = False, trace: Optional[str] = None,
+               **job_fields) -> dict:
         """``reattach=True`` makes the submit idempotent: the daemon
         matches the spec digest against existing jobs (journaled ones
         included) and returns the live/completed twin — with
         ``"reattached": true`` in the response — instead of building
         again. The safe shape for retried submits across a daemon
-        restart."""
+        restart.
+
+        ``trace`` overrides the wire trace context (a FleetClient
+        failover resubmit reuses the logical request's); by default a
+        fresh one is minted per submit and re-sent on every later
+        request naming the returned job id."""
         job = {"input": input, "k": k, **job_fields}
-        req = {"op": "submit", "tenant": tenant, "job": job}
+        req = {"op": "submit", "tenant": tenant, "job": job,
+               "trace": trace or self._mint_trace()}
         if reattach:
             req["reattach"] = True
-        return self.request(req)
+        resp = self.request(req)
+        jid = resp.get("job_id")
+        if jid:
+            self._job_traces[jid] = req["trace"]
+        return resp
 
     def status(self, job_id: str) -> dict:
         return self.request({"op": "status", "job_id": job_id})["job"]
@@ -420,6 +463,17 @@ def fleet_digest(input: str, k, tenant: str = "default",
     return journal_mod.job_digest(spec)
 
 
+def _trace_id_of(traceparent: Optional[str]) -> Optional[str]:
+    """The bare 32-hex trace id out of a wire traceparent (None when
+    absent/malformed) — what grep-able obs events carry."""
+    if not traceparent:
+        return None
+    try:
+        return protocol.parse_traceparent(traceparent)[0]
+    except protocol.ProtocolError:
+        return None
+
+
 class FleetClient:
     """Routes submits across a fleet of sheepd replicas (ISSUE 16).
 
@@ -463,11 +517,23 @@ class FleetClient:
         self._reconnect_base_s = float(reconnect_base_s)
         self._clients: dict = {}
         self.route_counts = {ep: 0 for ep in eps}
-        # (endpoint, job_id) -> (input, k, tenant, job_fields) — what
-        # failover needs to re-place the job on a surviving replica.
-        # Keyed by BOTH because daemon job ids are per-process
-        # counters: two replicas routinely mint the same "j1".
+        # (endpoint, job_id) -> (input, k, tenant, job_fields, trace)
+        # — what failover needs to re-place the job on a surviving
+        # replica (the trace context is REUSED: a failover resubmit is
+        # the same logical request, ISSUE 18). Keyed by BOTH because
+        # daemon job ids are per-process counters: two replicas
+        # routinely mint the same "j1".
         self._jobs: dict = {}
+        # routing-scrape TTL cache (ISSUE 18): a burst of submits
+        # within the TTL reuses one /metrics round-trip per replica
+        # instead of paying N; load keys go stale by at most the TTL,
+        # which headroom routing tolerates (admission re-checks)
+        try:
+            self.scrape_ttl_s = float(
+                os.environ.get("SHEEP_FLEET_SCRAPE_TTL_S", "1.0"))
+        except ValueError:
+            self.scrape_ttl_s = 1.0
+        self._load_cache: dict = {}  # ep -> (monotonic ts, load key)
         # job_id -> endpoint pins for the resident verbs (ISSUE 17):
         # resident state is replica-local, so update/epoch/compact
         # must keep hitting the owning replica and NEVER fail over
@@ -522,11 +588,26 @@ class FleetClient:
         return live, hit
 
     def _load(self, ep: str):
-        """(queued+active, -headroom) load key; None if unreachable."""
+        """(queued+active, -headroom) load key; None if unreachable.
+        Answers from the TTL cache within ``scrape_ttl_s`` of the last
+        scrape (ISSUE 18); each real scrape's wall cost lands on the
+        ``fleet_scrape_ms`` obs counter, cache answers on
+        ``fleet_scrape_cache_hits``."""
+        from sheep_tpu import obs
+
+        cached = self._load_cache.get(ep)
+        if cached is not None \
+                and time.monotonic() - cached[0] < self.scrape_ttl_s:
+            obs.inc("fleet_scrape_cache_hits")
+            return cached[1]
+        t0 = time.perf_counter()
         try:
             text = self._client(ep).metrics()
         except (ServerError, OSError, json.JSONDecodeError):
+            self._load_cache[ep] = (time.monotonic(), None)
             return None
+        obs.inc("fleet_scrape_ms",
+                round((time.perf_counter() - t0) * 1000.0, 3))
         from sheep_tpu.obs.metrics import parse_prometheus
 
         gauges = parse_prometheus(text)
@@ -538,7 +619,9 @@ class FleetClient:
         depth = one("sheepd_queue_depth", 0.0) \
             + one("sheepd_active_jobs", 0.0)
         headroom = one("sheepd_headroom_bytes", float("inf"))
-        return (depth, -headroom)
+        key = (depth, -headroom)
+        self._load_cache[ep] = (time.monotonic(), key)
+        return key
 
     def _route(self, live):
         scored = []
@@ -553,18 +636,21 @@ class FleetClient:
 
     def _submit_to(self, ep: str, why: str, digest: str, input: str,
                    k, tenant: str, job_fields: dict,
-                   reattach: bool = False) -> dict:
+                   reattach: bool = False,
+                   trace: Optional[str] = None) -> dict:
         from sheep_tpu import obs
 
         resp = self._client(ep).submit(input, k=k, tenant=tenant,
-                                       reattach=reattach, **job_fields)
+                                       reattach=reattach, trace=trace,
+                                       **job_fields)
         self.route_counts[ep] = self.route_counts.get(ep, 0) + 1
         jid = resp.get("job_id")
         if jid:
             self._jobs[(ep, jid)] = (input, k, tenant,
-                                     dict(job_fields))
+                                     dict(job_fields), trace)
         obs.event("fleet_route", endpoint=ep, why=why, digest=digest,
-                  job_id=jid, counts=dict(self.route_counts))
+                  job_id=jid, trace=_trace_id_of(trace),
+                  counts=dict(self.route_counts))
         resp["endpoint"] = ep
         return resp
 
@@ -574,26 +660,46 @@ class FleetClient:
         accepted for :class:`SheepClient` signature compatibility but
         ignored: first submits are plain (a repeat digest must reach
         the result store, not reattach to a retained terminal twin);
-        failover resubmission adds ``reattach=True`` itself."""
+        failover resubmission adds ``reattach=True`` itself.
+
+        One trace id is minted per LOGICAL request (ISSUE 18): the
+        client-side ``fleet_request`` span carries it, the wire
+        ``trace`` field propagates it to whichever replica takes the
+        job, and a later failover resubmit reuses it — so the client
+        route span and every replica's job span stitch into one tree
+        (``trace_report --stitch``)."""
         del reattach
+        from sheep_tpu import obs
+
         digest = fleet_digest(input, k, tenant=tenant, **job_fields)
+        tid = protocol.mint_trace_id()
+        sp = obs.begin_detached("fleet_request", trace=tid,
+                                digest=digest, tenant=str(tenant))
+        tp = protocol.make_traceparent(tid, getattr(sp, "id", None))
         tried: set = set()
-        while True:
-            live, hit = self._lookup_round(digest)
-            live = [e for e in live if e not in tried]
-            if hit is not None and hit not in tried:
-                ep, why = hit, "cache_hit"
-            else:
-                ep, why = self._route(live), "headroom"
-            if ep is None:
-                raise ServerError("no live endpoint among "
-                                  + ",".join(self.endpoints))
-            try:
-                return self._submit_to(ep, why, digest, input, k,
-                                       tenant, dict(job_fields))
-            except (OSError, json.JSONDecodeError):
-                # died between lookup and submit: strike it, reroute
-                tried.add(ep)
+        try:
+            while True:
+                live, hit = self._lookup_round(digest)
+                live = [e for e in live if e not in tried]
+                if hit is not None and hit not in tried:
+                    ep, why = hit, "cache_hit"
+                else:
+                    ep, why = self._route(live), "headroom"
+                if ep is None:
+                    raise ServerError("no live endpoint among "
+                                      + ",".join(self.endpoints))
+                try:
+                    resp = self._submit_to(ep, why, digest, input, k,
+                                           tenant, dict(job_fields),
+                                           trace=tp)
+                    sp.annotate(endpoint=ep, why=why,
+                                job_id=resp.get("job_id"))
+                    return resp
+                except (OSError, json.JSONDecodeError):
+                    # died between lookup and submit: strike, reroute
+                    tried.add(ep)
+        finally:
+            sp.end()
 
     def _resolve(self, job):
         """(endpoint, job_id) key for a job handle.
@@ -622,26 +728,49 @@ class FleetClient:
 
     def _failover(self, key, exc) -> dict:
         """The job's home replica is gone: re-place it on a survivor
-        (reattach-idempotent) and return the NEW descriptor."""
+        (reattach-idempotent) and return the NEW descriptor. The
+        resubmit REUSES the logical request's trace context, and the
+        client-side ``fleet_failover`` span nests under the original
+        ``fleet_request`` span — the failover seam is one visible
+        edge in the stitched tree (ISSUE 18)."""
+        from sheep_tpu import obs
+
         home, job_id = key
         sub = self._jobs.get(key)
         if sub is None:
             raise exc
         self._jobs.pop(key, None)
-        input, k, tenant, job_fields = sub
+        input, k, tenant, job_fields, tp = sub
         digest = fleet_digest(input, k, tenant=tenant, **job_fields)
-        for ep in self.endpoints:
-            if ep == home or self._down(ep):
-                continue
+        tid = parent = None
+        if tp:
             try:
-                return self._submit_to(ep, "failover", digest, input,
-                                       k, tenant, job_fields,
-                                       reattach=True)
-            except (ServerError, OSError, json.JSONDecodeError):
-                continue
-        raise ServerError(
-            f"job {job_id}: home replica {home} died and no live "
-            f"replica accepted the failover resubmit") from exc
+                tid, phex = protocol.parse_traceparent(tp)
+                parent = int(phex, 16) if phex else None
+            except protocol.ProtocolError:
+                pass
+        sp = obs.begin_detached("fleet_failover", parent=parent,
+                                trace=tid, from_endpoint=home,
+                                from_job=job_id)
+        try:
+            for ep in self.endpoints:
+                if ep == home or self._down(ep):
+                    continue
+                try:
+                    resp = self._submit_to(ep, "failover", digest,
+                                           input, k, tenant,
+                                           job_fields, reattach=True,
+                                           trace=tp)
+                    sp.annotate(endpoint=ep,
+                                job_id=resp.get("job_id"))
+                    return resp
+                except (ServerError, OSError, json.JSONDecodeError):
+                    continue
+            raise ServerError(
+                f"job {job_id}: home replica {home} died and no live "
+                f"replica accepted the failover resubmit") from exc
+        finally:
+            sp.end()
 
     def status(self, job) -> dict:
         """Job descriptor, following failover: when the home replica
@@ -879,8 +1008,6 @@ def _watch_job(c: "SheepClient", job, poll_s: float,
     retries transports with backoff, so a restarting daemon shows up
     as a few stderr retry notes and then the resumed job's progress —
     not a dead watch."""
-    import time
-
     t0 = time.monotonic()
     deadline = None if timeout_s is None else t0 + timeout_s
     last_line = None

@@ -283,8 +283,10 @@ class Daemon:
                         return
                     if not line.strip():
                         continue
+                    verb = "malformed"
                     try:
                         req = protocol.parse_request(line)
+                        verb = req["op"]
                         resp = self._dispatch(req, txns=txns)
                     except protocol.ProtocolError as e:
                         resp = {"ok": False, "error": str(e)}
@@ -292,6 +294,13 @@ class Daemon:
                         resp = {"ok": False,
                                 "error": f"internal: {type(e).__name__}: "
                                          f"{str(e)[:300]}"}
+                    # SLO denominators (ISSUE 18): every answered wire
+                    # request lands on sheepd_requests_total{verb,
+                    # outcome} — what fleet error-rate bounds divide by
+                    sched = self.scheduler
+                    if sched is not None:
+                        sched.record_request(
+                            verb, "ok" if resp.get("ok") else "error")
                     try:
                         conn.sendall(protocol.dumps(resp))
                     except OSError:
@@ -304,6 +313,13 @@ class Daemon:
                   txns: Optional[dict] = None) -> dict:
         op = req["op"]
         sched = self.scheduler
+        # propagated trace context (ISSUE 18): validated here so a
+        # malformed traceparent is answered loudly, never silently
+        # mis-correlated; threaded into the job's detached span +
+        # flight ring at submit
+        trace = None
+        if req.get("trace") is not None:
+            trace = protocol.parse_traceparent(req["trace"])
         if op == "update" and req.get("stream") is not None:
             return self._update_stream(req, txns)
         if op == "ping":
@@ -316,9 +332,11 @@ class Daemon:
                 # idempotent resubmission (ISSUE 14): a retried submit
                 # reattaches to the journaled/live twin by spec digest
                 # instead of double-building
-                job, reattached = sched.reattach_or_submit(spec)
+                job, reattached = sched.reattach_or_submit(
+                    spec, trace=trace)
             else:
-                job, reattached = sched.submit(spec), False
+                job, reattached = sched.submit(spec,
+                                               trace=trace), False
             return {"ok": True, "job_id": job.id, "state": job.state,
                     **({"reattached": True} if reattached else {}),
                     **({"error": job.error} if job.error else {})}

@@ -174,6 +174,15 @@ class JobEngine:
         self.job.phase = phase
         obs.event("job_phase", job=self.job.id, phase=phase)
 
+    def _phase_span(self, name: str):
+        # phase spans parent locally to the job span; a propagated
+        # trace id (ISSUE 18) rides on each so --stitch can collect a
+        # job's whole subtree by trace attr even across files
+        tid = getattr(self.job, "trace_id", None)
+        return obs.begin_detached(
+            name, parent=self.job.span_id,
+            **({"trace": tid} if tid else {}))
+
     def _on_device_loss(self):
         # best-effort in-process runtime reinit (utils/retry, ISSUE 9):
         # THIS job's live device arrays died with the old client, so
@@ -251,7 +260,7 @@ class JobEngine:
                 deg_start = int(state.chunk_idx)
             if resume_phase in (None, "degrees"):
                 self._enter_phase("degrees")
-                sp = obs.begin_detached("degrees", parent=job.span_id)
+                sp = self._phase_span("degrees")
                 deg = degrees_ops.init_degrees(n)
                 flush_every = degrees_ops.flush_every_for(cs)
                 since = 0
@@ -293,7 +302,7 @@ class JobEngine:
             # a pure deterministic function of the degree totals) -----
             t0 = time.perf_counter()
             self._enter_phase("sort")
-            sp = obs.begin_detached("sort", parent=job.span_id)
+            sp = self._phase_span("sort")
             try:
                 # the rank clip + flush cadence are SHARED with the tpu
                 # backend (ops/degrees.py) — the served==CLI bit-identity
@@ -321,7 +330,7 @@ class JobEngine:
             else:
                 t0 = time.perf_counter()
                 self._enter_phase("build")
-                sp = obs.begin_detached("build", parent=job.span_id)
+                sp = self._phase_span("build")
                 if resume_phase == "build":
                     P = jnp.asarray(state.arrays["p"], dtype=jnp.int32)
                     self._build_idx = int(state.chunk_idx)
@@ -474,7 +483,7 @@ class JobEngine:
             # ---- split (host, per k — the multi-k reuse query) ------
             t0 = time.perf_counter()
             self._enter_phase("split")
-            sp = obs.begin_detached("split", parent=job.span_id)
+            sp = self._phase_span("split")
             try:
                 parent = elim_ops.minp_to_parent(minp_host, order, n)
                 w = deg_host.astype(np.float64) \
@@ -492,7 +501,7 @@ class JobEngine:
             # ---- score: ONE stream pass for every k -----------------
             t0 = time.perf_counter()
             self._enter_phase("score")
-            sp = obs.begin_detached("score", parent=job.span_id)
+            sp = self._phase_span("score")
             dev_assign = {
                 k: jnp.concatenate([jnp.asarray(a, dtype=jnp.int32),
                                     jnp.zeros(1, dtype=jnp.int32)])

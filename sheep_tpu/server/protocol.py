@@ -89,6 +89,21 @@ pre-restart ``job_id`` keeps working in status/wait/cancel; a
 journal-replayed DONE job answers its journaled result summaries,
 without assignment payloads (use ``output`` for those).
 
+Trace context (ISSUE 18): every request may carry an optional
+top-level ``trace`` field — a W3C-traceparent-shaped string
+``"00-<32 hex trace id>-<16 hex parent span id>-01"`` minted by the
+client once per LOGICAL request (a fleet submit keeps one trace id
+across failover resubmits; waits/updates reuse the submit's). The
+daemon threads it into the job's detached span and flight-recorder
+ring, so one trace id stitches the client's route/failover spans and
+every replica's job spans into one cross-process tree
+(``tools/trace_report.py --stitch``). An all-zero parent span id
+means "the client had no span of its own" (untraced client); the
+trace id still correlates. The field is OPTIONAL and additive: old
+clients never send it, old daemons ignore it — it is not a job field
+and never affects the job digest (:func:`make_traceparent` /
+:func:`parse_traceparent` are the codec).
+
 Telemetry verbs (ISSUE 11): ``metrics`` answers ``{"ok": true,
 "content_type": ..., "text": "<Prometheus exposition>"}`` — the same
 document the daemon's optional HTTP ``GET /metrics`` listener
@@ -128,8 +143,10 @@ from __future__ import annotations
 
 import base64
 import json
+import os
+import re
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -161,6 +178,51 @@ MAX_UPDATE_TXN_BYTES = 256 << 20
 
 class ProtocolError(ValueError):
     """Malformed request — answered with ok=false, never fatal."""
+
+
+# -- trace context (ISSUE 18) ------------------------------------------
+# W3C-traceparent-shaped: version "00", 32-hex trace id, 16-hex parent
+# span id, flags "01" (sampled — sheep traces everything it traces).
+_NO_SPAN = "0" * 16
+_TRACEPARENT_RE = re.compile(
+    r"^00-(?P<trace>[0-9a-f]{32})-(?P<span>[0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 32-hex trace id — one per LOGICAL client request (a
+    failover resubmit is the same logical request and reuses it)."""
+    return os.urandom(16).hex()
+
+
+def make_traceparent(trace_id: str, span_id=None) -> str:
+    """Render the wire ``trace`` field. ``span_id`` is the client-side
+    parent span id — an int (local tracer span id), a hex string, or
+    None for "no client span" (encoded as the all-zero span id)."""
+    if span_id is None:
+        span = _NO_SPAN
+    elif isinstance(span_id, int):
+        span = format(span_id & ((1 << 64) - 1), "016x")
+    else:
+        span = str(span_id).lower().rjust(16, "0")[-16:]
+    return f"00-{trace_id}-{span}-01"
+
+
+def parse_traceparent(value) -> Tuple[str, Optional[str]]:
+    """Validate a wire ``trace`` field -> ``(trace_id, parent_span)``
+    with ``parent_span`` None when the client sent the all-zero span
+    id. Malformed values raise :class:`ProtocolError` — a daemon must
+    answer "bad trace context", never silently mis-correlate."""
+    if not isinstance(value, str):
+        raise ProtocolError("trace must be a traceparent string")
+    m = _TRACEPARENT_RE.match(value.lower())
+    if m is None:
+        raise ProtocolError(
+            f"trace {value!r} is not 00-<32hex>-<16hex>-<2hex>")
+    tid = m.group("trace")
+    if set(tid) == {"0"}:
+        raise ProtocolError("trace id must not be all zeros")
+    span = m.group("span")
+    return tid, (None if span == _NO_SPAN else span)
 
 
 @dataclass

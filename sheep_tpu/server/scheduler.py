@@ -156,6 +156,13 @@ class Job:
         self.gen = None           # the engine step generator, once running
         self.span = None          # detached obs span for the job tree
         self.span_id = None
+        # propagated wire trace context (ISSUE 18): the client-minted
+        # 32-hex trace id + the client's 16-hex parent span id. The
+        # job span starts with these as remote_parent attrs and the
+        # flight ring learns the trace id, so one trace id correlates
+        # this replica's spans/dumps with the client's route spans.
+        self.trace_id: Optional[str] = None
+        self.trace_parent: Optional[str] = None
         self.cancel_requested = False
         self.steps = 0
         # live phase name (degrees/sort/build/split/score): written by
@@ -402,6 +409,14 @@ class Scheduler:
             "sheep_quality_balance",
             "final balance of DONE jobs, one observation per result k",
             ("tenant",), buckets=DEFAULT_BALANCE_BUCKETS)
+        # ---- fleet observability plane (ISSUE 18): the SLO layer's
+        # missing denominator — every answered wire request by verb
+        # and outcome (tools/slo_check.py divides error outcomes by
+        # the total for the error-rate bound)
+        self._m_requests = self.metrics.counter(
+            "sheepd_requests_total",
+            "wire requests answered, by verb and outcome (ok|error)",
+            ("verb", "outcome"))
         self.metrics.add_collector(self._collect_live_gauges)
         # Always-on flight recorder: bounded per-job rings fed by
         # obs.event, dumped on job failure / fault injection / shutdown
@@ -498,13 +513,17 @@ class Scheduler:
     # ------------------------------------------------------------------
     # submit-side API (connection handler threads)
     # ------------------------------------------------------------------
-    def submit(self, spec: JobSpec, digest: Optional[str] = None) -> Job:
+    def submit(self, spec: JobSpec, digest: Optional[str] = None,
+               trace=None) -> Job:
         """Validate + model + enqueue. Raises ProtocolError on inputs
         that cannot be opened (answered ok=false; no job is created) —
         admission-budget verdicts come back as a REJECTED job instead,
         so they are queryable like any other terminal state. ``digest``
         lets reattach_or_submit hand over the identity it already
-        computed (and matched against) instead of hashing twice."""
+        computed (and matched against) instead of hashing twice.
+        ``trace`` is the request's parsed wire trace context — a
+        ``(trace_id, parent_span)`` pair (ISSUE 18) — threaded into
+        the job span and flight ring."""
         if digest is None:
             digest = journal_mod.job_digest(spec)
         n = self._probe_num_vertices(spec)
@@ -528,6 +547,9 @@ class Scheduler:
                 raise protocol.ProtocolError("daemon is shutting down")
             job = Job(f"j{next(self._ids)}", spec, n, modeled)
             job.digest = digest
+            if trace is not None:
+                job.trace_id, job.trace_parent = trace
+                self.flight.set_trace(job.id, job.trace_id)
             # the admission pre-shed: run at the degraded batch that
             # fits (the same knob an OOM would halve mid-run)
             if batch is not None and batch != spec.dispatch_batch:
@@ -563,7 +585,9 @@ class Scheduler:
                      "spec": job.journal_spec()}, fsync=True)
             obs.event("job_submit", job=job.id, tenant=spec.tenant,
                       input=spec.input, k=list(spec.ks), state=job.state,
-                      modeled_bytes=modeled)
+                      modeled_bytes=modeled,
+                      **({"trace": job.trace_id}
+                         if job.trace_id else {}))
             if hit is not None:
                 self._serve_from_store_locked(job, hit)
             self._cond.notify_all()
@@ -596,10 +620,11 @@ class Scheduler:
         job.stats["result_cache_hit"] = 1
         self._m_rc_hits.inc(tenant=job.spec.tenant)
         obs.event("result_cache_hit", job=job.id,
-                  tenant=job.spec.tenant, digest=job.digest)
+                  tenant=job.spec.tenant, digest=job.digest,
+                  **({"trace": job.trace_id} if job.trace_id else {}))
         self._finalize_locked(job, DONE)
 
-    def reattach_or_submit(self, spec: JobSpec):
+    def reattach_or_submit(self, spec: JobSpec, trace=None):
         """Idempotent resubmission (ISSUE 14): match the spec's digest
         against existing jobs and return ``(job, True)`` for a live or
         completed twin instead of double-building — the contract a
@@ -608,17 +633,41 @@ class Scheduler:
         is exactly what a fresh submit is for). The check-then-submit
         window is unlocked (submit probes the input off-lock), so two
         simultaneous first-time reattach submits may both build — the
-        retried-client scenario this exists for is serial."""
+        retried-client scenario this exists for is serial.
+
+        A matched twin with no trace of its own ADOPTS the retried
+        request's trace context (ISSUE 18): a failover resubmit that
+        reattaches to a journal-replayed job still names the fleet
+        request in that replica's trace and flight dumps."""
         digest = journal_mod.job_digest(spec)
         with self._lock:
             for job in reversed(self._jobs.values()):
                 if job.digest == digest \
                         and job.state in (QUEUED, RUNNING, DONE):
+                    if trace is not None and job.trace_id is None:
+                        job.trace_id, job.trace_parent = trace
+                        self.flight.set_trace(job.id, job.trace_id)
+                        if job.span is not None:
+                            job.span.annotate(
+                                trace=job.trace_id,
+                                **({"remote_parent": job.trace_parent}
+                                   if job.trace_parent else {}))
                     self._m_reattached.inc(tenant=spec.tenant)
                     obs.event("job_reattach", job=job.id,
-                              tenant=spec.tenant, state=job.state)
+                              tenant=spec.tenant, state=job.state,
+                              **({"trace": job.trace_id}
+                                 if job.trace_id else {}))
                     return job, True
-        return self.submit(spec, digest=digest), False
+        return self.submit(spec, digest=digest, trace=trace), False
+
+    def record_request(self, verb: str, outcome: str) -> None:
+        """Tally one answered wire request into
+        ``sheepd_requests_total{verb,outcome}`` (ISSUE 18) — the
+        error-rate numerator/denominator the SLO gate reads. Called by
+        the daemon's connection handlers; label values are free-form
+        but bounded in practice (verb comes from protocol.OPS or
+        "malformed", outcome is ok|error)."""
+        self._m_requests.inc(verb=str(verb), outcome=str(outcome))
 
     def _probe_num_vertices(self, spec: JobSpec) -> int:
         from sheep_tpu.io.edgestream import open_input
@@ -1577,8 +1626,11 @@ class Scheduler:
             self._m_queue_wait.observe(job.start_t - job.submit_t,
                                        tenant=job.spec.tenant)
             job.span = obs.begin_detached(
-                f"job:{job.id}", parent=self.root_span_id, job=job.id,
-                tenant=job.spec.tenant, input=job.spec.input,
+                f"job:{job.id}", parent=self.root_span_id,
+                remote_parent=({"trace": job.trace_id,
+                                "span": job.trace_parent}
+                               if job.trace_id else None),
+                job=job.id, tenant=job.spec.tenant, input=job.spec.input,
                 k=list(job.spec.ks))
             job.span_id = getattr(job.span, "id", None)
             cache = self._lease_cache_locked(job)

@@ -3,6 +3,7 @@
     sheeptop --server /run/sheepd.sock            # curses refresh view
     sheeptop --server 127.0.0.1:7433 --plain      # line-mode refresh
     sheeptop --server ... --once                  # one snapshot, exit 0
+    sheeptop --endpoints /run/a.sock,/run/b.sock  # fleet mode
 
 Polls the ``metrics`` + ``list`` protocol verbs (no HTTP needed — it
 speaks the same line protocol as sheep-submit) and renders:
@@ -16,11 +17,22 @@ speaks the same line protocol as sheep-submit) and renders:
   and — once a job is done — its final cut ratio and balance from the
   descriptor's result summaries (the quality plane, ISSUE 13).
 
-Rendering is pure string assembly (:func:`render_lines`) so tests pin
-it without a terminal; curses is a presentation detail that degrades
-to plain line mode on dumb terminals, ``--plain``, or ``--once``.
-The client reconnects per poll — a daemon restart mid-watch shows as
-one unreachable frame, not a dead tool.
+Fleet mode (ISSUE 18): ``--endpoints A,B`` polls every replica and
+renders one per-replica summary row each (up/DOWN, queue, active,
+reserved, flight dumps) plus a fleet-aggregate latency table whose
+p50/p90/p99 come from the FEDERATED histogram buckets
+(:mod:`sheep_tpu.obs.federate` — counters sum, same-boundary buckets
+add), i.e. quantiles over the union of every replica's observations,
+not an average of per-replica quantiles. A replica that fails its
+poll shows as DOWN and degrades out of the merge; the frame renders
+either way.
+
+Rendering is pure string assembly (:func:`render_lines` /
+:func:`render_fleet_lines`) so tests pin it without a terminal;
+curses is a presentation detail that degrades to plain line mode on
+dumb terminals, ``--plain``, or ``--once``. The client reconnects per
+poll — a daemon restart mid-watch shows as one unreachable frame, not
+a dead tool.
 """
 
 from __future__ import annotations
@@ -41,6 +53,32 @@ def fetch(server: str, timeout_s: float = 10.0) -> dict:
         jobs = c.list()
     return {"metrics": metrics_mod.parse_prometheus(text),
             "jobs": jobs, "t": time.time()}
+
+
+def fetch_fleet(endpoints: List[str], timeout_s: float = 10.0) -> dict:
+    """One fleet poll: every replica's metrics + jobs (per-replica
+    failures degrade to an up=False row), plus the federated merge of
+    the scrapes that answered."""
+    from sheep_tpu.obs import federate as federate_mod
+
+    replicas = []
+    scrapes = []
+    for ep in endpoints:
+        try:
+            with SheepClient(ep, timeout_s=timeout_s) as c:
+                text = c.metrics()
+                jobs = c.list()
+            replicas.append(
+                {"endpoint": ep, "up": True, "jobs": jobs,
+                 "metrics": metrics_mod.parse_prometheus(text)})
+            scrapes.append((ep, text))
+        except (ServerError, OSError) as e:
+            replicas.append({"endpoint": ep, "up": False,
+                             "error": str(e), "metrics": {},
+                             "jobs": []})
+            scrapes.append((ep, None))
+    return {"replicas": replicas,
+            "fed": federate_mod.federate(scrapes), "t": time.time()}
 
 
 def _g(parsed: dict, name: str, default=None):
@@ -153,11 +191,56 @@ def render_lines(model: dict, width: int = 100) -> List[str]:
     return [ln[:width] for ln in lines]
 
 
+def render_fleet_lines(model: dict, width: int = 100) -> List[str]:
+    """The fleet screen: one summary row per replica, then the
+    fleet-aggregate latency table over MERGED histogram buckets (the
+    federate record keeps the parse_prometheus shape, so
+    :func:`tenant_slo_rows` reads it unchanged)."""
+    reps = model["replicas"]
+    fed = model["fed"]
+    lines = []
+    n_up = sum(1 for r in reps if r["up"])
+    lines.append(f"sheep fleet: {n_up}/{len(reps)} replicas up  "
+                 f"jobs={sum(len(r['jobs']) for r in reps)}")
+    lines.append("")
+    lines.append(f"{'replica':<40}{'up':>5}{'queue':>7}{'active':>8}"
+                 f"{'reserved':>12}{'dumps':>7}")
+    for r in reps:
+        p = r["metrics"]
+        lines.append(
+            f"{r['endpoint'][-39:]:<40}"
+            f"{'ok' if r['up'] else 'DOWN':>5}"
+            f"{int(_g(p, 'sheepd_queue_depth', 0)):>7}"
+            f"{int(_g(p, 'sheepd_active_jobs', 0)):>8}"
+            f"{_fmt_bytes(_g(p, 'sheepd_reserved_bytes')):>12}"
+            f"{int(_g(p, 'sheepd_flight_dumps', 0)):>7}")
+    slo = tenant_slo_rows(fed["samples"])
+    if slo:
+        lines.append("")
+        lines.append("fleet latency (federated buckets, all replicas):")
+        lines.append(f"{'tenant':<16}{'requests':>9}{'p50':>10}"
+                     f"{'p90':>10}{'p99':>10}")
+        for row in slo:
+            lines.append(
+                f"{row['tenant'][:15]:<16}{row['requests']:>9}"
+                f"{_fmt_s(row['p50']):>10}{_fmt_s(row['p90']):>10}"
+                f"{_fmt_s(row['p99']):>10}")
+    for w in fed["warnings"]:
+        lines.append(f"warning: {w}")
+    return [ln[:width] for ln in lines]
+
+
+def _poll_lines(args, width: int = 100) -> List[str]:
+    if args.endpoint_list:
+        return render_fleet_lines(fetch_fleet(args.endpoint_list),
+                                  width=width)
+    return render_lines(fetch(args.server), width=width)
+
+
 def _loop_plain(args) -> int:
     while True:
         try:
-            model = fetch(args.server)
-            out = "\n".join(render_lines(model))
+            out = "\n".join(_poll_lines(args))
         except (ServerError, OSError) as e:
             out = f"sheeptop: daemon unreachable: {e}"
         print(out, flush=True)
@@ -175,9 +258,8 @@ def _loop_curses(args) -> int:
         scr.timeout(int(max(0.2, args.interval) * 1000))
         while True:
             try:
-                model = fetch(args.server)
-                lines = render_lines(
-                    model, width=max(20, scr.getmaxyx()[1] - 1))
+                lines = _poll_lines(
+                    args, width=max(20, scr.getmaxyx()[1] - 1))
             except (ServerError, OSError) as e:
                 lines = [f"sheeptop: daemon unreachable: {e}"]
             scr.erase()
@@ -204,8 +286,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="sheeptop",
         description="live console view over a running sheepd "
                     "(metrics + list verbs)")
-    p.add_argument("--server", required=True,
+    p.add_argument("--server", default=None,
                    help="daemon address: unix socket path or host:port")
+    p.add_argument("--endpoints", default=None, metavar="A,B",
+                   help="fleet mode: comma-separated replica "
+                        "addresses — per-replica rows + latency "
+                        "percentiles over federated buckets")
     p.add_argument("--interval", type=float, default=2.0, metavar="S",
                    help="refresh interval (default 2s)")
     p.add_argument("--once", action="store_true",
@@ -216,7 +302,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[list] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    args.endpoint_list = [e.strip() for e in
+                          (args.endpoints or "").split(",")
+                          if e.strip()]
+    if bool(args.server) == bool(args.endpoint_list):
+        parser.error("exactly one of --server or --endpoints")
     try:
         if args.once or args.plain or not sys.stdout.isatty():
             return _loop_plain(args)

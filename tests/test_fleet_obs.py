@@ -1,0 +1,491 @@
+"""Fleet observability tests (ISSUE 18): the wire trace-context codec,
+remote-parent span attrs, cross-replica metric federation (exact
+histogram merge, loud boundary mismatch, degrade-with-warning), the
+--stitch cross-process tree, the SLO gate's rule evaluation and exit
+codes, and the fleet client's routing-scrape TTL cache. The live
+two-replica failover leg (same trace id in the client and BOTH
+replicas' traces, stitched green) is tools/obs_smoke.sh leg 14."""
+
+import importlib.util
+import io
+import json
+import os
+import random
+import sys
+
+import pytest
+
+from sheep_tpu import obs
+from sheep_tpu.obs import federate as federate_mod
+from sheep_tpu.obs.metrics import MetricRegistry, parse_prometheus
+from sheep_tpu.server import protocol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_report = _load_tool("trace_report")
+slo_check = _load_tool("slo_check")
+
+
+# ---------------------------------------------------------------------------
+# traceparent codec (protocol.py)
+# ---------------------------------------------------------------------------
+
+def test_mint_trace_id_shape_and_uniqueness():
+    ids = {protocol.mint_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    for tid in ids:
+        assert len(tid) == 32
+        int(tid, 16)  # pure hex
+
+
+def test_traceparent_round_trip_with_span():
+    tid = protocol.mint_trace_id()
+    tp = protocol.make_traceparent(tid, 7)
+    assert tp == f"00-{tid}-0000000000000007-01"
+    assert protocol.parse_traceparent(tp) == (tid, "0000000000000007")
+
+
+def test_traceparent_no_span_parses_to_none():
+    """An all-zero span id means 'the client had no span of its own' —
+    the trace id still propagates."""
+    tid = protocol.mint_trace_id()
+    tp = protocol.make_traceparent(tid)
+    assert protocol.parse_traceparent(tp) == (tid, None)
+
+
+@pytest.mark.parametrize("bad", [
+    123, None, "", "garbage",
+    "00-zz-0000000000000001-01",                       # not hex
+    "00-" + "0" * 32 + "-0000000000000001-01",          # all-zero trace
+    "00-" + "a" * 31 + "-0000000000000001-01",          # short trace
+])
+def test_traceparent_rejects_malformed(bad):
+    with pytest.raises(protocol.ProtocolError):
+        protocol.parse_traceparent(bad)
+
+
+def test_request_trace_field_is_not_a_job_field():
+    """``trace`` rides at the request top level: JobSpec must keep
+    rejecting unknown job fields, and a traced submit must parse."""
+    req = {"op": "submit", "tenant": "t", "trace":
+           protocol.make_traceparent(protocol.mint_trace_id()),
+           "job": {"input": "x.txt", "k": 2}}
+    protocol.parse_request(json.dumps(req).encode() + b"\n")
+    with pytest.raises(protocol.ProtocolError):
+        protocol.JobSpec.from_request({"input": "x.txt", "k": 2,
+                                       "trace": "00-..."}, "t")
+
+
+# ---------------------------------------------------------------------------
+# tracer: remote_parent + current_span_id
+# ---------------------------------------------------------------------------
+
+def _spans_of(buf):
+    return [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if json.loads(ln).get("event") == "span_start"]
+
+
+def test_begin_detached_remote_parent_attrs():
+    buf = io.StringIO()
+    tid = protocol.mint_trace_id()
+    with obs.tracing(buf):
+        sp = obs.begin_detached(
+            "job:j1", remote_parent={"trace": tid,
+                                     "span": "00000000000000ab"})
+        sp.end()
+    rec = _spans_of(buf)[0]
+    assert rec["trace"] == tid
+    assert rec["remote_parent"] == "00000000000000ab"
+    assert rec["parent"] is None  # the LOCAL tree is untouched
+
+
+def test_begin_detached_all_zero_remote_span_drops_parent_only():
+    buf = io.StringIO()
+    tid = protocol.mint_trace_id()
+    with obs.tracing(buf):
+        obs.begin_detached("job:j1",
+                           remote_parent={"trace": tid,
+                                          "span": "0" * 16}).end()
+    rec = _spans_of(buf)[0]
+    assert rec["trace"] == tid
+    assert "remote_parent" not in rec
+
+
+def test_current_span_id_tracks_the_stack():
+    assert obs.current_span_id() is None  # untraced
+    buf = io.StringIO()
+    with obs.tracing(buf):
+        assert obs.current_span_id() is None  # traced, at root
+        with obs.span("outer") as sp:
+            assert obs.current_span_id() == sp.id
+
+
+# ---------------------------------------------------------------------------
+# federation: exact merge, loud mismatch, graceful degrade
+# ---------------------------------------------------------------------------
+
+def _replica_scrapes(n=3, per=150, seed=11):
+    """n registries with shared metric shapes; returns (texts,
+    all_observations)."""
+    rng = random.Random(seed)
+    texts, all_obs = [], []
+    for i in range(n):
+        reg = MetricRegistry()
+        c = reg.counter("sheepd_requests_total", "r",
+                        ("verb", "outcome"))
+        c.inc(10 + i, verb="submit", outcome="ok")
+        c.inc(i, verb="wait", outcome="error")
+        reg.gauge("sheepd_queue_depth", "d").set(i + 1)
+        h = reg.histogram("sheepd_request_latency_seconds", "lat",
+                          ("tenant",))
+        for _ in range(per):
+            v = rng.expovariate(1.5)
+            h.observe(v, tenant="t0")
+            all_obs.append(v)
+        texts.append(reg.render())
+    return texts, all_obs
+
+
+def test_federated_histogram_quantiles_are_exact():
+    """The fleet quantile from merged buckets equals the quantile of
+    ONE histogram fed every replica's observations — same-boundary
+    cumulative buckets add exactly (to bucket resolution, which is
+    identical by construction)."""
+    texts, all_obs = _replica_scrapes()
+    fed = federate_mod.federate(
+        [(f"r{i}", t) for i, t in enumerate(texts)])
+    ref = MetricRegistry().histogram("ref", "x", ("tenant",))
+    for v in all_obs:
+        ref.observe(v, tenant="t0")
+    for q in (0.1, 0.5, 0.9, 0.99):
+        got = federate_mod.fleet_quantile(
+            fed, "sheepd_request_latency_seconds", q, {"tenant": "t0"})
+        want = ref.quantile(q, tenant="t0")
+        assert got == pytest.approx(want, abs=1e-12), q
+
+
+def test_federated_counters_sum_and_gauges_get_replica_label():
+    texts, _ = _replica_scrapes(n=2)
+    fed = federate_mod.federate([("A", texts[0]), ("B", texts[1])])
+    totals = {(ls["verb"], ls["outcome"]): v
+              for ls, v in fed["samples"]["sheepd_requests_total"]}
+    assert totals[("submit", "ok")] == 21   # 10 + 11
+    assert totals[("wait", "error")] == 1   # 0 + 1
+    depths = {ls["replica"]: v
+              for ls, v in fed["samples"]["sheepd_queue_depth"]}
+    assert depths == {"A": 1.0, "B": 2.0}
+
+
+def test_federation_boundary_mismatch_is_a_loud_error():
+    texts, _ = _replica_scrapes(n=1)
+    other = MetricRegistry()
+    h = other.histogram("sheepd_request_latency_seconds", "lat",
+                        ("tenant",), buckets=(0.1, 1.0))
+    h.observe(0.5, tenant="t0")
+    with pytest.raises(federate_mod.FederationError,
+                       match="MISMATCHED bucket boundaries"):
+        federate_mod.federate([("A", texts[0]), ("B", other.render())])
+
+
+def test_federation_partial_and_empty_scrapes_degrade_with_warning():
+    texts, _ = _replica_scrapes(n=2)
+    fed = federate_mod.federate(
+        [("A", texts[0]), ("B", None), ("C", "   ")])
+    assert fed["answered"] == ["A"]
+    assert len(fed["warnings"]) == 2
+    assert any("B" in w for w in fed["warnings"])
+    up = {ls["replica"]: v
+          for ls, v in fed["samples"]["sheep_federated_up"]}
+    assert up == {"A": 1.0, "B": 0.0, "C": 0.0}
+    # the single answering replica's data still merges
+    assert fed["samples"]["sheepd_requests_total"]
+
+
+def test_federated_render_round_trips_through_the_parser():
+    texts, _ = _replica_scrapes(n=2)
+    fed = federate_mod.federate([("A", texts[0]), ("B", texts[1])])
+    rt = parse_prometheus(federate_mod.render_federated(fed))
+    refed = {"samples": rt}
+    for q in (0.5, 0.99):
+        assert federate_mod.fleet_quantile(
+            refed, "sheepd_request_latency_seconds", q,
+            {"tenant": "t0"}) == pytest.approx(
+            federate_mod.fleet_quantile(
+                fed, "sheepd_request_latency_seconds", q,
+                {"tenant": "t0"}), abs=1e-12)
+
+
+def test_fleet_metrics_cli_merges_saved_scrapes(tmp_path, capsys):
+    texts, _ = _replica_scrapes(n=2)
+    paths = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"r{i}.txt"
+        p.write_text(t)
+        paths.append(str(p))
+    rc = federate_mod.main(paths + [
+        "--quantile", "sheepd_request_latency_seconds:0.5:tenant=t0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sheepd_requests_total" in out
+    assert "# quantile sheepd_request_latency_seconds:0.5" in out
+
+
+# ---------------------------------------------------------------------------
+# --stitch: cross-process trace trees
+# ---------------------------------------------------------------------------
+
+TID = "ab" * 16
+
+
+def _write_jsonl(path, events):
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return str(path)
+
+
+def _failover_files(tmp_path):
+    client = [
+        {"event": "manifest", "ts": 1.0},
+        {"event": "span_start", "ts": 1.0, "span": "fleet_request",
+         "id": 1, "parent": None, "trace": TID, "tenant": "t0"},
+        {"event": "span_start", "ts": 3.0, "span": "fleet_failover",
+         "id": 2, "parent": 1, "trace": TID, "from_endpoint": "A",
+         "from_job": "j1"},
+        {"event": "span_end", "ts": 4.0, "span": "fleet_failover",
+         "id": 2, "parent": 1, "secs": 1.0, "endpoint": "B"},
+        {"event": "span_end", "ts": 5.0, "span": "fleet_request",
+         "id": 1, "parent": None, "secs": 4.0},
+    ]
+    killed = [
+        {"event": "manifest", "ts": 1.5},
+        {"event": "span_start", "ts": 1.5, "span": "job:j1", "id": 1,
+         "parent": None, "trace": TID,
+         "remote_parent": "0000000000000001", "job": "j1"},
+        {"event": "span_start", "ts": 1.6, "span": "build", "id": 2,
+         "parent": 1, "trace": TID},
+    ]  # no span_end: SIGKILL mid-build
+    survivor = [
+        {"event": "manifest", "ts": 3.2},
+        {"event": "span_start", "ts": 3.2, "span": "job:j1", "id": 1,
+         "parent": None, "trace": TID,
+         "remote_parent": "0000000000000001", "job": "j1"},
+        {"event": "span_end", "ts": 4.1, "span": "job:j1", "id": 1,
+         "parent": None, "secs": 0.9, "state": "DONE"},
+    ]
+    return [_write_jsonl(tmp_path / "client.jsonl", client),
+            _write_jsonl(tmp_path / "replica_a.jsonl", killed),
+            _write_jsonl(tmp_path / "replica_b.jsonl", survivor)]
+
+
+def _trace_report():
+    return trace_report
+
+
+def test_stitch_builds_one_tree_with_failover_seam(tmp_path):
+    tr = _trace_report()
+    trees = tr.stitch_traces(_failover_files(tmp_path))
+    assert list(trees) == [TID]
+    t = trees[TID]
+    assert tr.stitch_check(trees) == []
+    assert len(t["roots"]) == 1
+    root = t["roots"][0]
+    assert root["node"]["name"] == "fleet_request"
+    assert root["file"] == "client.jsonl"
+    kids = sorted(root["stitch_children"],
+                  key=lambda e: e["node"]["ts"])
+    names = [(e["node"]["name"], e["file"]) for e in kids]
+    assert names == [("job:j1", "replica_a.jsonl"),
+                     ("fleet_failover", "client.jsonl"),
+                     ("job:j1", "replica_b.jsonl")]
+    assert kids[0]["node"].get("unclosed")        # the killed replica
+    assert not kids[2]["node"].get("unclosed")    # the survivor
+    # the killed job's local child rode along via the parent link
+    sub = [c["node"]["name"] for c in kids[0]["stitch_children"]]
+    assert sub == ["build"]
+
+
+def test_stitch_cli_check_green_and_missing_file_fails(tmp_path,
+                                                       capsys):
+    tr = _trace_report()
+    files = _failover_files(tmp_path)
+    assert tr.main(["--stitch"] + files + ["--check"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet_request [client.jsonl]" in out
+    assert out.count("job:j1") == 2
+    # drop the client file: both job spans' remote parents dangle
+    assert tr.main(["--stitch", files[1], files[2], "--check"]) == 3
+
+
+def test_stitch_reads_every_appended_run(tmp_path):
+    """A restarted daemon appends a second run to the same trace file;
+    a graft living in run 2 must still stitch (parse_trace alone only
+    reads the last run)."""
+    tr = _trace_report()
+    files = _failover_files(tmp_path)
+    # prepend an unrelated earlier run to the survivor's file
+    earlier = [
+        {"event": "manifest", "ts": 0.1},
+        {"event": "span_start", "ts": 0.1, "span": "serve", "id": 1,
+         "parent": None},
+        {"event": "span_end", "ts": 0.2, "span": "serve", "id": 1,
+         "parent": None, "secs": 0.1},
+    ]
+    merged = "".join(json.dumps(e) + "\n" for e in earlier)
+    merged += (tmp_path / "replica_b.jsonl").read_text()
+    (tmp_path / "replica_b.jsonl").write_text(merged)
+    trees = tr.stitch_traces(files)
+    assert tr.stitch_check(trees) == []
+    assert len(trees[TID]["roots"]) == 1
+
+
+def test_last_errors_names_the_fleet_trace(capsys):
+    tr = _trace_report()
+    rep = {"path": "x.jsonl", "parsed": {"flight_dumps": [
+        {"event": "flight_dump", "job": "j1", "reason": "job_failed",
+         "trace": TID, "events": [{"t": 1.0, "ev": "job_phase"}]}]}}
+    tr.print_last_errors([rep], 8, sys.stdout)
+    assert f"trace={TID}" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# SLO gate
+# ---------------------------------------------------------------------------
+
+def _slo():
+    return slo_check
+
+
+def _slo_fed(lat=(0.02, 0.2, 1.4), errors=5, ok=95, throttled=3):
+    reg = MetricRegistry()
+    c = reg.counter("sheepd_requests_total", "r", ("verb", "outcome"))
+    if ok:
+        c.inc(ok, verb="submit", outcome="ok")
+    if errors:
+        c.inc(errors, verb="wait", outcome="error")
+    h = reg.histogram("sheepd_request_latency_seconds", "lat",
+                      ("tenant",))
+    for v in lat:
+        h.observe(v, tenant="t0")
+    t = reg.counter("sheepd_update_throttled_total", "t", ("tenant",))
+    if throttled:
+        t.inc(throttled, tenant="t0")
+    return federate_mod.federate([("A", reg.render())])
+
+
+def test_slo_evaluate_pass_and_burn():
+    slo = _slo()
+    fed = _slo_fed()
+    rules = {"tenants": {"t0": {"p99_latency_s": 10.0,
+                                "max_update_throttled": 10},
+                         "*": {"max_error_rate": 0.2}}}
+    verdicts = slo.evaluate(rules, fed)
+    assert all(v["ok"] for v in verdicts)
+    rate = next(v for v in verdicts if v["bound"] == "max_error_rate")
+    assert rate["value"] == pytest.approx(0.05)
+    tight = slo.evaluate(
+        {"tenants": {"t0": {"p99_latency_s": 0.001}}}, fed)
+    assert not tight[0]["ok"]
+
+
+def test_slo_no_data_passes_with_note_not_silently():
+    slo = _slo()
+    fed = _slo_fed(lat=(), errors=0, ok=0, throttled=0)
+    verdicts = slo.evaluate(
+        {"tenants": {"t9": {"p99_latency_s": 1.0},
+                     "*": {"max_error_rate": 0.1}}}, fed)
+    for v in verdicts:
+        assert v["ok"] and v["value"] is None and v["note"]
+
+
+def test_slo_unknown_bound_is_a_rule_error():
+    slo = _slo()
+    with pytest.raises(ValueError, match="unknown bound"):
+        slo.evaluate({"tenants": {"t0": {"p99_latnecy_s": 1.0}}},
+                     _slo_fed())
+
+
+def test_slo_cli_exit_codes(tmp_path, capsys):
+    slo = _slo()
+    reg = MetricRegistry()
+    h = reg.histogram("sheepd_request_latency_seconds", "lat",
+                      ("tenant",))
+    h.observe(0.3, tenant="t0")
+    scrape = tmp_path / "a.txt"
+    scrape.write_text(reg.render())
+    ok_rules = tmp_path / "ok.json"
+    ok_rules.write_text(json.dumps(
+        {"tenants": {"t0": {"p99_latency_s": 60.0}}}))
+    tight = tmp_path / "tight.json"
+    tight.write_text(json.dumps(
+        {"tenants": {"t0": {"p99_latency_s": 0.001}}}))
+    assert slo.main(["--rules", str(ok_rules), str(scrape)]) == 0
+    assert slo.main(["--rules", str(tight), str(scrape)]) == 2
+    out = capsys.readouterr().out
+    assert "BURN" in out
+
+
+# ---------------------------------------------------------------------------
+# fleet client: routing-scrape TTL cache
+# ---------------------------------------------------------------------------
+
+class _StubClient:
+    def __init__(self, text):
+        self.text = text
+        self.metrics_calls = 0
+
+    def metrics(self):
+        self.metrics_calls += 1
+        return self.text
+
+
+def test_fleet_load_ttl_cache_coalesces_scrapes(monkeypatch):
+    from sheep_tpu.server.client import FleetClient
+
+    reg = MetricRegistry()
+    reg.gauge("sheepd_queue_depth", "d").set(2)
+    reg.gauge("sheepd_active_jobs", "a").set(1)
+    stub = _StubClient(reg.render())
+    fc = FleetClient(["ep-a"])
+    monkeypatch.setattr(fc, "_client", lambda ep: stub)
+
+    fc.scrape_ttl_s = 60.0
+    buf = io.StringIO()
+    with obs.tracing(buf) as tracer:
+        first = fc._load("ep-a")
+        for _ in range(4):
+            assert fc._load("ep-a") == first  # served from cache
+        assert stub.metrics_calls == 1
+        assert tracer.counters.get("fleet_scrape_cache_hits") == 4
+        assert tracer.counters.get("fleet_scrape_ms", 0) > 0
+
+    fc.scrape_ttl_s = 0.0  # TTL off: every call scrapes
+    fc._load_cache.clear()
+    fc._load("ep-a")
+    fc._load("ep-a")
+    assert stub.metrics_calls == 3
+
+
+def test_fleet_load_caches_failures_too(monkeypatch):
+    from sheep_tpu.server.client import FleetClient
+
+    calls = {"n": 0}
+
+    class _Dead:
+        def metrics(self):
+            calls["n"] += 1
+            raise OSError("down")
+
+    fc = FleetClient(["ep-a"])
+    monkeypatch.setattr(fc, "_client", lambda ep: _Dead())
+    fc.scrape_ttl_s = 60.0
+    assert fc._load("ep-a") is None
+    assert fc._load("ep-a") is None  # cached verdict, no re-dial
+    assert calls["n"] == 1

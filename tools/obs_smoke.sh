@@ -924,7 +924,193 @@ python tools/trace_report.py "$TRACE13" --check \
     > "$OUT/report_odelta.txt"
 grep -q '"event": "delta_epoch_applied"' "$TRACE13"
 
+# fourteenth leg: fleet observability (ISSUE 18) — a fleet submit
+# mints ONE trace id, the wire `trace` field carries it to whichever
+# replica takes the job, and a mid-build SIGKILL + failover leaves the
+# SAME id in the client trace and BOTH replicas' traces; `--stitch`
+# renders the three files as one tree (the killed replica's job span
+# UNCLOSED under the client request span, the survivor's closed beside
+# it) with --check green; `sheep-fleet-metrics` federates the two
+# saved scrapes with the merged p99 matching a hand-summed bucket
+# merge exactly; and the SLO gate passes sane rules / exits 2 on a
+# deliberately-tight one.
+TRACE14A="$OUT/trace_fobs_a.jsonl"
+TRACE14B="$OUT/trace_fobs_b.jsonl"
+TRACE14C="$OUT/trace_fobs_client.jsonl"
+SOCK14A="$OUT/sheepd_fobs_a.sock"
+SOCK14B="$OUT/sheepd_fobs_b.sock"
+STATE14A="$OUT/fobs_state_a"
+STATE14B="$OUT/fobs_state_b"
+rm -f "$TRACE14A" "$TRACE14B" "$TRACE14C" "$SOCK14A" "$SOCK14B"
+rm -rf "$STATE14A" "$STATE14B"
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK14A" --trace "$TRACE14A" --heartbeat-secs 0.2 \
+    --state-dir "$STATE14A" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_fobs_a.err" &
+SHEEPD14A_PID=$!
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.daemon \
+    --socket "$SOCK14B" --trace "$TRACE14B" --heartbeat-secs 0.2 \
+    --state-dir "$STATE14B" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_fobs_b.err" &
+SHEEPD14B_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID $SHEEPD13_PID $SHEEPD14A_PID $SHEEPD14B_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do
+    [ -S "$SOCK14A" ] && [ -S "$SOCK14B" ] && break; sleep 0.2
+done
+[ -S "$SOCK14A" ] || { echo "fobs sheepd A never bound" >&2; exit 1; }
+[ -S "$SOCK14B" ] || { echo "fobs sheepd B never bound" >&2; exit 1; }
+# the fleet console sees both replicas (sheeptop --endpoints mode)
+JAX_PLATFORMS=cpu python -m sheep_tpu.server.sheeptop \
+    --endpoints "$SOCK14A,$SOCK14B" --once > "$OUT/fobs_sheeptop.txt"
+grep -q "2/2 replicas up" "$OUT/fobs_sheeptop.txt"
+if ! JAX_PLATFORMS=cpu python - "$SOCK14A" "$SOCK14B" \
+        "$SHEEPD14B_PID" "$OUT" "$TRACE14C" \
+        > "$OUT/fobs.json" 2> "$OUT/fobs.err" <<'PYEOF'
+import json
+import os
+import signal
+import sys
+import time
+
+from sheep_tpu import obs
+from sheep_tpu.server.client import FleetClient, SheepClient
+
+sock_a, sock_b, pid_b, out, trace = sys.argv[1:6]
+# one small job per replica first, so BOTH scrapes carry request
+# latency observations for the federation checks below
+for ep in (sock_a, sock_b):
+    with SheepClient(ep, timeout_s=600) as c:
+        jid = c.submit("rmat:8:8:1", k=[4], tenant="fleetobs",
+                       chunk_edges=1024)["job_id"]
+        assert c.wait(jid, timeout_s=300)["state"] == "done"
+with obs.tracing(trace):
+    with FleetClient([sock_b, sock_a]) as fleet:
+        rep = fleet.submit("rmat:12:8:5", k=[4], tenant="fleetobs",
+                           chunk_edges=512, dispatch_batch=1)
+        assert rep["endpoint"] == sock_b, rep
+        with SheepClient(sock_b) as cb:
+            # snapshot B's exposition BEFORE the kill: the saved
+            # file stands in for the dead replica downstream
+            with open(os.path.join(out, "fobs_scrape_b.txt"),
+                      "w") as f:
+                f.write(cb.metrics())
+            for _ in range(4000):
+                st = cb.status(rep["job_id"])
+                if st.get("phase") == "build" \
+                        and st.get("steps", 0) >= 3:
+                    break
+                time.sleep(0.005)
+            else:
+                raise SystemExit("fleet job never reached build on B")
+        os.kill(int(pid_b), signal.SIGKILL)
+        fin = fleet.wait(rep, timeout_s=300)
+        assert fin["state"] == "done", fin
+with SheepClient(sock_a) as ca:
+    with open(os.path.join(out, "fobs_scrape_a.txt"), "w") as f:
+        f.write(ca.metrics())
+    ca.shutdown()
+print(json.dumps({"cut": fin["results"][0]["edge_cut"]}))
+PYEOF
+then
+    echo "fleet obs client failed:" >&2
+    cat "$OUT/fobs.err" >&2
+    exit 1
+fi
+wait "$SHEEPD14A_PID"
+wait "$SHEEPD14B_PID" 2>/dev/null || true
+# the SAME 32-hex trace id in the client trace and BOTH replicas'
+# traces: B stamped it at submit, A on the failover resubmit
+TID14=$(JAX_PLATFORMS=cpu python - "$TRACE14C" <<'PYEOF'
+import json
+import sys
+
+for line in open(sys.argv[1]):
+    rec = json.loads(line)
+    if rec.get("span") == "fleet_request" and rec.get("trace"):
+        print(rec["trace"])
+        break
+else:
+    raise SystemExit("no traced fleet_request span in client trace")
+PYEOF
+)
+echo "$TID14" | grep -Eq '^[0-9a-f]{32}$'
+grep -q "\"trace\": \"$TID14\"" "$TRACE14A"
+grep -q "\"trace\": \"$TID14\"" "$TRACE14B"
+grep -q "\"trace\": \"$TID14\"" "$TRACE14C"
+# the routing-scrape wall counter landed in the client trace
+# (satellite: FleetClient scrape cache, ISSUE 18)
+grep -q "fleet_scrape_ms" "$TRACE14C"
+# one stitched tree across the three files, --check green, the
+# killed job span flagged and both job spans remote-grafted
+python tools/trace_report.py --stitch "$TRACE14C" "$TRACE14A" \
+    "$TRACE14B" --check > "$OUT/report_fobs_stitch.txt"
+grep -q "UNCLOSED (died mid-span" "$OUT/report_fobs_stitch.txt"
+N14=$(grep -c -- "<-remote" "$OUT/report_fobs_stitch.txt" || true)
+[ "$N14" -ge 2 ] || {
+    echo "expected both job spans grafted remotely, got $N14" >&2
+    exit 1
+}
+python tools/trace_report.py "$TRACE14A" --check > "$OUT/report_fobs_a.txt"
+# federation: the CLI's merged p99 equals a hand-summed bucket merge
+grep -q "sheepd_requests_total{" "$OUT/fobs_scrape_a.txt"
+JAX_PLATFORMS=cpu python -m sheep_tpu.obs.federate \
+    "$OUT/fobs_scrape_a.txt" "$OUT/fobs_scrape_b.txt" \
+    --quantile sheepd_request_latency_seconds:0.99 \
+    --json > "$OUT/fobs_fed.json"
+JAX_PLATFORMS=cpu python - "$OUT" <<'PYEOF'
+import json
+import os
+import sys
+
+from sheep_tpu.obs.metrics import parse_prometheus, \
+    quantile_from_cumulative
+
+out = sys.argv[1]
+fed = json.load(open(os.path.join(out, "fobs_fed.json")))
+agg = {}
+for rep in ("a", "b"):
+    with open(os.path.join(out, f"fobs_scrape_{rep}.txt")) as f:
+        m = parse_prometheus(f.read())
+    for labels, v in m.get("sheepd_request_latency_seconds_bucket", []):
+        agg[labels["le"]] = agg.get(labels["le"], 0) + v
+rows = sorted(agg.items(),
+              key=lambda kv: float(kv[0].replace("+Inf", "inf")))
+uppers = [float(le) for le, _ in rows if le != "+Inf"]
+cum = [int(c) for _, c in rows]
+hand = quantile_from_cumulative(uppers, cum, 0.99)
+got = fed["quantiles"]["sheepd_request_latency_seconds:0.99"]
+assert got is not None and abs(got - hand) < 1e-12, (got, hand)
+smp = fed["samples"]
+assert any(lb.get("outcome") == "ok"
+           for lb, _ in smp["sheepd_requests_total"]), \
+    sorted(smp)
+ups = {lb["replica"]: v for lb, v in smp["sheep_federated_up"]}
+assert len(ups) == 2 and all(v == 1 for v in ups.values()), ups
+print(json.dumps({"fleet_p99": got, "hand_p99": hand}))
+PYEOF
+# the SLO gate: sane rules hold (exit 0), a deliberately-tight p99
+# bound burns (exit 2) — over the same two saved scrapes
+cat > "$OUT/fobs_slo.json" <<'JSON'
+{"tenants": {
+    "fleetobs": {"p99_latency_s": 600.0, "max_update_throttled": 0},
+    "*": {"p99_latency_s": 600.0, "max_error_rate": 0.25}}}
+JSON
+JAX_PLATFORMS=cpu python tools/slo_check.py --rules "$OUT/fobs_slo.json" \
+    "$OUT/fobs_scrape_a.txt" "$OUT/fobs_scrape_b.txt" \
+    > "$OUT/fobs_slo_ok.txt"
+grep -q "4/4 bounds hold" "$OUT/fobs_slo_ok.txt"
+cat > "$OUT/fobs_slo_tight.json" <<'JSON'
+{"tenants": {"*": {"p99_latency_s": 0.000001}}}
+JSON
+rc=0
+JAX_PLATFORMS=cpu python tools/slo_check.py \
+    --rules "$OUT/fobs_slo_tight.json" \
+    "$OUT/fobs_scrape_a.txt" "$OUT/fobs_scrape_b.txt" \
+    > "$OUT/fobs_slo_burn.txt" || rc=$?
+[ "$rc" -eq 2 ] || { echo "tight SLO rule did not burn (rc=$rc)" >&2; exit 1; }
+grep -q "BURN" "$OUT/fobs_slo_burn.txt"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A $TRACE13"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A $TRACE13 $TRACE14A"

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Declarative fleet SLO gate over a federated scrape (ISSUE 18
+tentpole, layer 3).
+
+    python tools/slo_check.py --rules slo.json --endpoints A,B
+    python tools/slo_check.py --rules slo.json saved_a.txt saved_b.txt
+
+Rule file: JSON mapping tenant -> bounds. Tenant ``"*"`` means
+fleet-wide (every tenant's series merged)::
+
+    {"tenants": {
+        "t0": {"p99_latency_s": 5.0, "max_update_throttled": 100},
+        "*":  {"p99_latency_s": 30.0, "max_error_rate": 0.01}}}
+
+Bounds (each optional):
+
+- ``p<N>_latency_s`` — the N-th percentile of the federated
+  ``sheepd_request_latency_seconds`` histogram (queued->done) must not
+  exceed the bound. Any percentile works: ``p50_latency_s``,
+  ``p99_latency_s``, ...
+- ``max_error_rate`` — error/total over the federated
+  ``sheepd_requests_total{verb,outcome}`` counter. The series has no
+  tenant label, so this bound is ALWAYS evaluated fleet-wide (a
+  per-tenant entry carrying it gets a note saying so).
+- ``max_update_throttled`` — the federated
+  ``sheepd_update_throttled_total`` count (update items deferred by
+  the per-tenant byte budget, ISSUE 17) must not exceed the bound.
+
+A bound whose series holds no data PASSES with a note — no traffic is
+not an SLO burn (the obs_smoke leg exercises both directions with
+live daemons). Replicas that fail to scrape degrade with a warning,
+exactly as ``sheep-fleet-metrics`` does; ZERO answering replicas is an
+error, not a pass.
+
+Exit codes: 0 every bound holds; 1 usage/IO/no replica answered;
+2 at least one bound burned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheep_tpu.obs import federate as federate_mod  # noqa: E402
+from sheep_tpu.obs.metrics import quantile_from_cumulative  # noqa: E402
+
+LATENCY_METRIC = "sheepd_request_latency_seconds"
+REQUESTS_METRIC = "sheepd_requests_total"
+THROTTLE_METRIC = "sheepd_update_throttled_total"
+
+_PCT_RE = re.compile(r"^p(\d{1,2}(?:\.\d+)?)_latency_s$")
+
+
+def tenant_quantile(fed: dict, q: float, tenant=None):
+    """Quantile of the federated latency histogram for one tenant, or
+    across ALL tenants when tenant is None — per-``le`` counts sum
+    across tenant series first (histogram_series_quantile assumes one
+    series, so the cross-tenant merge happens here)."""
+    agg: dict = {}
+    for labels, v in fed["samples"].get(LATENCY_METRIC + "_bucket", []):
+        if tenant is not None and labels.get("tenant") != tenant:
+            continue
+        le = labels.get("le")
+        if le is None:
+            continue
+        agg[le] = agg.get(le, 0) + v
+    if not agg:
+        return None
+    rows = sorted(agg.items(),
+                  key=lambda kv: float(kv[0].replace("+Inf", "inf")))
+    uppers = [float(le) for le, _ in rows
+              if not math.isinf(float(le.replace("+Inf", "inf")))]
+    cum = [int(c) for _, c in rows]
+    return quantile_from_cumulative(uppers, cum, q)
+
+
+def fleet_error_rate(fed: dict):
+    """(errors / total, total) over the federated requests counter, or
+    None when no requests were tallied."""
+    total = errors = 0.0
+    for labels, v in fed["samples"].get(REQUESTS_METRIC, []):
+        total += v
+        if labels.get("outcome") == "error":
+            errors += v
+    if total <= 0:
+        return None
+    return errors / total, total
+
+
+def tenant_throttled(fed: dict, tenant=None) -> float:
+    return sum(v for labels, v
+               in fed["samples"].get(THROTTLE_METRIC, [])
+               if tenant is None or labels.get("tenant") == tenant)
+
+
+def evaluate(rules: dict, fed: dict) -> list:
+    """[{tenant, bound, limit, value, ok, note}] — one verdict per
+    declared bound. Unknown bound keys are a rule-file error (raise),
+    not a silent pass: a typo'd bound that never evaluates is an SLO
+    gate that never gates."""
+    tenants = rules.get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        raise ValueError('rules must be {"tenants": {tenant: '
+                         '{bound: limit}}} with >= 1 tenant')
+    verdicts = []
+    for tenant, bounds in sorted(tenants.items()):
+        if not isinstance(bounds, dict):
+            raise ValueError(f"tenant {tenant!r}: bounds must be a "
+                             f"dict, got {type(bounds).__name__}")
+        t = None if tenant == "*" else tenant
+        for key, limit in sorted(bounds.items()):
+            limit = float(limit)
+            v = {"tenant": tenant, "bound": key, "limit": limit,
+                 "value": None, "ok": True, "note": ""}
+            m = _PCT_RE.match(key)
+            if m:
+                q = float(m.group(1)) / 100.0
+                got = tenant_quantile(fed, q, t)
+                if got is None:
+                    v["note"] = "no latency observations — no traffic"
+                else:
+                    v["value"] = got
+                    v["ok"] = got <= limit
+            elif key == "max_error_rate":
+                got = fleet_error_rate(fed)
+                if t is not None:
+                    v["note"] = (f"{REQUESTS_METRIC} has no tenant "
+                                 f"label; evaluated fleet-wide")
+                if got is None:
+                    v["note"] = (v["note"] + "; " if v["note"] else
+                                 "") + "no requests tallied"
+                else:
+                    rate, total = got
+                    v["value"] = rate
+                    v["ok"] = rate <= limit
+                    v["note"] = (v["note"] + "; " if v["note"] else
+                                 "") + f"{int(total)} requests"
+            elif key == "max_update_throttled":
+                got = tenant_throttled(fed, t)
+                v["value"] = got
+                v["ok"] = got <= limit
+            else:
+                raise ValueError(
+                    f"tenant {tenant!r}: unknown bound {key!r} "
+                    f"(want p<N>_latency_s, max_error_rate, or "
+                    f"max_update_throttled)")
+            verdicts.append(v)
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Evaluate declarative per-tenant SLO rules over a "
+                    "federated fleet scrape; exit 2 on any burn.")
+    ap.add_argument("endpoint", nargs="*",
+                    help="replica endpoints (unix socket / URL / "
+                         "saved scrape file)")
+    ap.add_argument("--rules", required=True,
+                    help="JSON rule file (see module docstring)")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated endpoints")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdicts")
+    args = ap.parse_args(argv)
+
+    endpoints = list(args.endpoint)
+    if args.endpoints:
+        endpoints += [e.strip() for e in args.endpoints.split(",")
+                      if e.strip()]
+    if not endpoints:
+        ap.error("no endpoints given")
+    try:
+        with open(args.rules) as f:
+            rules = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read rules {args.rules}: {e}",
+              file=sys.stderr)
+        return 1
+
+    scrapes = federate_mod.scrape_fleet(endpoints,
+                                        timeout_s=args.timeout)
+    try:
+        fed = federate_mod.federate(scrapes)
+        verdicts = evaluate(rules, fed)
+    except (federate_mod.FederationError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    for w in fed["warnings"]:
+        print(f"warning: {w}", file=sys.stderr)
+    if not fed["answered"]:
+        print("error: no replica answered a scrape", file=sys.stderr)
+        return 1
+
+    burned = [v for v in verdicts if not v["ok"]]
+    if args.json:
+        json.dump({"ok": not burned, "verdicts": verdicts,
+                   "replicas": fed["replicas"],
+                   "answered": fed["answered"],
+                   "warnings": fed["warnings"]},
+                  sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        for v in verdicts:
+            val = "n/a" if v["value"] is None \
+                else f"{v['value']:.6g}"
+            note = f"  ({v['note']})" if v["note"] else ""
+            print(f"{'BURN' if not v['ok'] else 'ok  '} "
+                  f"tenant={v['tenant']} {v['bound']} "
+                  f"value={val} limit={v['limit']:g}{note}")
+        print(f"slo: {len(verdicts) - len(burned)}/{len(verdicts)} "
+              f"bounds hold across {len(fed['answered'])} replica(s)"
+              + (f" — {len(burned)} BURNED" if burned else ""))
+    return 2 if burned else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

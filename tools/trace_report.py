@@ -5,6 +5,7 @@ per-span self/total-time tree with counter deltas.
     python tools/trace_report.py out.jsonl [--check] [--json]
     python tools/trace_report.py out.jsonl --last-errors [N]
     python tools/trace_report.py run_a.jsonl run_b.jsonl   # + attribution
+    python tools/trace_report.py --stitch client.jsonl a.jsonl b.jsonl
 
 One trace: manifest summary, the span tree (spans with the same name
 under the same parent aggregate into one row with a count), per-row
@@ -24,10 +25,24 @@ each trace's build wall + host_syncs/device_rounds counters — two runs
 of the same build at different --dispatch-batch yield the per-dispatch
 overhead vs per-round device cost split.
 
-``--check`` exits non-zero unless the trace is well-formed AND
-complete: parses, has a manifest, every span end matches a start,
-no span is left unclosed, and >= 1 heartbeat exists (the obs_smoke
-gate).
+``--stitch FILE...`` (ISSUE 18) merges SEVERAL trace files — a fleet
+client's plus each replica daemon's — by propagated trace id into one
+cross-process tree per fleet request: spans carrying a ``trace`` attr
+(and their local descendants) group by that id, and a span whose
+``remote_parent`` attr names a client span's local id grafts under
+that span even though the two live in different files. A failover
+renders as two ``job:`` spans under one ``fleet_request`` — the
+killed replica's UNCLOSED, the survivor's closed. Unlike the
+single-trace report, stitch reads EVERY appended run in each file (a
+restarted daemon's runs all hold grafts). With ``--check`` it exits 3
+unless >= 1 trace id is present and every trace forms exactly one
+tree (no unmatched remote_parent, no second root); UNCLOSED spans are
+fine there — they ARE the failover seam.
+
+``--check`` (without --stitch) exits non-zero unless the trace is
+well-formed AND complete: parses, has a manifest, every span end
+matches a start, no span is left unclosed, and >= 1 heartbeat exists
+(the obs_smoke gate).
 
 Exit codes: 0 ok; 1 usage/IO; 2 malformed trace (an end without a
 start, unparseable beyond stray truncation); 3 --check unsatisfied.
@@ -43,20 +58,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def parse_trace(path: str) -> dict:
-    """Parse one trace file into {events, spans, roots, errors...}.
-
-    --trace appends, so one file may hold SEVERAL runs; each run's span
-    ids restart at 1. The stream is segmented into runs (a new manifest
-    after spans were seen, or a span_start whose id already exists in
-    the current segment, starts the next one) and the LAST run is
-    reported, with ``n_runs`` recording how many the file holds —
-    merging them would attach run 2's children to run 1's ids and
-    silently corrupt every number in the report.
-
-    A truncated LAST line (the process died mid-write) is tolerated
-    silently; any other unparseable line is reported. span_end without
-    a matching span_start marks the trace malformed."""
+def _read_events(path: str) -> tuple:
+    """(events, bad line numbers). A truncated LAST line (the process
+    died mid-write) is tolerated silently; any other unparseable line
+    is reported."""
     all_events = []
     bad_lines = []
     with open(path) as f:
@@ -70,14 +75,19 @@ def parse_trace(path: str) -> dict:
             if i == len(lines) - 1:
                 continue  # mid-write kill; everything before it counts
             bad_lines.append(i + 1)
+    return all_events, bad_lines
 
-    # Run boundaries: a span_start whose id already exists in the
-    # current segment (ids restart at 1 per Tracer) OR a manifest
-    # arriving when every current span is closed. The open-span
-    # condition matters: multi-host traces legitimately emit the
-    # manifest AFTER the root span opened (deferred until
-    # jax.distributed.initialize) — splitting there would orphan the
-    # root's span_end and mis-report a valid trace as malformed.
+
+def _segment_runs(all_events: list) -> list:
+    """Split an appended-to trace stream into per-run segments.
+
+    Run boundaries: a span_start whose id already exists in the
+    current segment (ids restart at 1 per Tracer) OR a manifest
+    arriving when every current span is closed. The open-span
+    condition matters: multi-host traces legitimately emit the
+    manifest AFTER the root span opened (deferred until
+    jax.distributed.initialize) — splitting there would orphan the
+    root's span_end and mis-report a valid trace as malformed."""
     segments: list = [[]]
     seen_ids: set = set()
     open_ids: set = set()
@@ -113,8 +123,13 @@ def parse_trace(path: str) -> dict:
         elif ev == "span_end":
             open_ids.discard(e.get("id"))
         segments[-1].append(e)
-    events = segments[-1]
+    return segments
 
+
+def _build_spans(events: list) -> tuple:
+    """One run's events -> (spans by id, roots, unclosed, orphan
+    ends). Unclosed spans get a lower-bound duration (span start to
+    the run's last record) and an ``unclosed`` flag."""
     spans: dict = {}  # id -> node
     orphan_ends = []
     last_ts = max((e.get("ts", 0) for e in events), default=0)
@@ -135,6 +150,12 @@ def parse_trace(path: str) -> dict:
                 continue
             node["secs"] = e.get("secs", 0.0)
             node["counters"] = e.get("counters", {})
+            # span_end is where annotate()d attrs land — fold any the
+            # start record lacked (reattach trace adoption, ISSUE 18)
+            for k, v in e.items():
+                if k not in ("event", "ts", "span", "id", "parent",
+                             "secs", "counters"):
+                    node["attrs"].setdefault(k, v)
     roots = []
     for node in spans.values():
         parent = spans.get(node["parent"])
@@ -147,6 +168,23 @@ def parse_trace(path: str) -> dict:
         # lower bound: span start to the last record the run managed
         n["secs"] = max(0.0, round(last_ts - n["ts"], 3))
         n["unclosed"] = True
+    return spans, roots, unclosed, orphan_ends
+
+
+def parse_trace(path: str) -> dict:
+    """Parse one trace file into {events, spans, roots, errors...}.
+
+    --trace appends, so one file may hold SEVERAL runs; each run's span
+    ids restart at 1. The stream is segmented into runs
+    (:func:`_segment_runs`) and the LAST run is reported, with
+    ``n_runs`` recording how many the file holds — merging them would
+    attach run 2's children to run 1's ids and silently corrupt every
+    number in the report. span_end without a matching span_start marks
+    the trace malformed."""
+    all_events, bad_lines = _read_events(path)
+    segments = _segment_runs(all_events)
+    events = segments[-1]
+    spans, roots, unclosed, orphan_ends = _build_spans(events)
     return {
         "events": events, "spans": spans, "roots": roots,
         "n_runs": len(segments),
@@ -382,7 +420,8 @@ def print_report(rep: dict, out) -> None:
     for d in parsed["flight_dumps"]:
         out.write(f"flight dump: job={d.get('job')} "
                   f"reason={d.get('reason')} "
-                  f"events={d.get('n_events', len(d.get('events') or []))}"
+                  + (f"trace={d.get('trace')} " if d.get("trace") else "")
+                  + f"events={d.get('n_events', len(d.get('events') or []))}"
                   f"  (render with --last-errors)\n")
     if parsed["job_spans"]:
         for e in parsed["job_spans"]:
@@ -459,6 +498,181 @@ def print_quality(parsed: dict, out) -> None:
                   f"balance={jq.get('balance')}\n")
 
 
+def parse_runs(path: str) -> list:
+    """EVERY run in ``path`` (not just the last — contrast
+    parse_trace), one {run, spans, roots} dict each: the --stitch
+    input, where a failover story spans a client file plus several
+    daemon files each possibly holding restart-appended runs."""
+    all_events, _bad = _read_events(path)
+    out = []
+    for i, seg in enumerate(_segment_runs(all_events)):
+        spans, roots, unclosed, orphans = _build_spans(seg)
+        out.append({"run": i, "spans": spans, "roots": roots,
+                    "orphan_ends": orphans})
+    return out
+
+
+def stitch_traces(paths: list) -> dict:
+    """Merge spans from several trace files into one cross-process
+    tree per propagated trace id (ISSUE 18).
+
+    Membership: a span carrying a ``trace`` attr seeds its trace's
+    group, and its local descendants (children by in-file parent
+    links — the engine phase spans under a job span) ride along.
+    Grafting: a member whose ``remote_parent`` attr names a 16-hex
+    span id attaches under the member in a DIFFERENT file/run whose
+    local id matches (the originating client span); members without
+    one attach to their local parent when it is also a member, else
+    they root the tree. Returns {trace_id: {"roots", "ungrafted",
+    "n_spans", "files"}} where roots' entries carry
+    ``stitch_children`` ordered by wall-clock start."""
+    by_tid: dict = {}
+    for path in paths:
+        label = os.path.basename(path)
+        for run in parse_runs(path):
+            spans = run["spans"]
+            tids = {n["attrs"].get("trace") for n in spans.values()}
+            tids.discard(None)
+            for tid in tids:
+                members: dict = {}
+
+                def add(n):
+                    if n["id"] in members:
+                        return
+                    members[n["id"]] = n
+                    for c in n["children"]:
+                        add(c)
+
+                for n in spans.values():
+                    if n["attrs"].get("trace") == tid:
+                        add(n)
+                group = by_tid.setdefault(tid, [])
+                for n in members.values():
+                    group.append({"node": n, "file": label,
+                                  "run": run["run"]})
+    trees: dict = {}
+    for tid, entries in sorted(by_tid.items()):
+        by_local_id: dict = {}
+        by_key: dict = {}
+        for e in entries:
+            e["stitch_children"] = []
+            by_local_id.setdefault(e["node"]["id"], []).append(e)
+            by_key[(e["file"], e["run"], e["node"]["id"])] = e
+        roots = []
+        ungrafted = []
+        for e in entries:
+            n = e["node"]
+            parent_entry = None
+            rp = n["attrs"].get("remote_parent")
+            if rp is not None:
+                try:
+                    pid = int(str(rp), 16)
+                except ValueError:
+                    pid = None
+                # the remote parent is by definition in ANOTHER
+                # process's file — same-file id collisions (span ids
+                # restart at 1 per run) never qualify
+                cands = [c for c in by_local_id.get(pid, [])
+                         if (c["file"], c["run"]) != (e["file"],
+                                                      e["run"])]
+                if cands:
+                    parent_entry = cands[0]
+                else:
+                    ungrafted.append(e)
+            else:
+                parent_entry = by_key.get(
+                    (e["file"], e["run"], n["parent"]))
+            if parent_entry is not None:
+                parent_entry["stitch_children"].append(e)
+            else:
+                roots.append(e)
+        trees[tid] = {"roots": roots, "ungrafted": ungrafted,
+                      "n_spans": len(entries),
+                      "files": sorted({e["file"] for e in entries})}
+    return trees
+
+
+_STITCH_ATTRS = ("tenant", "job", "job_id", "endpoint", "why",
+                 "from_endpoint", "from_job", "state")
+
+
+def _stitch_entry_dict(e: dict) -> dict:
+    n = e["node"]
+    return {"span": n["name"], "file": e["file"], "run": e["run"],
+            "id": n["id"], "secs": n["secs"],
+            "unclosed": bool(n.get("unclosed")),
+            "remote": n["attrs"].get("remote_parent") is not None,
+            "attrs": {k: n["attrs"][k] for k in _STITCH_ATTRS
+                      if n["attrs"].get(k) is not None},
+            "children": [_stitch_entry_dict(c)
+                         for c in sorted(e["stitch_children"],
+                                         key=lambda c: c["node"]["ts"])]}
+
+
+def stitch_json(trees: dict) -> dict:
+    return {"traces": [
+        {"trace": tid, "n_spans": t["n_spans"], "files": t["files"],
+         "ungrafted": len(t["ungrafted"]),
+         "roots": [_stitch_entry_dict(r)
+                   for r in sorted(t["roots"],
+                                   key=lambda e: e["node"]["ts"])]}
+        for tid, t in trees.items()]}
+
+
+def print_stitched(trees: dict, out) -> None:
+    first = True
+    for tid, t in trees.items():
+        if not first:
+            out.write("\n")
+        first = False
+        out.write(f"trace {tid}  ({t['n_spans']} spans across "
+                  f"{', '.join(t['files'])}):\n")
+
+        def walk(e, depth):
+            n = e["node"]
+            bits = [f"{k}={n['attrs'][k]}" for k in _STITCH_ATTRS
+                    if n["attrs"].get(k) is not None]
+            mark = " <-remote" if n["attrs"].get("remote_parent") \
+                is not None else ""
+            flag = "  UNCLOSED (died mid-span — the failover seam?)" \
+                if n.get("unclosed") else ""
+            name = f"{n['name']} [{e['file']}]"
+            out.write(f"  {'  ' * depth}{name:<{max(1, 40 - 2 * depth)}}"
+                      f"{n['secs'] or 0.0:>9.3f}s{mark}"
+                      f"{'  ' if bits else ''}{' '.join(bits)}{flag}\n")
+            for c in sorted(e["stitch_children"],
+                            key=lambda c: c["node"]["ts"]):
+                walk(c, depth + 1)
+
+        for r in sorted(t["roots"], key=lambda e: e["node"]["ts"]):
+            walk(r, 0)
+        for e in t["ungrafted"]:
+            out.write(f"  warning: {e['node']['name']} [{e['file']}] "
+                      f"names remote_parent="
+                      f"{e['node']['attrs'].get('remote_parent')} but "
+                      f"no given file holds that span — stitch is "
+                      f"missing the originating trace file?\n")
+
+
+def stitch_check(trees: dict) -> list:
+    """--check failures for stitch mode: every propagated trace must
+    form exactly ONE grafted tree. UNCLOSED spans are expected (a
+    killed replica's job span IS the failover evidence) and do not
+    fail the check."""
+    fails = []
+    if not trees:
+        fails.append("no propagated trace ids in the given files")
+    for tid, t in trees.items():
+        if t["ungrafted"]:
+            fails.append(
+                f"trace {tid}: {len(t['ungrafted'])} span(s) with an "
+                f"unmatched remote_parent (missing a trace file?)")
+        if len(t["roots"]) != 1:
+            fails.append(f"trace {tid}: {len(t['roots'])} roots — "
+                         f"expected one stitched tree")
+    return fails
+
+
 def _fmt_flight_event(e: dict, t0: float) -> str:
     bits = [f"+{max(0.0, e.get('t', t0) - t0):7.3f}s",
             str(e.get("ev", "?"))]
@@ -483,8 +697,12 @@ def print_last_errors(reports: list, n: int, out) -> int:
         for d in dumps:
             evs = d.get("events") or []
             tail = evs[-n:]
+            # a propagated trace id (ISSUE 18) names the fleet request
+            # this failure belongs to — the handle --stitch groups by
             out.write(f"  {d.get('job')}  reason={d.get('reason')}  "
-                      f"({len(evs)} buffered, last {len(tail)}):\n")
+                      + (f"trace={d.get('trace')}  "
+                         if d.get("trace") else "")
+                      + f"({len(evs)} buffered, last {len(tail)}):\n")
             t0 = tail[0].get("t", 0.0) if tail else 0.0
             for e in tail:
                 out.write(f"    {_fmt_flight_event(e, t0)}\n")
@@ -500,10 +718,17 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Render obs trace JSONL as a span tree; two traces "
                     "add the dispatch-cost attribution solve.")
-    ap.add_argument("trace", help="trace JSONL (from --trace)")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="trace JSONL (from --trace)")
     ap.add_argument("trace_b", nargs="?", default=None,
                     help="second trace: solve per-dispatch vs per-round "
                          "cost from the two runs' dispatch counts")
+    ap.add_argument("--stitch", nargs="+", default=None, metavar="FILE",
+                    help="merge several trace files by propagated "
+                         "trace id into one cross-process tree per "
+                         "fleet request (client span + every "
+                         "replica's job spans; reads ALL appended "
+                         "runs per file)")
     ap.add_argument("--check", action="store_true",
                     help="exit 3 unless well-formed + manifest + "
                          "complete span tree + >= 1 heartbeat")
@@ -515,6 +740,31 @@ def main(argv=None) -> int:
                          "recorder events buffered before each failed "
                          "job / fault / shutdown dump")
     args = ap.parse_args(argv)
+
+    if args.stitch:
+        paths = list(args.stitch)
+        paths += [p for p in (args.trace, args.trace_b) if p]
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"error: no such trace: {p}", file=sys.stderr)
+                return 1
+        trees = stitch_traces(paths)
+        if args.json:
+            json.dump(stitch_json(trees), sys.stdout, indent=1,
+                      default=str)
+            print()
+        else:
+            print_stitched(trees, sys.stdout)
+        if args.check:
+            fails = stitch_check(trees)
+            if fails:
+                for c in fails:
+                    print(f"check failed [stitch]: {c}",
+                          file=sys.stderr)
+                return 3
+        return 0
+    if args.trace is None:
+        ap.error("a trace file is required (or --stitch FILE...)")
 
     reports = []
     checks = []
