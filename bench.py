@@ -333,7 +333,7 @@ def measure(scale: int, platform: str) -> dict:
             0, un, (min(1 << 15, max(1024, (un * edge_factor) // 256)),
                     2), dtype=np.int64)
 
-        def scored_epoch(sc):
+        def scored_epoch(sc, name="tpu"):
             """One SCORED update epoch at RMAT-``sc``: returns the
             (fold_s, score_s, state) split — the score side comes
             from the state's own update_score_s accounting, so it
@@ -343,7 +343,7 @@ def measure(scale: int, platform: str) -> dict:
             pass that builds the survivor index."""
             stream = generators.RmatHashStream(sc, edge_factor,
                                                seed=42)
-            be = get_backend("tpu", chunk_edges=min(
+            be = get_backend(name, chunk_edges=min(
                 accel_chunk, (1 << sc) * edge_factor))
             st, _ = inc_mod.begin_incremental(stream, k, backend=be,
                                               comm_volume=False)
@@ -383,6 +383,21 @@ def measure(scale: int, platform: str) -> dict:
                 f"{out['epoch_scale_x2']}x on a 2x base — the "
                 f"O(delta) incremental-score path may have fallen "
                 f"back to full rescoring")
+        # multi-device update leg (ISSUE 19): the SAME scored epoch
+        # through the sharded lockstep fold + distributed rescore —
+        # what a resident sharded partition pays per delta epoch.
+        # Gated lower-better by bench_regress like update_request_s.
+        fold_sh, score_sh, sh_state = scored_epoch(us,
+                                                   name="tpu-sharded")
+        out["sharded_update_request_s"] = round(fold_sh + score_sh, 4)
+        log(f"sharded incremental: {out['sharded_update_request_s']}s "
+            f"(fold {fold_sh:.4f}s + score {score_sh:.4f}s, "
+            f"update_folds="
+            f"{int(sh_state.stats.get('update_folds', 0))}, "
+            f"score_distributed="
+            f"{int(sh_state.stats.get('score_distributed', 0))}, "
+            f"device_rounds="
+            f"{int(sh_state.stats.get('device_rounds', 0))})")
     except Exception as e:  # noqa: BLE001 — the leg must not kill bench
         log(f"incremental leg skipped: {type(e).__name__}: "
             f"{str(e)[:200]}")
@@ -677,7 +692,7 @@ def main():
               "checkpoint_degraded", "warm_up_s", "cold_request_s",
               "warm_request_s", "cached_request_s", "update_request_s",
               "update_fold_s", "update_score_s", "epoch_scale_x2",
-              "compactions"):
+              "sharded_update_request_s", "compactions"):
         if f in result:
             extra[f] = result[f]
     if failures:

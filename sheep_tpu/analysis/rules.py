@@ -44,6 +44,14 @@ Rules:
   ``jnp.asarray``/``jnp.array``/``jax.device_put`` of a host value
   inside a loop (the synchronous-upload shape the staged H2D ring
   removed, ISSUE 12); designed windows carry ``# sheeplint: h2d-ok``.
+- **fold** — the resident delta-fold path (ISSUE 19): inside a
+  ``*fold_delta*``/``*move_rescore*`` function, constructing a fold
+  pipeline or a jit (per-EPOCH recompile — the cached ``_update_pipe``
+  / ``_MOVE_RESCORE_CACHE`` helpers exist so repeat epochs reuse every
+  compiled program) and per-CHUNK host pulls inside a loop
+  (``np.asarray``/``.item()``/``.tolist()``/``.block_until_ready()`` —
+  the O(Δ) epoch's designed shape is ONE pull after the fold
+  converges); designed windows carry ``# sheeplint: fold-ok``.
 """
 
 from __future__ import annotations
@@ -706,6 +714,96 @@ def check_h2d(ctx: RuleContext) -> None:
 
 
 # ---------------------------------------------------------------------------
+# delta fold path (ISSUE 19): per-epoch recompiles and per-chunk host
+# syncs in the resident update fold
+# ---------------------------------------------------------------------------
+
+#: the multi-device fold pipelines — constructing one compiles programs
+FOLD_PIPELINE_CTORS = {"ShardedPipeline", "BigVPipeline"}
+
+#: method pulls that synchronize device work onto the host
+FOLD_PULL_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+class _FoldPath(ast.NodeVisitor):
+    """Scan ``*fold_delta*`` / ``*move_rescore*`` function bodies — the
+    per-epoch resident update path (ISSUE 19). Two regression classes:
+
+    - a fold pipeline constructed (or a jit built) inline re-COMPILES
+      every epoch; the epoch cost then is compile wall, not the O(Δ)
+      fold — the cached ``_update_pipe`` / ``_MOVE_RESCORE_CACHE``
+      helpers are the blessed shape;
+    - a host pull (``np.asarray``/``.item()``/``.tolist()``/
+      ``.block_until_ready()``) inside a chunk loop serializes the
+      lockstep fold per chunk; the designed shape pulls ONCE after the
+      fold converges (those single pulls sit at loop depth 0, or carry
+      ``# sheeplint: fold-ok``)."""
+
+    def __init__(self, ctx: RuleContext):
+        self.ctx = ctx
+        self.loop_depth = 0
+        self.in_fold = False
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def _def(self, node):
+        name = getattr(node, "name", "")
+        # _make_* builders are the cached-construction fix this rule
+        # recommends — the one place a compile belongs
+        on_path = ("fold_delta" in name or "move_rescore" in name) \
+            and not name.startswith("_make")
+        fold, self.in_fold = self.in_fold, self.in_fold or on_path
+        # a nested function's body does not execute per iteration of
+        # the enclosing loop; it gets its own scan at depth 0
+        depth, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = depth
+        self.in_fold = fold
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _def
+
+    def visit_Lambda(self, node):
+        depth, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = depth
+
+    def visit_Call(self, node):
+        if self.in_fold:
+            term = _terminal(node.func)
+            is_jit, _ = _jit_call_info(node)
+            if term in FOLD_PIPELINE_CTORS or is_jit:
+                self.ctx.add(
+                    "fold", "error", node,
+                    f"{term}(...) constructed on the delta fold path: "
+                    "every epoch recompiles its programs — build it "
+                    "once in a cached helper (the _update_pipe "
+                    "convention), or annotate a designed window with "
+                    "'# sheeplint: fold-ok'")
+            elif self.loop_depth > 0:
+                pull = _is_np_pull(node) or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in FOLD_PULL_METHODS)
+                if pull:
+                    self.ctx.add(
+                        "fold", "error", node,
+                        "host pull inside a loop on the delta fold "
+                        "path serializes the lockstep fold per chunk "
+                        "— pull ONCE after the fold converges, or "
+                        "annotate a designed window with "
+                        "'# sheeplint: fold-ok'")
+        self.generic_visit(node)
+
+
+def check_fold(ctx: RuleContext) -> None:
+    _FoldPath(ctx).visit(ctx.tree)
+
+
+# ---------------------------------------------------------------------------
 # lock discipline
 # ---------------------------------------------------------------------------
 
@@ -781,7 +879,7 @@ def check_locks(ctx: RuleContext) -> None:
 # ---------------------------------------------------------------------------
 
 ALL_CHECKS = (check_sync_donate, check_jit_hygiene, check_resources,
-              check_locks, check_h2d)
+              check_locks, check_h2d, check_fold)
 
 
 def check_file(path: str, source: str, tree: ast.Module,

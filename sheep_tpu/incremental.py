@@ -515,14 +515,19 @@ def _survivor_arcs_from(state: PartitionState,
 
 
 def _rescore_incremental(state: PartitionState, assigns: dict,
-                         w) -> dict:
+                         w, backend=None) -> dict:
     """The O(Δ)-per-epoch scored refresh: accumulators already carry
     the multiset delta (apply_update folded it under the cached
     assignments), so only the REASSIGNMENT delta remains — rescore the
     arcs incident to vertices whose label moved, per k. Returns the
     same ``{k: (cut, total, balance, cv)}`` shape as score_stream;
     balance is recomputed O(V) with the identical part_balance call,
-    so every field is bit-equal to the full pass."""
+    so every field is bit-equal to the full pass.
+
+    A backend exposing ``_move_rescore`` (the multi-device backends,
+    ISSUE 19) takes the rescore device-side: per-shard per-k cut
+    deltas all-reduced ONCE per epoch, bit-equal to the host scorer
+    (:func:`sheep_tpu.ops.score.move_rescore_sharded`)."""
     from sheep_tpu.core import pure
     from sheep_tpu.ops.refine import move_rescore_host
 
@@ -535,10 +540,21 @@ def _rescore_incremental(state: PartitionState, assigns: dict,
     changed = np.flatnonzero(union)
     if len(changed):
         src, dst = _survivor_arcs_from(state, changed)
-        for k, a in assigns.items():
-            if masks[k].any():
-                cut[k] += move_rescore_host(src, dst, prev[k], a,
-                                            masks[k])
+        hook = getattr(backend, "_move_rescore", None)
+        ks_m = [k for k in assigns if masks[k].any()]
+        if hook is not None and ks_m:
+            deltas = hook(src, dst,
+                          {k: prev[k] for k in ks_m},
+                          {k: assigns[k] for k in ks_m},
+                          {k: masks[k] for k in ks_m})
+            for k in ks_m:
+                cut[k] += deltas[k]
+            state.stats["score_distributed"] = \
+                state.stats.get("score_distributed", 0) + 1
+        else:
+            for k in ks_m:
+                cut[k] += move_rescore_host(src, dst, prev[k],
+                                            assigns[k], masks[k])
     out = {}
     for k, a in assigns.items():
         prev[k] = np.array(a, copy=True)
@@ -641,7 +657,8 @@ def refresh(backend, state: PartitionState, comm_volume: bool = False):
     t0 = time.perf_counter()
     sc = state._score
     if sc is not None and "prev" in sc and not comm_volume:
-        scored = _rescore_incremental(state, assigns, w)
+        scored = _rescore_incremental(state, assigns, w,
+                                      backend=backend)
         state.stats["score_incremental"] = \
             state.stats.get("score_incremental", 0) + 1
         if os.environ.get("SHEEP_SCORE_AUDIT", "") not in ("", "0"):

@@ -496,9 +496,11 @@ class DeltaLogStream:
     multiset at ``up_to`` — default: the whole log), with the anchored
     elimination-order contract (module docstring).
 
-    Single-shard only: delta logs serve the single-device incremental
-    path; the multi-device backends reject anchored streams up front.
-    """
+    Single-shard streaming only: one process streams the log (the
+    single-device backends, and the multi-device backends' lockstep
+    ingest under one process — ISSUE 19). Multi-HOST meshes cannot
+    byte-range an anchored log across processes and reject it up
+    front."""
 
     order_anchor = True
 
@@ -608,8 +610,9 @@ class DeltaLogStream:
                byte_range: bool = False) -> Iterator[np.ndarray]:
         if num_shards != 1:
             raise NotImplementedError(
-                "delta: inputs stream single-shard (multi-device "
-                "backends reject anchored streams)")
+                "delta: inputs stream single-shard (multi-host meshes "
+                "reject anchored streams; single-process multi-device "
+                "runs ingest the one shard lockstep)")
         idx = 0
         for c in filter_tombstones(
                 self.base.chunks(chunk_edges), self.tombs):

@@ -179,3 +179,104 @@ def cut_pair_keys_host(chunk, assign, n: int, k: int):
         rows = rows[rows[:, 0] < n]
         rows_all.append(rows[:, 0].astype(np.int64) * k + rows[:, 1])
     return np.concatenate(rows_all) if rows_all else np.zeros(0, np.int64)
+
+
+# -- distributed incremental rescore (ISSUE 19) ------------------------
+# One compiled rescore program per (mesh, arc capacity, K): cached here
+# so repeat epochs at similar delta sizes never recompile (the sheeplint
+# ``fold`` rule's contract for the update path).
+_MOVE_RESCORE_CACHE: dict = {}
+
+
+def _make_move_rescore(mesh):
+    """Build the jitted all-k rescore program for ``mesh``: arcs shard
+    over the devices, the per-k assignment/mask tables replicate, and
+    the per-shard (not-both, both) partial sums ride out through ONE
+    psum — the single all-reduce a scored resident epoch pays."""
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheep_tpu.parallel.mesh import SHARD_AXIS, shard_map
+
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    repl = NamedSharding(mesh, P())
+
+    @partial(jax.jit,
+             in_shardings=(shard, shard, repl, repl, repl),
+             out_shardings=repl)
+    def rescore(su, du, prev_t, new_t, mask_t):
+        def f(s_l, d_l, prev_, new_, mask_):
+            keep = mask_[:, s_l]                       # (K, a)
+            both = mask_[:, d_l]
+            diff = (new_[:, s_l] != new_[:, d_l]).astype(jnp.int32) \
+                - (prev_[:, s_l] != prev_[:, d_l]).astype(jnp.int32)
+            dk = jnp.where(keep, diff, 0)
+            s_nb = jnp.sum(jnp.where(both, 0, dk), axis=1,
+                           dtype=jnp.int32)
+            s_b = jnp.sum(jnp.where(both, dk, 0), axis=1,
+                          dtype=jnp.int32)
+            return lax.psum(jnp.stack([s_nb, s_b], axis=1), SHARD_AXIS)
+        return shard_map(f, mesh=mesh,
+                         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS),
+                                   P(), P(), P()),
+                         out_specs=P())(su, du, prev_t, new_t, mask_t)
+
+    return rescore
+
+
+def move_rescore_sharded(src, dst, prevs: dict, news: dict,
+                         masks: dict, mesh) -> dict:
+    """Distributed twin of :func:`sheep_tpu.ops.refine.move_rescore_host`
+    (ISSUE 19 tentpole b): exact per-k edge-cut deltas of a batch of
+    part moves, computed from per-shard partial sums all-reduced ONCE
+    for every k together.
+
+    Bit-equal to the host scorer by construction: integer addition is
+    associative, so sharding the kept arcs and psumming the (not-both,
+    both) partials reproduces the host sums exactly; the both-changed
+    halving divides only AFTER the global reduction (a per-shard "both"
+    partial may be odd — only the global one is guaranteed even by arc
+    symmetry, asserted here like the host path). Per-shard counts stay
+    int32-exact because each shard sees < 2^31 arcs (the same bound
+    :func:`score_chunk` leans on). Sentinel-padded arc slots index the
+    tables' sentinel row (mask false) and contribute nothing.
+
+    ``prevs`` / ``news`` / ``masks`` are ``{k: array[V]}`` for the ks
+    whose assignment actually moved; returns ``{k: cut_delta}``."""
+    import numpy as np
+
+    from sheep_tpu.ops.elim import pow2_at_least
+
+    ks = list(prevs)
+    out = {k: 0 for k in ks}
+    s = np.asarray(src)
+    d = np.asarray(dst)
+    if not len(s) or not ks:
+        return out
+    n = int(len(next(iter(prevs.values()))))
+    dev = int(mesh.devices.size)
+    cap = pow2_at_least(-(-len(s) // dev), floor=1 << 10) * dev
+    su = np.full(cap, n, np.int32)
+    du = np.full(cap, n, np.int32)
+    su[:len(s)] = s
+    du[:len(d)] = d
+    kk = len(ks)
+    prev_t = np.zeros((kk, n + 1), np.int32)
+    new_t = np.zeros((kk, n + 1), np.int32)
+    mask_t = np.zeros((kk, n + 1), bool)
+    for i, k in enumerate(ks):
+        prev_t[i, :n] = prevs[k]
+        new_t[i, :n] = news[k]
+        mask_t[i, :n] = masks[k]
+    fn = _MOVE_RESCORE_CACHE.get(mesh)
+    if fn is None:
+        fn = _MOVE_RESCORE_CACHE[mesh] = _make_move_rescore(mesh)
+    part = np.asarray(  # sheeplint: sync-ok (the one designed pull)
+        fn(su, du, prev_t, new_t, mask_t))
+    for i, k in enumerate(ks):
+        s_nb, s_b = int(part[i, 0]), int(part[i, 1])
+        # symmetric arcs: the global both-changed sum is even (the
+        # per-shard partials need not be — divide after the psum)
+        assert s_b % 2 == 0
+        out[k] = s_nb + s_b // 2
+    return out

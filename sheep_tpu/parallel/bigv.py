@@ -60,7 +60,9 @@ unboundedly uneven.
 
 from __future__ import annotations
 
+import os
 import time
+from collections import OrderedDict
 from functools import partial
 from typing import Optional
 
@@ -532,7 +534,9 @@ class BigVPipeline:
 
         ``stats``: accumulates collective_ops / collective_bytes /
         compactions / q_rounds (sum of Q over rounds) for the run
-        diagnostics."""
+        diagnostics, plus the cross-backend O(Δ) cost triple
+        (host_syncs / device_rounds / folded_bytes — the counters the
+        update-vs-rebuild gate compares)."""
         if stats is None:
             stats = {}
         lo_a, hi_a = self.orient_step(pos_sh, batch_dev)
@@ -541,6 +545,8 @@ class BigVPipeline:
         stats["collective_ops"] = stats.get("collective_ops", 0) + 4
         stats["collective_bytes"] = stats.get("collective_bytes", 0) \
             + 4 * 4 * self.n_devices * size
+        stats["folded_bytes"] = stats.get("folded_bytes", 0) \
+            + int(batch_dev.size) * 4
         total = 0
         # SHEEP_SANITIZE: stray-sync traps around the routed fold loop
         # (the designed pulls below are the only host reads)
@@ -572,6 +578,9 @@ class BigVPipeline:
                     live_i = int(live)  # sheeplint: sync-ok
                     ml = int(max_live)  # sheeplint: sync-ok
                 total += r
+                stats["host_syncs"] = stats.get("host_syncs", 0) + 1
+                stats["device_rounds"] = \
+                    stats.get("device_rounds", 0) + r
                 ops, byts = self._round_cost(size, jumps, lift)
                 seg_ops, seg_bytes = self._segment_cost(lift)
                 stats["collective_ops"] += ops * r + seg_ops
@@ -688,7 +697,7 @@ class BigVPipeline:
                     stats.get("dispatch_retries", 0) + grew
             return out
 
-        def batches(start_chunk=0):
+        def batches(start_chunk=0, src=None):
             # device-stream ingest (ISSUE 12): a counter-hash input
             # (the bigv soak generator class) synthesizes every
             # (rows, C, 2) batch directly in device memory — zero host
@@ -696,18 +705,21 @@ class BigVPipeline:
             # Pass-through, not prefetch: a worker queue of global
             # device batches would hold unmodeled HBM, and there is no
             # host I/O to overlap. Multi-host keeps the host lockstep
-            # path (per-process assembly takes host rows).
-            if self.procs == 1 and is_device_stream(stream):
+            # path (per-process assembly takes host rows). ``src``
+            # substitutes the streamed source (the anchored degrees
+            # pass streams the delta log's base segment only).
+            src = stream if src is None else src
+            if self.procs == 1 and is_device_stream(src):
                 from sheep_tpu.parallel.pipeline import (
                     _PassThrough, device_lockstep_batches)
 
                 return _PassThrough(device_lockstep_batches(
-                    stream, cs, self.n_local, n, self.batch_sharding,
+                    src, cs, self.n_local, n, self.batch_sharding,
                     start_chunk=start_chunk, stats=build_stats))
             return prefetch(iter_batches_lockstep(
-                stream, cs, self.n_local, n, self.proc, self.procs,
+                src, cs, self.n_local, n, self.proc, self.procs,
                 start_chunk=start_chunk,
-                byte_range=use_byte_range(stream, self.procs)))
+                byte_range=use_byte_range(src, self.procs)))
 
         # state_format "bigv-pos": the checkpointed table block is now
         # POSITION-indexed; the format bump makes --resume against a
@@ -737,6 +749,12 @@ class BigVPipeline:
         # ingest counters (device_stream_chunks, ISSUE 12) accumulate
         # wherever batches are synthesized
         build_stats: dict = {}
+        # anchored-order inputs (delta: logs, ISSUE 19): degrees stream
+        # the BASE segment only (the anchor), build/score the full
+        # surviving multiset — same anchored-order semantics as the
+        # single-device backends, same unique fixpoint
+        anchored = bool(getattr(stream, "order_anchor", False))
+        deg_src = stream.anchor_stream() if anchored else None
         # pass 1: degrees (block-sharded int32 accumulator + host fold of
         # the LOCAL block, int32 when the edge bound proves no overflow;
         # resets are jitted on-device zeros, no
@@ -763,7 +781,8 @@ class BigVPipeline:
             # with-exit = deterministic prefetch-worker cancel on
             # exception unwind (utils/prefetch.py close contract)
             with wd_mod.watched(self.procs, "bigv-degrees",
-                                self.proc) as wd, batches(start) as pf:
+                                self.proc) as wd, \
+                    batches(start, src=deg_src) as pf:
                 for batch in pf:
                     deg_sh = self.deg_step(deg_sh, self._put(
                         self.batch_sharding, batch))
@@ -948,3 +967,48 @@ class BigVPipeline:
             "k": k, "fixpoint_rounds": total_rounds,
             "build_stats": build_stats,
         }
+
+
+# ---------------------------------------------------------------------------
+# process-wide compiled-pipeline cache (ISSUE 19)
+# ---------------------------------------------------------------------------
+# Every BigVPipeline() re-traces and re-compiles the whole routed program
+# set (deg/orient/fold/compact/score close over n, B and the shardings),
+# a flat multi-second XLA tax per instance regardless of graph size. The
+# pipeline is stateless across runs — everything mutable lives in the
+# tables threaded through build_step/run, and the only instance dicts
+# are the lazy program caches we WANT to share — so instances are safe
+# to reuse whenever every constructor input matches. Keyed on the full
+# constructor signature plus the mesh's device ids; bounded LRU so a
+# long-lived process sweeping many shapes doesn't pin dead programs.
+
+_PIPE_CACHE: "OrderedDict[tuple, BigVPipeline]" = OrderedDict()
+_PIPE_CACHE_MAX = 16
+
+
+def cached_pipeline(n: int, chunk_edges: int, mesh, jumps: int = 128,
+                    max_rounds: int = 1 << 20, segment_rounds: int = 16,
+                    dedup_compact: bool = True, lift_levels: int = 0,
+                    hoist_bytes: Optional[int] = None) -> BigVPipeline:
+    """BigVPipeline with its compiled programs reused across backend
+    instances (one-shot builds, resident epoch folds, compaction
+    rebuilds — all hit the same programs for the same shape)."""
+    hb = hoist_bytes if hoist_bytes is not None \
+        else int(os.environ.get("SHEEP_BIGV_HOIST_BYTES", "0"))
+    key = (n, chunk_edges, tuple(d.id for d in mesh.devices.flat),
+           jumps, max_rounds, segment_rounds, dedup_compact,
+           lift_levels, hb)
+    pipe = _PIPE_CACHE.get(key)
+    if pipe is None:
+        pipe = BigVPipeline(n, chunk_edges, mesh, jumps=jumps,
+                            max_rounds=max_rounds,
+                            segment_rounds=segment_rounds,
+                            dedup_compact=dedup_compact,
+                            lift_levels=lift_levels,
+                            hoist_bytes=hoist_bytes)
+        _PIPE_CACHE[key] = pipe
+        while len(_PIPE_CACHE) > _PIPE_CACHE_MAX:
+            _PIPE_CACHE.popitem(last=False)
+    else:
+        _PIPE_CACHE.move_to_end(key)
+    return pipe

@@ -249,6 +249,10 @@ class JobSpec:
     # tenant can stream delta epochs at it (ISSUE 15); the reservation
     # stays charged until released via cancel
     resident: bool = False
+    # backend the resident update path folds delta epochs with
+    # (ISSUE 19): multi-device names route each epoch through the
+    # sharded lockstep fold + distributed rescore
+    update_backend: str = "tpu"
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -268,7 +272,8 @@ class JobSpec:
         known = {"input", "k", "ks", "chunk_edges", "dispatch_batch",
                  "h2d_ring", "inflight", "segment_rounds", "alpha",
                  "weights", "comm_volume", "num_vertices", "deadline_s",
-                 "output", "return_assignment", "resident"}
+                 "output", "return_assignment", "resident",
+                 "update_backend"}
         unknown = set(body) - known
         if unknown:
             raise ProtocolError(f"unknown job field(s): {sorted(unknown)}")
@@ -290,6 +295,7 @@ class JobSpec:
                     else str(body["output"])),
             return_assignment=bool(body.get("return_assignment", False)),
             resident=bool(body.get("resident", False)),
+            update_backend=str(body.get("update_backend", "tpu")),
         )
         if spec.chunk_edges < 1:
             raise ProtocolError("job.chunk_edges must be >= 1")
@@ -306,6 +312,11 @@ class JobSpec:
             raise ProtocolError("job.deadline_s must be > 0 seconds")
         if spec.alpha <= 0:
             raise ProtocolError("job.alpha must be > 0")
+        if spec.update_backend not in ("pure", "cpu", "tpu",
+                                       "tpu-sharded", "tpu-bigv"):
+            raise ProtocolError(
+                "job.update_backend must be one of pure/cpu/tpu/"
+                "tpu-sharded/tpu-bigv")
         return spec
 
 

@@ -1307,9 +1307,13 @@ class Scheduler:
             from sheep_tpu.backends.base import get_backend
 
             spec = job.spec
-            job._upd_backend = get_backend(
-                "tpu", chunk_edges=spec.chunk_edges, alpha=spec.alpha,
-                segment_rounds=spec.segment_rounds)
+            name = getattr(spec, "update_backend", "tpu") or "tpu"
+            kw = {"chunk_edges": spec.chunk_edges, "alpha": spec.alpha}
+            if name.startswith("tpu"):
+                # the single-process backends take no segment knob;
+                # every tpu* fold pipeline does
+                kw["segment_rounds"] = spec.segment_rounds
+            job._upd_backend = get_backend(name, **kw)
         return job._upd_backend
 
     def _persist_resident(self, job: Job,

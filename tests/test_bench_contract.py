@@ -89,6 +89,10 @@ def test_fallback_emits_null_vs_baseline():
     # update wall like the warm path
     assert line["update_request_s"] > 0
     assert line["compactions"] == 0
+    # the multi-device incremental contract (ISSUE 19): the same scored
+    # delta epoch through the tpu-sharded fold + distributed rescore
+    # rides every measured line, gated lower-better by bench_regress
+    assert line["sharded_update_request_s"] > 0
 
 
 def test_skip_probe_short_circuits():

@@ -282,7 +282,12 @@ def test_epoch_idempotency_and_vertex_space_guard(tmp_path):
         be.partition_update(state, adds=e[:4], compact="later")
 
 
-def test_unsupported_backends_reject_incremental_and_delta(tmp_path):
+def test_multidevice_backends_accept_delta_reject_multihost(tmp_path):
+    """ISSUE 19 flips the ISSUE-15 rejection: a single-process
+    multi-device mesh folds delta epochs through the lockstep
+    machinery (full parity coverage in
+    test_incremental_multidevice.py). The one rejection left is a
+    multi-HOST mesh, which cannot byte-range an anchored log."""
     e = _graph(200)
     base = _base_file(tmp_path, e)
     log = str(tmp_path / "g.dlog")
@@ -290,16 +295,24 @@ def test_unsupported_backends_reject_incremental_and_delta(tmp_path):
         w.append(e[:10])
     from sheep_tpu.types import UnsupportedGraphError
 
+    oracle = get_backend("tpu", chunk_edges=777).partition(
+        open_input(f"delta:{log}", n_vertices=N), 4, comm_volume=False)
     for name in ("tpu-sharded", "tpu-bigv"):
         if name not in list_backends():
             continue
         be = get_backend(name)
-        with pytest.raises(ValueError,
-                           match="does not support incremental"):
-            be.partition_update(None, adds=e[:2])
-        with pytest.raises(UnsupportedGraphError,
-                           match="single-device"):
-            be.partition(open_input(f"delta:{log}", n_vertices=N), 4)
+        assert be.supports_incremental
+        r = be.partition(open_input(f"delta:{log}", n_vertices=N), 4,
+                         comm_volume=False)
+        assert np.array_equal(r.assignment, oracle.assignment)
+        import jax
+
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(jax, "process_count", lambda: 2)
+            with pytest.raises(UnsupportedGraphError,
+                               match="multi-host"):
+                be.partition(open_input(f"delta:{log}", n_vertices=N),
+                             4)
 
 
 # ----------------------------------------------------------------------

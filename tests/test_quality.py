@@ -506,3 +506,26 @@ def test_quality_dynamic_scenario_artifact():
     r01 = json.load(open(os.path.join(REPO, "QUALITY_r01.json")))
     for name, row in r01["scenarios"].items():
         assert doc["scenarios"][name] == row, name
+
+
+def test_quality_sharded_dynamic_scenario_artifact():
+    """ISSUE 19 satellite: QUALITY_r03.json adds the multi-device
+    dynamic scenario — the same delta epochs through the tpu-sharded
+    incremental path (distributed rescore + audit on) — inside the
+    same drift bound, extending QUALITY_r02.json bit-identically on
+    the shared rows. The sharded fold is bit-identical to the
+    single-device one, so the sharded row's quality numbers EQUAL the
+    dynamic_sbm row's."""
+    doc = json.load(open(os.path.join(REPO, "QUALITY_r03.json")))
+    sc = doc["scenarios"]["dynamic_sbm_sharded"]
+    assert sc["backend"] == "tpu-sharded"
+    assert sc["epoch"] == sc["recipe"]["dynamic"]["epochs"]
+    assert sc["anchored_drift"] <= sc["recipe"]["dynamic"]["bound"]
+    assert "bound_exceeded" not in sc
+    host = dict(doc["scenarios"]["dynamic_sbm"])
+    for k in ("cut_ratio", "edge_cut", "balance", "oneshot_cut_ratio",
+              "anchored_drift", "total_edges"):
+        assert sc[k] == host[k], k
+    r02 = json.load(open(os.path.join(REPO, "QUALITY_r02.json")))
+    for name, row in r02["scenarios"].items():
+        assert doc["scenarios"][name] == row, name

@@ -295,6 +295,14 @@ def test_protocol_validation_and_codec():
         JobSpec.from_request({"input": "g", "k": 4, "deadline_s": -1})
     sp = JobSpec.from_request({"input": "g", "k": [8, 8, 4]})
     assert sp.ks == [8, 4]  # dupes dropped, order kept
+    # update_backend (ISSUE 19): resident epochs may fold multi-device
+    assert sp.update_backend == "tpu"  # the single-device default
+    sh = JobSpec.from_request({"input": "g", "k": 4, "resident": True,
+                               "update_backend": "tpu-sharded"})
+    assert sh.update_backend == "tpu-sharded"
+    with pytest.raises(ProtocolError, match="update_backend"):
+        JobSpec.from_request({"input": "g", "k": 4,
+                              "update_backend": "gpu"})
     a = np.arange(1000, dtype=np.int32) % 7
     assert np.array_equal(
         protocol.decode_assignment(protocol.encode_assignment(a)), a)

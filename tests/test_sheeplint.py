@@ -283,6 +283,63 @@ def test_lock_rule_fires_on_unlocked_write():
     assert "lock" not in rules_of(lint_source(ok))
 
 
+FOLD_BAD = """
+import numpy as np
+import jax
+
+def _fold_delta(self, state, edges):
+    pipe = ShardedPipeline(state.n, 1024, mesh)   # per-epoch rebuild
+    for c in chunk(edges):
+        x = np.asarray(pipe.step(c))              # per-chunk host pull
+    return x
+
+def move_rescore(src, dst):
+    return jax.jit(lambda a: a + 1)(src)          # per-epoch recompile
+"""
+
+
+def test_fold_rule_fires_on_recompile_and_loop_pull():
+    findings = [f for f in lint_source(FOLD_BAD) if f.rule == "fold"]
+    assert any("ShardedPipeline" in f.message for f in findings)
+    assert any("host pull inside a loop" in f.message for f in findings)
+    assert any("recompile" in f.message.replace("recompiles", "recompile")
+               for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_fold_rule_pragma_and_make_builder_clean():
+    ok = FOLD_BAD.replace(
+        "ShardedPipeline(state.n, 1024, mesh)   # per-epoch rebuild",
+        "ShardedPipeline(state.n, 1024, mesh)  # sheeplint: fold-ok"
+    ).replace(
+        "np.asarray(pipe.step(c))              # per-chunk host pull",
+        "np.asarray(pipe.step(c))  # sheeplint: fold-ok"
+    ).replace(
+        "jax.jit(lambda a: a + 1)(src)          # per-epoch recompile",
+        "jax.jit(lambda a: a + 1)(src)  # sheeplint: fold-ok")
+    assert "fold" not in rules_of(lint_source(ok))
+    # _make_* builders are the cached-construction fix the rule
+    # recommends — the one place a compile belongs
+    builder = """
+import jax
+
+def _make_move_rescore(mesh):
+    return jax.jit(lambda a: a + 1)
+
+def _fold_delta(state, edges):
+    total = 0
+    for c in edges:
+        total += len(c)            # host arithmetic, not a device pull
+    return total
+"""
+    assert "fold" not in rules_of(lint_source(builder))
+    # the same calls OUTSIDE a fold-path function are the other rules'
+    # business, not this one's
+    elsewhere = FOLD_BAD.replace("_fold_delta", "_ingest").replace(
+        "move_rescore", "rescale")
+    assert "fold" not in rules_of(lint_source(elsewhere))
+
+
 CLEAN = """
 import numpy as np
 import jax

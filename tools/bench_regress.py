@@ -21,6 +21,9 @@ each compared only when present in BOTH captures:
                                       the O(Δ) scored refresh (its
                                       epoch_scale_x2 probe rides
                                       info-only);
+    sharded_update_request_s          the same scored epoch through the
+                                      multi-device lockstep fold +
+                                      distributed rescore (ISSUE 19);
     warm_up_s, warm_request_s,        warm_up_s is the cold-request jit
                                       tax and warm_request_s the warm
                                       served-request wall — the pair
@@ -103,11 +106,15 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # answer wall — a repeat submit served with zero build steps; its
 # contract bar is >= 10x under warm_request_s, so a rise means the
 # store read/decode path itself is slowing, gated like the warm path.
+# sharded_update_request_s (ISSUE 19) is the same scored delta epoch
+# through the multi-device lockstep fold + distributed rescore — the
+# per-epoch cost of a resident SHARDED partition; gated lower-better
+# with the update_request_s convention.
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
                 "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
                 "warm_request_s", "cached_request_s",
                 "update_request_s", "update_fold_s",
-                "update_score_s")
+                "update_score_s", "sharded_update_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory

@@ -1110,7 +1110,125 @@ JAX_PLATFORMS=cpu python tools/slo_check.py \
 [ "$rc" -eq 2 ] || { echo "tight SLO rule did not burn (rc=$rc)" >&2; exit 1; }
 grep -q "BURN" "$OUT/fobs_slo_burn.txt"
 
+# fifteenth leg: multi-device O(delta) epochs served end-to-end
+# (ISSUE 19) — a tiny RESIDENT partition submitted with
+# update_backend=tpu-sharded (the daemon runs an 8-way virtual device
+# mesh), absorbs one >UPDATE_CHUNK_EDGES epoch through the chunked
+# begin/chunk/commit wire form — folded through the sharded lockstep
+# pipeline and rescored with the distributed score cache (the scored
+# reply's diagnostics must carry update_folds and score_distributed),
+# with SHEEP_SCORE_AUDIT shadow-checking every incremental score —
+# then the daemon is SIGKILLed and the restart must reattach at the
+# applied epoch and absorb one more scored epoch.
+TRACE15="$OUT/trace_shupd.jsonl"
+SOCK15="$OUT/sheepd_shupd.sock"
+STATE15="$OUT/sheepd_shupd_state"
+rm -f "$TRACE15" "$SOCK15"
+rm -rf "$STATE15"
+JAX_PLATFORMS=cpu SHEEP_SCORE_AUDIT=1 \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python -m sheep_tpu.server.daemon \
+    --socket "$SOCK15" --trace "$TRACE15" --heartbeat-secs 0.2 \
+    --state-dir "$STATE15" --checkpoint-every 1 --metrics-port 0 \
+    2> "$OUT/sheepd_shupd.err" &
+SHEEPD15_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID $SHEEPD13_PID $SHEEPD14A_PID $SHEEPD14B_PID $SHEEPD15_PID 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do [ -S "$SOCK15" ] && break; sleep 0.2; done
+[ -S "$SOCK15" ] || { echo "shupd sheepd never bound $SOCK15" >&2; exit 1; }
+JAX_PLATFORMS=cpu python - "$SOCK15" "$OUT" \
+    > "$OUT/shupd_plan.json" <<'PYEOF'
+import json
+import os
+import sys
+
+import numpy as np
+
+from sheep_tpu.server.client import SheepClient
+
+sock, out = sys.argv[1], sys.argv[2]
+rng = np.random.default_rng(15)
+n = 2048
+# SPARSE on purpose: a dense random graph's elimination forest is so
+# stable that delta epochs move no labels and the rescore (correctly)
+# has nothing to do — sparse epochs actually exercise it
+E = rng.integers(0, n, (30000, 2)).astype(np.int64)
+base = os.path.join(out, "shupd_base.bin64")
+with open(base, "wb") as f:
+    f.write(E[:6000].astype("<u8").tobytes())
+np.save(os.path.join(out, "shupd_edges.npy"), E)
+with SheepClient(sock, timeout_s=600) as c:
+    jid = c.submit(base, k=[4], tenant="shupd", resident=True,
+                   chunk_edges=2048, num_vertices=n,
+                   update_backend="tpu-sharded")["job_id"]
+    assert c.wait(jid, timeout_s=600)["state"] == "done"
+    # a 4k-edge epoch at chunk_edges=1024 rides the chunked
+    # begin/chunk/commit framing (one txn, applied as ONE epoch);
+    # its scored refresh SEEDS the score cache (one full pass)
+    r = c.update(jid, adds=E[6000:10000], score=True,
+                 chunk_edges=1024)
+    assert r["applied"] and r.get("txn"), r
+    diag = r["results"][0]["diagnostics"]
+    assert diag.get("update_folds", 0) >= 1, diag
+    # the next epoch takes the O(delta) path: folded through the
+    # sharded lockstep pipeline, rescored with ONE all-reduce
+    r = c.update(jid, adds=E[10000:13000], score=True)
+    assert r["applied"], r
+    diag = r["results"][0]["diagnostics"]
+    assert diag.get("score_distributed", 0) >= 1, diag
+    print(json.dumps({"job_id": jid, "epoch": int(r["epoch"]),
+                      "cut": r["results"][0]["edge_cut"]}))
+PYEOF
+EPOCH15=$(python -c "import json,sys; \
+print(json.load(open(sys.argv[1]))['epoch'])" "$OUT/shupd_plan.json")
+JID15=$(python -c "import json,sys; \
+print(json.load(open(sys.argv[1]))['job_id'])" "$OUT/shupd_plan.json")
+kill -9 "$SHEEPD15_PID"
+wait "$SHEEPD15_PID" 2>/dev/null || true
+JAX_PLATFORMS=cpu SHEEP_SCORE_AUDIT=1 \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python -m sheep_tpu.server.daemon \
+    --socket "$SOCK15" --trace "$TRACE15" --heartbeat-secs 0.2 \
+    --state-dir "$STATE15" --checkpoint-every 1 --metrics-port 0 \
+    2>> "$OUT/sheepd_shupd.err" &
+SHEEPD15_PID=$!
+trap 'kill $SHEEPD7_PID $SHEEPD7B_PID $SHEEPD10_PID $SHEEPD11_PID $SHEEPD12A_PID $SHEEPD12B_PID $SHEEPD13_PID $SHEEPD14A_PID $SHEEPD14B_PID $SHEEPD15_PID 2>/dev/null || true' EXIT
+JAX_PLATFORMS=cpu python - "$SOCK15" "$OUT" "$JID15" "$EPOCH15" \
+    > "$OUT/shupd_resume.json" <<'PYEOF'
+import json
+import os
+import sys
+
+import numpy as np
+
+from sheep_tpu.server.client import SheepClient
+
+sock, out, jid, last = sys.argv[1], sys.argv[2], sys.argv[3], \
+    int(sys.argv[4])
+E = np.load(os.path.join(out, "shupd_edges.npy"))
+with SheepClient(sock, reconnect=40, reconnect_base_s=0.3,
+                 timeout_s=600) as c:
+    ep = c.epoch(jid)
+    assert ep["epoch"] == last, (ep, last)  # the SIGKILL lost nothing
+    # first scored epoch after the restart seeds the score cache with
+    # one full pass (the snapshot carries tables, not the cache); the
+    # second takes the O(delta) path — distributed, and audited
+    r = c.update(jid, adds=E[13000:15000], epoch=last + 1, score=True)
+    assert r["applied"] and r["epoch"] == last + 1, r
+    r = c.update(jid, adds=E[15000:17000], epoch=last + 2, score=True)
+    assert r["applied"] and r["epoch"] == last + 2, r
+    diag = r["results"][0]["diagnostics"]
+    assert diag.get("update_folds", 0) >= 2, diag
+    assert diag.get("score_distributed", 0) >= 1, diag
+    c.shutdown()
+print(json.dumps({"epoch": last + 2,
+                  "cut": r["results"][0]["edge_cut"]}))
+PYEOF
+wait "$SHEEPD15_PID"
+python tools/trace_report.py "$TRACE15" --check \
+    > "$OUT/report_shupd.txt"
+grep -q '"event": "delta_epoch_applied"' "$TRACE15"
+
 # and the static gate stays at zero with the new telemetry modules in
 python tools/sheeplint.py --check sheep_tpu tools > "$OUT/sheeplint.txt"
 
-echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A $TRACE13 $TRACE14A"
+echo "obs smoke OK: $TRACE $TRACE2 $TRACE3 $TRACE4 $TRACE5 $TRACE6 $TRACE7 $TRACE8 $TRACE9 $TRACE10 $TRACE11 $TRACE12A $TRACE13 $TRACE14A $TRACE15"

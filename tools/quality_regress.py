@@ -75,6 +75,14 @@ SCENARIOS = (
     # edges (cut_ratio <= oneshot + bound, else the sweep exits 2).
     {"name": "dynamic_sbm", "spec": "sbm-hash:10:16:0.05:16:2",
      "k": 16, "dynamic": {"epochs": 2, "bound": 0.05, "seed": 7}},
+    # multi-device variant (ISSUE 19): the SAME dynamic recipe pinned
+    # to the sharded backend — epochs fold through the lockstep
+    # pipeline and every scored refresh rescores device-side (the
+    # distributed score cache), still under the audit. The `backend`
+    # key overrides the sweep-level choice for this row only.
+    {"name": "dynamic_sbm_sharded", "spec": "sbm-hash:10:16:0.05:16:2",
+     "k": 16, "backend": "tpu-sharded",
+     "dynamic": {"epochs": 2, "bound": 0.05, "seed": 7}},
 )
 
 
@@ -124,10 +132,19 @@ def run_dynamic_scenario(sc: dict, backend: str) -> dict:
         raise RuntimeError(
             f"dynamic scenario never exercised the incremental-score "
             f"path (stats={state.stats})")
+    if hasattr(be, "_move_rescore") \
+            and int(state.stats.get("score_distributed", 0)) < 1:
+        # a multi-device backend must have taken the rescore
+        # device-side at least once (ISSUE 19) — a silent fall-back
+        # to the host scorer would leave the distributed path ungated
+        raise RuntimeError(
+            f"dynamic scenario never exercised the distributed-score "
+            f"path on {be.name} (stats={state.stats})")
     oneshot = be.partition(EdgeStream.from_array(e, n_vertices=n),
                            sc["k"], comm_volume=False)
     row = {"spec": sc["spec"], "recipe": {"k": sc["k"],
                                           "dynamic": dict(dyn)},
+           **({"backend": be.name} if "backend" in sc else {}),
            "k": int(res.k),
            "cut_ratio": round(float(res.cut_ratio), 6),
            "edge_cut": int(res.edge_cut),
@@ -198,7 +215,9 @@ def run_sweep(out_path: str, names=None, backend: str = None) -> dict:
     for sc in SCENARIOS:
         if names and sc["name"] not in names:
             continue
-        row = run_scenario(sc, backend)
+        # a scenario may pin its own backend (the multi-device rows);
+        # everything else rides the sweep-level choice
+        row = run_scenario(sc, sc.get("backend", backend))
         doc["scenarios"][sc["name"]] = row
         print(f"{sc['name']:<14} cut_ratio {row['cut_ratio']:.4f}  "
               f"balance {row['balance']:.3f}"
