@@ -470,6 +470,53 @@ def measure(scale: int, platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — the leg must not kill bench
         log(f"result-cache leg skipped: {type(e).__name__}: "
             f"{str(e)[:200]}")
+    # out-of-core contract field (ISSUE 20): oocore_request_s — one
+    # full build with SHEEP_CACHE_BYTES clamped to ~half the modeled
+    # working set, so the residency manager MUST evict and re-upload
+    # mid-build (the disk tier is live, not idle). Gated lower-better
+    # by bench_regress; the spill counters ride info-only — they
+    # describe the constraint, not a perf series. Runs at the reduced
+    # update-leg scale with a small chunk so the stream has enough
+    # chunks to rotate, and stays seconds everywhere.
+    try:
+        os2 = max(10, scale - 4)
+        m2 = (1 << os2) * edge_factor
+        oc_chunk = max(1024, m2 // 8)       # ~8 chunks to rotate over
+        nchunks = -(-m2 // oc_chunk)
+        # modeled working set: every padded (cs, 2) int32 chunk resident
+        working = nchunks * oc_chunk * 2 * 4
+        budget = max(1, working // 2)
+        oc_stream = generators.RmatHashStream(os2, edge_factor, seed=42)
+        oc_be = get_backend("tpu", chunk_edges=oc_chunk)
+        oc_be.partition(oc_stream, k, comm_volume=False)  # compile warm-up
+        prev = os.environ.get("SHEEP_CACHE_BYTES")
+        os.environ["SHEEP_CACHE_BYTES"] = str(budget)
+        try:
+            t0 = time.perf_counter()
+            res_oc = oc_be.partition(oc_stream, k, comm_volume=False)
+            oc_s = time.perf_counter() - t0
+        finally:
+            if prev is None:
+                os.environ.pop("SHEEP_CACHE_BYTES", None)
+            else:
+                os.environ["SHEEP_CACHE_BYTES"] = prev
+        out["oocore_request_s"] = round(oc_s, 4)
+        for f in ("spill_evictions", "spill_reload_bytes",
+                  "spill_resident_bytes"):
+            out[f] = int(res_oc.diagnostics.get(f, 0))
+        log(f"out-of-core: oocore_request_s {out['oocore_request_s']}s "
+            f"(RMAT-{os2}, {nchunks} chunks, budget {budget:,} of "
+            f"modeled {working:,} bytes; spill_evictions="
+            f"{out['spill_evictions']}, spill_reload_bytes="
+            f"{out['spill_reload_bytes']}, spill_resident_bytes="
+            f"{out['spill_resident_bytes']})")
+        if not out["spill_evictions"]:
+            log("WARNING: out-of-core leg evicted nothing — the "
+                "budget clamp is not constraining the build and "
+                "oocore_request_s is measuring a fully-resident run")
+    except Exception as e:  # noqa: BLE001 — the leg must not kill bench
+        log(f"out-of-core leg skipped: {type(e).__name__}: "
+            f"{str(e)[:200]}")
     # per-segment build-wall attribution (t_warm_s/t_full_s/t_small_s/
     # t_host_tail_s — elim.py accumulates them per sync), the numbers
     # that decompose build wall into device floor vs tunnel/host tax
@@ -692,7 +739,9 @@ def main():
               "checkpoint_degraded", "warm_up_s", "cold_request_s",
               "warm_request_s", "cached_request_s", "update_request_s",
               "update_fold_s", "update_score_s", "epoch_scale_x2",
-              "sharded_update_request_s", "compactions"):
+              "sharded_update_request_s", "compactions",
+              "oocore_request_s", "spill_evictions",
+              "spill_reload_bytes", "spill_resident_bytes"):
         if f in result:
             extra[f] = result[f]
     if failures:

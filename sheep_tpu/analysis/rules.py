@@ -52,6 +52,16 @@ Rules:
   (``np.asarray``/``.item()``/``.tolist()``/``.block_until_ready()`` —
   the O(Δ) epoch's designed shape is ONE pull after the fold
   converges); designed windows carry ``# sheeplint: fold-ok``.
+- **spill** — out-of-core discipline (ISSUE 20): full materialization
+  of an mmap CSR region (``np.asarray``/``np.array`` over a bare
+  ``indices``/``indptr`` attribute or a whole-region ``[:]`` slice of
+  one — the disk tier pulled entirely into host memory, defeating the
+  O(chunk) working-set contract; element/range subscripts stay
+  O(slice) and are fine), and a per-chunk device upload inside a loop
+  (``jax.device_put``/``jnp.asarray`` of a ``pad_chunk``/
+  ``device_chunk`` product) that bypasses the residency manager — HBM
+  the budget model cannot see or spill; designed windows carry
+  ``# sheeplint: spill-ok``.
 """
 
 from __future__ import annotations
@@ -804,6 +814,111 @@ def check_fold(ctx: RuleContext) -> None:
 
 
 # ---------------------------------------------------------------------------
+# out-of-core residency discipline (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+#: mmap-backed CSR region attributes (io/csr.py CsrView)
+CSR_REGION_ATTRS = {"indices", "indptr", "_indices", "_indptr"}
+
+#: chunk producers whose result is the residency plane's unit
+CHUNK_PRODUCERS = {"pad_chunk", "device_chunk", "device_chunk_on"}
+
+
+def _full_region_pull(arg) -> str:
+    """The CSR region attribute ``arg`` fully materializes, or ''.
+    Full = the bare attribute (``view._indices``) or a whole-region
+    slice of it (``view._indices[:]``). An element/range subscript
+    (``self._indices[eid]``) reads only the rows asked for — O(slice),
+    exactly the mmap contract — and is not flagged."""
+    if isinstance(arg, ast.Subscript):
+        sl = arg.slice
+        if not (isinstance(sl, ast.Slice) and sl.lower is None
+                and sl.upper is None and sl.step is None):
+            return ""
+        arg = arg.value
+    if isinstance(arg, ast.Attribute) and arg.attr in CSR_REGION_ATTRS:
+        return arg.attr
+    return ""
+
+
+def _chunk_valued(arg) -> bool:
+    """True when ``arg`` is recognizably a streamed chunk: a
+    ``pad_chunk``/``device_chunk`` call, or a name whose terminal
+    mentions 'chunk' (the drivers' naming convention)."""
+    if isinstance(arg, ast.Call):
+        return _terminal(arg.func) in CHUNK_PRODUCERS
+    return "chunk" in _terminal(arg).lower()
+
+
+class _SpillPath(ast.NodeVisitor):
+    """The two regression classes the out-of-core plane (ISSUE 20)
+    creates room for:
+
+    - ``np.asarray``/``np.array`` over a whole mmap CSR region pulls
+      the DISK tier entirely into host memory — the working set is
+      back to O(E) and the budget means nothing;
+    - a per-chunk ``jax.device_put``/``jnp.asarray`` upload inside a
+      loop puts evictable chunk bytes on device OUTSIDE the residency
+      manager: HBM the budget model cannot account, spill, or evict at
+      a checkpoint boundary (the blessed paths go through
+      ``_residency_chunks``/``admit`` or the staged H2D ring).
+
+    Designed windows (the refine re-stream, device-synth placement
+    relays) carry ``# sheeplint: spill-ok``."""
+
+    def __init__(self, ctx: RuleContext):
+        self.ctx = ctx
+        self.loop_depth = 0
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def _def(self, node):
+        # a nested function's body does not execute per iteration of
+        # the enclosing loop; it gets its own scan at depth 0
+        depth, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = depth
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _def
+
+    def visit_Call(self, node):
+        if _is_np_pull(node) and node.args:
+            region = _full_region_pull(node.args[0])
+            if region:
+                self.ctx.add(
+                    "spill", "error", node,
+                    f"np.{_terminal(node.func)}() over the whole "
+                    f"'{region}' mmap region materializes the disk "
+                    "tier into host memory — slice the rows you need "
+                    "(the view stays O(slice)), or annotate a designed "
+                    "window with '# sheeplint: spill-ok'")
+        elif self.loop_depth > 0 and node.args:
+            term = _terminal(node.func)
+            root = _root(node.func)
+            uploader = term == "device_put" or (
+                term in ("asarray", "array") and root in ("jnp", "jax"))
+            if uploader and _chunk_valued(node.args[0]):
+                self.ctx.add(
+                    "spill", "error", node,
+                    f"{root}.{term}() of a chunk inside a loop puts "
+                    "evictable bytes on device outside the residency "
+                    "manager — HBM the budget cannot account or spill; "
+                    "serve chunks through the residency/H2D staging "
+                    "path, or annotate a designed window with "
+                    "'# sheeplint: spill-ok'")
+        self.generic_visit(node)
+
+
+def check_spill(ctx: RuleContext) -> None:
+    _SpillPath(ctx).visit(ctx.tree)
+
+
+# ---------------------------------------------------------------------------
 # lock discipline
 # ---------------------------------------------------------------------------
 
@@ -879,7 +994,7 @@ def check_locks(ctx: RuleContext) -> None:
 # ---------------------------------------------------------------------------
 
 ALL_CHECKS = (check_sync_donate, check_jit_hygiene, check_resources,
-              check_locks, check_h2d, check_fold)
+              check_locks, check_h2d, check_fold, check_spill)
 
 
 def check_file(path: str, source: str, tree: ast.Module,

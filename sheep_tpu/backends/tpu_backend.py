@@ -36,6 +36,7 @@ from sheep_tpu.ops import score as score_ops
 from sheep_tpu.ops import split as split_ops
 from sheep_tpu.types import PartitionResult, check_tpu_vertex_range
 from sheep_tpu.utils.prefetch import H2DRing, prefetch, prefetch_batched
+from sheep_tpu.utils.residency import ResidencyManager
 
 
 def pad_chunk(chunk: np.ndarray, size: int, n: int) -> np.ndarray:
@@ -145,10 +146,61 @@ def _upload_chunks(stream, cs: int, n: int, start_chunk: int,
             yield dev
 
 
+def _residency_chunks(stream, cs: int, n: int, rm, start_chunk: int,
+                      ring: int = 1, stats=None):
+    """Serve chunks through the residency manager (ISSUE 20): resident
+    ids come straight from HBM; the first miss falls through to the
+    stream (the disk tier — every chunk is reconstructible from its
+    on-disk bytes), re-uploading and re-offering each chunk for
+    residence. The chunk just yielded stays LEASED while it is the
+    freshest serve; the lease is dropped just before the NEXT
+    admission so the eviction scans that admission may trigger see it
+    as reclaimable — dropping the head anchor instead (because the
+    tail chunk was pinned) would cost every later pass its prefix
+    hits. Correctness never depends on the lease: eviction only drops
+    the manager's reference, and a consumer still folding the chunk
+    keeps the device buffer alive through its own reference."""
+    idx = start_chunk
+    leased = None
+    try:
+        while True:
+            ref = rm.get(idx)
+            if ref is None:
+                break
+            rm.lease(idx)
+            if leased is not None:
+                rm.release(leased)
+            leased = idx
+            yield ref
+            idx += 1
+        if not rm.complete:
+            for d in _upload_chunks(stream, cs, n, idx, ring, stats):
+                if leased is not None:
+                    rm.release(leased)
+                    leased = None
+                rm.admit(idx, d, int(d.size) * 4)
+                rm.lease(idx)
+                leased = idx
+                yield d
+                idx += 1
+            if start_chunk == 0:
+                rm.note_stream_end(idx)
+    finally:
+        if leased is not None:
+            rm.release(leased)
+
+
 def _device_chunks(stream, cs: int, n: int, cache, start_chunk: int,
                    ring: int = 1, stats=None):
     """Yield padded (cs, 2) int32 chunks as DEVICE arrays, serving and
-    filling ``cache`` when iterating from the stream head."""
+    filling ``cache`` when iterating from the stream head. ``cache``
+    is a legacy prefix :class:`_ChunkCache` (or a reader view), a
+    :class:`~sheep_tpu.utils.residency.ResidencyManager` (eviction +
+    reload — the out-of-core regime), or None."""
+    if isinstance(cache, ResidencyManager):
+        yield from _residency_chunks(stream, cs, n, cache, start_chunk,
+                                     ring, stats)
+        return
     if cache is None or start_chunk != 0:
         yield from _upload_chunks(stream, cs, n, start_chunk, ring, stats)
         return
@@ -215,14 +267,19 @@ def _chunk_cache_budget(n: int, chunk_edges: int,
     0 (cache disabled) on cpu-jax — there the "device" IS host RAM, so
     caching would duplicate the stream in memory to save a transfer that
     does not exist — and 0 when the accelerator does not report a real
-    bytes_limit (no basis for a budget)."""
+    bytes_limit (no basis for a budget). An explicit SHEEP_CACHE_BYTES
+    wins EVERYWHERE, including cpu-jax: the override is how the
+    out-of-core residency plane (ISSUE 20) is engaged and exercised —
+    its spill/reload/boundary machinery is platform-independent, and
+    the exactness contract (tiny budget == unconstrained oracle, bit
+    for bit) must be testable without an accelerator."""
     from sheep_tpu.utils.membudget import build_phase_bytes
 
-    if jax.default_backend() == "cpu":
-        return 0
     env = os.environ.get("SHEEP_CACHE_BYTES")
     if env is not None:
         return max(0, int(env))
+    if jax.default_backend() == "cpu":
+        return 0
     hbm = _device_hbm_bytes()
     reserve = build_phase_bytes(
         n, chunk_edges, dispatch_batch=dispatch_batch,
@@ -558,12 +615,25 @@ class TpuBackend(Partitioner):
                                            donate=donate,
                                            h2d_ring=ring_model) \
             if self.cache_chunks else 0
-        cache = _ChunkCache(cache_budget) if cache_budget > 0 else None
         # ONE stats dict across all three streaming passes: the ingest
         # counters (h2d_* / device_stream_chunks) accumulate wherever
         # chunks cross (or don't cross) the link, and the build phase
         # adds the dispatch counters to the same record
         build_stats: dict = {}
+        # residency-managed chunk tier (ISSUE 20): same prefix-cache
+        # fast path when the stream fits the budget, spill/reload with
+        # checkpoint-boundary eviction when it does not — device memory
+        # is a cache over the on-disk stream, not a ceiling. The spill
+        # counters land in build_stats -> diagnostics -> bench record.
+        cache = ResidencyManager(cache_budget, stats=build_stats) \
+            if cache_budget > 0 else None
+
+        def _ckpt_boundary(confirmed_idx: int) -> None:
+            # checkpoint boundaries are the residency eviction points:
+            # chunks behind the confirmed index can no longer be
+            # re-read by any retry (resume starts at confirmed_idx)
+            if isinstance(cache, ResidencyManager):
+                cache.boundary(confirmed_idx)
         sp = obs.begin("degrees")
         obs.progress(phase="degrees", chunks_done=0, edges_done=0)
         # anchored-order streams (delta: inputs, io/deltalog.py): the
@@ -600,6 +670,7 @@ class TpuBackend(Partitioner):
                     since_flush = 0
                 if at_ckpt:
                     checkpointer.save("degrees", idx, {"deg": deg_host}, meta)
+                    _ckpt_boundary(idx)
             deg_host += np.asarray(deg[:n],  # sheeplint: sync-ok
                                    dtype=np.int64)
         t["degrees"] = time.perf_counter() - t0
@@ -796,6 +867,10 @@ class TpuBackend(Partitioner):
                             if checkpointer is not None:
                                 checkpointer.save("build", idx, arrays,
                                                   meta)
+                            # the flushed table IS the confirmed state
+                            # (durable or in-memory snapshot): chunks
+                            # behind it are eviction-safe either way
+                            _ckpt_boundary(idx)
 
                         staged = staged_groups()
                         try:
@@ -888,6 +963,7 @@ class TpuBackend(Partitioner):
                                                      arrays["carry_hi"])
                                 checkpointer.save("build", idx, arrays,
                                                   meta)
+                                _ckpt_boundary(idx)
                     if overlap:
                         _flush_deltas()
                 if carry_mode and carry is not None \
@@ -908,12 +984,16 @@ class TpuBackend(Partitioner):
             from sheep_tpu.utils import retry as retry_mod
 
             def _on_resource():
-                # the cached device chunks are reclaimable HBM — free
-                # them and stop refilling for the rest of this run
-                # (later passes re-stream), then halve whichever
-                # dispatch knob the membudget model indicts
+                # spill before shrink (ISSUE 20): the resident chunks
+                # are reclaimable HBM — with spillable bytes the
+                # degrade ladder's first rung drops them (and halves
+                # the residency budget) with the dispatch knobs
+                # UNCHANGED; only a fault with nothing left to spill
+                # halves whichever knob the membudget model indicts
                 nonlocal cache
-                if cache is not None:
+                rm = cache if isinstance(cache, ResidencyManager) \
+                    else None
+                if cache is not None and rm is None:
                     cache.chunks.clear()
                     cache.used = 0
                     cache.complete = False
@@ -922,7 +1002,10 @@ class TpuBackend(Partitioner):
                 nxt = retry_mod.degrade_dispatch(
                     n, cs, cfg["batch"], cfg["inflight"], cfg["donate"],
                     build_stats, snap["idx"],
-                    h2d_ring=None if ring_model == 0 else cfg["ring"])
+                    h2d_ring=None if ring_model == 0 else cfg["ring"],
+                    residency=rm)
+                if rm is not None and rm.budget <= 0:
+                    cache = None  # walked to zero: stop probing it
                 if nxt is not None:
                     cfg["batch"], cfg["inflight"] = nxt[0], nxt[1]
                     if len(nxt) > 2:
@@ -1012,7 +1095,12 @@ class TpuBackend(Partitioner):
                     checkpointer, idx, cut, total, cv_chunks,
                     {"deg": deg_host, "minp": np.asarray(minp)}, meta,
                     comm_volume)
+                _ckpt_boundary(idx)
         cv = int(len(ckpt.compact_cv_keys(cv_chunks))) if comm_volume else None
+        # the score pass re-streams (and under a residency budget,
+        # re-spills) — absorb its counters so the trace's final totals
+        # match the diagnostics instead of stopping at the build phase
+        stats_acc.absorb(build_stats)
         from sheep_tpu.core import pure
 
         balance = pure.part_balance(assign_host, k,

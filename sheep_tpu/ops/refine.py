@@ -371,7 +371,7 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
         cuts = []
         for c in stream.chunks(chunk_edges):
             cc, _ = score_ops.score_chunk(
-                jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok, spill-ok (refine re-stream, not the dispatch chain)
                                       chunk_edges, n)), a_try, n)
             cuts.append(cc)
         return sum(int(c) for c in cuts)
@@ -388,7 +388,7 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
             hist = jnp.zeros((n + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
                 hist, cc, _ = neighbor_hist_chunk(
-                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok, spill-ok (refine re-stream, not the dispatch chain)
                                                chunk_edges, n)),
                     a_try, n, k)
                 cuts.append(cc)
@@ -400,7 +400,7 @@ def _refine_impl(assign, stream, n, k, rounds, alpha, chunk_edges,
             hist = jnp.zeros((vb + 1, k), jnp.int32)
             for c in stream.chunks(chunk_edges):
                 hist = neighbor_hist_block(
-                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok (refine re-stream, not the dispatch chain)
+                    hist, jnp.asarray(pad_chunk(c,  # sheeplint: h2d-ok, spill-ok (refine re-stream, not the dispatch chain)
                                                chunk_edges, n)),
                     a_try, jnp.int32(base), n, k, vb)
             rows = a_try[base:base + vb]

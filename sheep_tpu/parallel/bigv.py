@@ -749,6 +749,17 @@ class BigVPipeline:
         # ingest counters (device_stream_chunks, ISSUE 12) accumulate
         # wherever batches are synthesized
         build_stats: dict = {}
+        # out-of-core residency plane (ISSUE 20): under an explicit
+        # SHEEP_CACHE_BYTES budget, build-pass device batches (keyed by
+        # absolute chunk index) serve the score pass and the in-process
+        # dispatch retries from HBM instead of re-uploading, with
+        # checkpoint boundaries as eviction points. Single-process host
+        # streams only — device-synth batches have no upload to save,
+        # and multi-host residency would skew the collective lockstep.
+        rm = None
+        if self.procs == 1 and not is_device_stream(stream):
+            from sheep_tpu.utils.residency import manager_from_env
+            rm = manager_from_env(stats=build_stats)
         # anchored-order inputs (delta: logs, ISSUE 19): degrees stream
         # the BASE segment only (the anchor), build/score the full
         # surviving multiset — same anchored-order semantics as the
@@ -842,12 +853,15 @@ class BigVPipeline:
                 for batch in pf:
                     seg_sp = obs.begin("segment", i=nb)
 
-                    def _step(b=batch, i=nb):
+                    def _step(b=batch, i=nb, key=start + nb * d):
                         maybe_fail("dispatch", i + 1, kinds=okinds)
+                        dev = rm.get(key) if rm is not None else None
+                        if dev is None:
+                            dev = self._put(self.batch_sharding, b)
+                            if rm is not None:
+                                rm.admit(key, dev, int(b.nbytes))
                         return self.build_step(
-                            P_sh, pos_sh,
-                            self._put(self.batch_sharding, b),
-                            stats=build_stats)
+                            P_sh, pos_sh, dev, stats=build_stats)
 
                     try:
                         P_sh, rounds = _guarded(_step, "bigv.build",
@@ -871,6 +885,11 @@ class BigVPipeline:
                             {"deg_local": deg_local,
                              "ptable_local": self._local_block(P_sh)},
                             meta)
+                        if rm is not None:
+                            # checkpoint boundary = eviction point: a
+                            # retry never re-reads behind the confirmed
+                            # index
+                            rm.boundary(start + nb * d)
         P_host = self._allgather_table(
             self._local_block(P_sh))[: n + 1]
         t["build"] = time.perf_counter() - t0
@@ -914,9 +933,15 @@ class BigVPipeline:
         with wd_mod.watched(self.procs, "bigv-score",
                             self.proc) as wd, batches(start) as pf:
             for batch in pf:
+                key = start + nb * d
+                dev = rm.get(key) if rm is not None else None
+                if dev is None:
+                    dev = self._put(self.batch_sharding, batch)
+                    if rm is not None:
+                        rm.admit(key, dev, int(batch.nbytes))
                 # designed per-batch score pull (two scalars)
                 c, tt = np.asarray(self.score_step(  # sheeplint: sync-ok
-                    self._put(self.batch_sharding, batch), assign_sh))
+                    dev, assign_sh))
                 cut += int(c)
                 total += int(tt)
                 if comm_volume:
@@ -936,6 +961,8 @@ class BigVPipeline:
                         {"deg_local": deg_local,
                          "ptable_local": self._local_block(P_sh)}, meta,
                         comm_volume)
+                    if rm is not None:
+                        rm.boundary(start + nb * d)
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

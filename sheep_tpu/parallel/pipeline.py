@@ -955,6 +955,19 @@ class ShardedPipeline:
         # ingest counters (device_stream_chunks / h2d_staged_bytes,
         # ISSUE 12) accumulate wherever batches are synthesized
         build_stats: dict = {}
+        # out-of-core residency plane (ISSUE 20): under an explicit
+        # SHEEP_CACHE_BYTES budget, device batches admitted during the
+        # build pass (keyed by absolute chunk index) serve the score
+        # pass — and intra-attempt retries — from HBM instead of
+        # re-uploading, with checkpoint boundaries as eviction points
+        # and spill-before-shrink on RESOURCE faults (_on_resource).
+        # Single-process host streams only: device-synth batches have
+        # no upload to save, and multi-host residency would skew the
+        # collective lockstep.
+        rm = None
+        if self.procs == 1 and not self._device_synth(stream):
+            from sheep_tpu.utils.residency import manager_from_env
+            rm = manager_from_env(stats=build_stats)
         # anchored-order inputs (delta: logs, ISSUE 19): the degrees
         # pass streams the BASE segment only — the order anchors to the
         # base degrees exactly as on the single-device backends — while
@@ -1143,8 +1156,16 @@ class ShardedPipeline:
                             for batch in pf:
                                 seg_sp = obs.begin("segment", i=batches)
                                 try:
+                                    key = start + batches * d
+                                    dev_batch = rm.get(key) \
+                                        if rm is not None else None
+                                    if dev_batch is None:
+                                        dev_batch = self.put_batch(batch)
+                                        if rm is not None:
+                                            rm.admit(key, dev_batch,
+                                                     int(batch.nbytes))
                                     P_all = self.build_step(
-                                        P_all, self.put_batch(batch),
+                                        P_all, dev_batch,
                                         pos, stats=build_stats)
                                 finally:
                                     seg_sp.end()
@@ -1169,12 +1190,18 @@ class ShardedPipeline:
                                         {"deg": deg_host,
                                          "merged_partial": partial},
                                         meta)
+                                    if rm is not None:
+                                        # checkpoint boundary = eviction
+                                        # point: retries never re-read
+                                        # behind the confirmed index
+                                        rm.boundary(start + batches * d)
                 return P_all
 
             def _on_resource():
                 nxt = retry_mod.degrade_dispatch(
                     n, cs, self.dispatch_batch, self.inflight,
-                    self.donate, build_stats, snap["idx"])
+                    self.donate, build_stats, snap["idx"],
+                    residency=rm)
                 if nxt is not None:
                     self.dispatch_batch, self.inflight = nxt
 
@@ -1251,7 +1278,12 @@ class ShardedPipeline:
                             self.proc) as wd, \
                 self._staged_batches(stream, start, build_stats) as pf:
             for batch in pf:
-                dev_batch = self.put_batch(batch)
+                key = start + batches * d
+                dev_batch = rm.get(key) if rm is not None else None
+                if dev_batch is None:
+                    dev_batch = self.put_batch(batch)
+                    if rm is not None:
+                        rm.admit(key, dev_batch, int(batch.nbytes))
                 c, tt = np.asarray(  # sheeplint: sync-ok
                     self.score_step(dev_batch, assign))
                 cut += int(c)
@@ -1273,6 +1305,8 @@ class ShardedPipeline:
                         {"deg": deg_host,
                          "merged": np.asarray(merged_minp)},  # sheeplint: sync-ok
                         meta, comm_volume)
+                    if rm is not None:
+                        rm.boundary(start + batches * d)
         cv = None
         if comm_volume:
             keys = ckpt.compact_cv_keys(cv_chunks)

@@ -180,6 +180,10 @@ class Job:
         # the scheduler drops the cache entry at finalize so the HBM is
         # released and future jobs start a fresh cache
         self.cache_shed = False
+        # admitted in spilled mode (ISSUE 20): over the budget at every
+        # dispatch shape, so it runs at the irreducible floor — no
+        # shared chunk cache lease, every overlap knob at 1
+        self.spilled = False
         # ---- durability (ISSUE 14) -----------------------------------
         # deterministic submit identity (spec + input content), the
         # reattach key; journaled at submit
@@ -527,7 +531,7 @@ class Scheduler:
         if digest is None:
             digest = journal_mod.job_digest(spec)
         n = self._probe_num_vertices(spec)
-        modeled, batch, rejected_why = self._model(spec, n)
+        modeled, batch, rejected_why, spilled = self._model(spec, n)
         hit = None
         if self.result_store is not None and not spec.resident:
             # fleet warm path (ISSUE 16): a digest hit answers DONE
@@ -555,6 +559,15 @@ class Scheduler:
             if batch is not None and batch != spec.dispatch_batch:
                 job.spec.dispatch_batch = batch
                 job.stats["admission_dispatch_batch"] = batch
+            if spilled:
+                # over-budget job admitted in spilled mode (ISSUE 20):
+                # every overlap knob pinned to 1 and NO shared chunk
+                # cache lease — the floor the admission model priced
+                job.spec.dispatch_batch = 1
+                job.spec.inflight = 1
+                job.spec.h2d_ring = 1
+                job.spilled = True
+                job.stats["admission_spilled"] = 1
             self._jobs[job.id] = job
             self.totals["submitted"] += 1
             self._m_submitted.inc(tenant=spec.tenant)
@@ -683,13 +696,24 @@ class Scheduler:
 
     def _model(self, spec: JobSpec, n: int):
         """(modeled_bytes, pre-shed dispatch_batch or None, reject
-        reason or None) for admission. Models at the REQUESTED chunk
-        size (clamping only shrinks it — conservative), with the same
-        staged-H2D-ring term the engine will actually run
-        (ISSUE 12): device-stream inputs stage nothing, host-format
-        ones hold ring x batch blocks in HBM — reserving without that
-        term would admit jobs whose real footprint exceeds the budget
-        and re-create the OOM churn admission exists to prevent."""
+        reason or None, spilled bool) for admission. Models at the
+        REQUESTED chunk size (clamping only shrinks it —
+        conservative), with the same staged-H2D-ring term the engine
+        will actually run (ISSUE 12): device-stream inputs stage
+        nothing, host-format ones hold ring x batch blocks in HBM —
+        reserving without that term would admit jobs whose real
+        footprint exceeds the budget and re-create the OOM churn
+        admission exists to prevent.
+
+        Spilled-mode admission (ISSUE 20): a job the halving ladder
+        cannot fit even at dispatch_batch=1 is admitted at the
+        IRREDUCIBLE floor — batch=1, inflight=1, ring depth 1, zero
+        resident chunk bytes (the engine runs without the shared chunk
+        cache; every pass streams from disk) — instead of rejected.
+        The build is bit-identical at any dispatch shape (the fixpoint
+        invariant), so spilled mode trades only wall time for
+        admission. Rejection remains only for jobs whose floor itself
+        exceeds the budget."""
         from sheep_tpu.backends.tpu_backend import (resolve_dispatch_batch,
                                                     resolve_h2d_ring,
                                                     resolve_inflight)
@@ -712,7 +736,7 @@ class Scheduler:
         batch = resolve_dispatch_batch(spec.dispatch_batch, n, cs,
                                        inflight=infl, h2d_ring=ring)
         if self.budget is None:
-            return None, None, None
+            return None, None, None, False
 
         def total(b):
             return membudget.build_phase_bytes(
@@ -724,15 +748,27 @@ class Scheduler:
         while m > self.budget:
             nxt = membudget.degraded_dispatch(n, cs, batch, 1)
             if nxt is None:
+                # spilled mode: the irreducible footprint — every
+                # overlap knob at 1, nothing resident (resident_bytes
+                # names the term it zeroes: the job runs cache-less,
+                # streaming each pass from the disk tier)
+                floor = membudget.build_phase_bytes(
+                    n, cs, dispatch_batch=1, inflight=1,
+                    h2d_ring=min(1, ring),
+                    resident_bytes=0)["total_bytes"]
+                if floor <= self.budget:
+                    return floor, 1, None, True
                 return m, None, (
                     f"modeled device footprint {m:,} bytes exceeds the "
-                    f"admission budget {self.budget:,} even at "
-                    f"dispatch_batch=1 (V={n:,}, chunk_edges={cs:,}); "
-                    f"shrink the graph/chunk or raise the budget")
+                    f"admission budget {self.budget:,} even spilled "
+                    f"(floor {floor:,} at dispatch_batch=1, inflight=1 "
+                    f"with nothing resident; V={n:,}, "
+                    f"chunk_edges={cs:,}); shrink the graph/chunk or "
+                    f"raise the budget"), False
             batch = nxt[0]
             shed = batch
             m = total(batch)
-        return m, shed, None
+        return m, shed, None, False
 
     @staticmethod
     def _is_resident(job: Job) -> bool:
@@ -1932,6 +1968,11 @@ class Scheduler:
                                                     _ChunkCacheReader,
                                                     _chunk_cache_budget)
 
+        if job.spilled:
+            # spilled-mode admission priced this job at the cache-less
+            # floor; leasing resident chunks would put back exactly the
+            # bytes the admission model zeroed out
+            return None
         with self._lock:
             key = (job.spec.input, job.spec.chunk_edges,
                    job.n_vertices)

@@ -18,7 +18,7 @@ from sheep_tpu.ops.elim import EXACT_TABLE_BYTES
 def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
                       descent: str = "auto", dispatch_batch: int = 1,
                       inflight: int = 1, donate: bool = False,
-                      h2d_ring: int = 0) -> dict:
+                      h2d_ring: int = 0, resident_bytes: int = 0) -> dict:
     """Estimated peak device bytes for one build_chunk_step.
 
     The displacement fixpoint (ops/elim.py fold_edges) keeps the carried
@@ -51,6 +51,15 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     depth x staging-bytes product. 0 = ring off (device-stream inputs
     synthesize on device and stage nothing; the synchronous path
     uploads in place).
+
+    ``resident_bytes`` (the residency term, ISSUE 20) is the chunk
+    bytes the :class:`~sheep_tpu.utils.residency.ResidencyManager`
+    currently holds (or budgets) on device — cached chunks are live HBM
+    exactly like staging blocks, and a model that ignored them would
+    admit builds whose real footprint overflows the instant the cache
+    warms. Unlike every other term it is *reclaimable*: the degrade
+    ladder spills it before shrinking any dispatch knob (see
+    :func:`degraded_dispatch`).
     """
     if lift_levels <= 0:
         lift_levels = max(1, int(n).bit_length())
@@ -83,13 +92,16 @@ def build_phase_bytes(n: int, chunk_edges: int, lift_levels: int = 0,
     # chunks each) live in HBM between transfer and dispatch
     ring_bytes = 4 * 2 * chunk_edges * max(1, dispatch_batch) \
         * max(0, h2d_ring)
-    total = persistent + transient + staging + ring_bytes + lift_bytes
+    resident = max(0, int(resident_bytes))
+    total = persistent + transient + staging + ring_bytes + lift_bytes \
+        + resident
     return {
         "persistent_bytes": persistent,
         "transient_bytes": transient,
         "staging_bytes": staging,
         "h2d_ring_bytes": ring_bytes,
         "lift_bytes": lift_bytes,
+        "resident_bytes": resident,
         "descent": descent,
         "total_bytes": total,
     }
@@ -120,7 +132,7 @@ def dispatch_batch_for(hbm_bytes: int, n: int, chunk_edges: int,
 
 def degraded_dispatch(n: int, chunk_edges: int, dispatch_batch: int,
                       inflight: int, donate: bool = False,
-                      h2d_ring=None):
+                      h2d_ring=None, spillable_bytes: int = 0):
     """One RESOURCE_EXHAUSTED degradation step for the dispatch drivers
     (ISSUE 9): halve ``dispatch_batch``, ``inflight`` — or, when the
     caller runs a staged H2D ring (``h2d_ring`` given as an int >= 1,
@@ -132,12 +144,25 @@ def degraded_dispatch(n: int, chunk_edges: int, dispatch_batch: int,
     the caller falls back to a plain retry, then to the
     checkpoint/kill+resume contract).
 
+    **Spill-before-shrink** (ISSUE 20): when the caller holds evictable
+    resident chunks (``spillable_bytes`` > 0), the ladder's FIRST rung
+    is spilling them — cached chunks are a pure latency optimization
+    whose modeled bytes come back for free, while halving a dispatch
+    knob permanently costs overlap for the rest of the run. The step is
+    then ``("spill", dispatch_batch, inflight[, h2d_ring])``: the knobs
+    come back *unchanged* and the caller (utils/retry.degrade_dispatch
+    with a residency manager) performs the actual eviction. Only with
+    nothing left to spill does the ladder fall through to halving.
+
     Reusing :func:`build_phase_bytes` instead of a fixed halving order
     keeps the degrade schedule consistent with the auto-sizing rule
     (:func:`dispatch_batch_for`): the knob that the model says holds the
     most staging is the knob an OOM most plausibly indicts."""
     batch, depth = max(1, int(dispatch_batch)), max(1, int(inflight))
     ring = None if h2d_ring is None else max(1, int(h2d_ring))
+    if spillable_bytes > 0:
+        step = ("spill", batch, depth)
+        return step + (ring,) if ring is not None else step
     if batch <= 1 and depth <= 1 and (ring is None or ring <= 1):
         return None
 

@@ -224,7 +224,7 @@ def handle_build_fault(policy: RetryPolicy, exc: BaseException,
 
 def degrade_dispatch(n: int, chunk_edges: int, batch: int, inflight: int,
                      donate: bool, stats: dict, resume_chunk: int,
-                     h2d_ring=None):
+                     h2d_ring=None, residency=None):
     """Shared RESOURCE recovery step: pick the membudget-modeled
     halving of (dispatch_batch, inflight) — plus the staged H2D ring
     depth when the caller runs one (``h2d_ring`` an int, ISSUE 12) —
@@ -232,12 +232,30 @@ def degrade_dispatch(n: int, chunk_edges: int, batch: int, inflight: int,
     event. Returns the new pair (or triple, mirroring
     ``membudget.degraded_dispatch``), or None when nothing is left to
     shed (the caller then plain-retries and ultimately falls back to
-    the kill+resume contract)."""
+    the kill+resume contract).
+
+    With a :class:`~sheep_tpu.utils.residency.ResidencyManager`
+    (``residency``, ISSUE 20) the ladder spills BEFORE it shrinks:
+    resident chunks are reclaimable HBM (their bits live on disk), so
+    the first RESOURCE fault drops them — and halves the residency
+    budget so refill pressure shrinks too — returning the dispatch
+    knobs *unchanged*. Only a fault with nothing left to spill reaches
+    the halving rungs below."""
     from sheep_tpu import obs
     from sheep_tpu.utils import membudget
 
+    spillable = residency.spillable_bytes() if residency is not None \
+        else 0
     nxt = membudget.degraded_dispatch(n, chunk_edges, batch, inflight,
-                                      donate, h2d_ring=h2d_ring)
+                                      donate, h2d_ring=h2d_ring,
+                                      spillable_bytes=spillable)
+    if nxt is not None and nxt[0] == "spill":
+        freed = residency.pressure_spill()
+        stats["spill_degrades"] = stats.get("spill_degrades", 0) + 1
+        obs.event("dispatch_spilled", resume_chunk=int(resume_chunk),
+                  freed_bytes=int(freed),
+                  residency_budget=int(residency.budget))
+        return nxt[1:]
     if nxt is not None:
         stats["degraded_dispatch_batch"] = nxt[0]
         stats["degraded_inflight"] = nxt[1]

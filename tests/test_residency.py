@@ -1,0 +1,352 @@
+"""Out-of-core residency manager (ISSUE 20).
+
+The pins, unit level first, then end-to-end:
+
+- byte accounting: the budget caps the resident set through overflow,
+  rotation, boundary eviction and pressure spill; the high-water /
+  eviction / reload counters track every transition;
+- eviction order: the sticky prefix anchors at the stream HEAD (the
+  overflow carve drops the highest prefix index, never chunk 0), the
+  tail window rotates FIFO, checkpoint boundaries evict only window
+  entries behind the confirmed index;
+- a leased chunk refuses eviction (LeasedChunkError) and is skipped by
+  every spill scan — leased bytes are not modeled as reclaimable;
+- spill-before-shrink: with spillable bytes the degrade ladder's first
+  rung is ("spill", ...) with the dispatch knobs UNCHANGED; the retry
+  wrapper performs the spill and halves the residency budget;
+- a build under a deliberately tiny SHEEP_CACHE_BYTES budget is
+  bit-identical to the unconstrained oracle on tpu / tpu-sharded /
+  tpu-bigv, with the spill counters on the diagnostics record;
+- the served scheduler ADMITS an over-budget job in spilled mode
+  (knobs pinned to 1, no shared-cache lease) instead of rejecting it,
+  bit-identically; only a job whose irreducible floor exceeds the
+  budget is still rejected;
+- a run killed mid-build under a spilling budget resumes bit-identical
+  to the unconstrained oracle (the PR-8 contract holds through the
+  eviction/reload plane).
+"""
+
+import numpy as np
+import pytest
+
+from sheep_tpu.backends.base import get_backend, list_backends
+from sheep_tpu.io.edgestream import EdgeStream
+from sheep_tpu.io import generators
+from sheep_tpu.utils.checkpoint import Checkpointer
+from sheep_tpu.utils.fault import ENV_VAR, InjectedFault
+from sheep_tpu.utils import membudget
+from sheep_tpu.utils import retry as retry_mod
+from sheep_tpu.utils.residency import (LeasedChunkError, ResidencyManager,
+                                       manager_from_env)
+
+K = 4
+CHUNK = 256  # 2048 B/chunk on the single-device backend
+
+
+def graph():
+    e = generators.rmat(10, 8, seed=3)
+    return EdgeStream.from_array(e, n_vertices=1 << 10)
+
+
+# ----------------------------------------------------------------- unit level
+
+def test_prefix_admission_byte_accounting():
+    stats: dict = {}
+    rm = ResidencyManager(100, stats=stats)
+    assert rm.admit(0, "a", 40) and rm.admit(1, "b", 40)
+    assert rm.used == 80
+    assert rm.get(0) == "a" and rm.get(1) == "b"
+    assert stats["residency_hits"] == 2
+    assert rm.spillable_bytes() == 80
+    assert stats.get("spill_evictions", 0) == 0
+
+
+def test_overflow_carve_keeps_stream_head():
+    """First overflow carves the tail window out of the prefix TOP:
+    chunk 0 (what every later pass re-reads first) stays resident."""
+    rm = ResidencyManager(100, stats={})
+    rm.admit(0, "a", 40)
+    rm.admit(1, "b", 40)
+    assert rm.admit(2, "c", 40)  # overflow -> carve -> window
+    assert rm.get(0) == "a", "head anchor evicted by the carve"
+    assert rm.get(1) is None, "carve must drop the highest prefix idx"
+    assert rm.get(2) == "c"
+    assert rm.used <= rm.budget
+
+
+def test_window_rotates_fifo():
+    rm = ResidencyManager(100, stats={})
+    for i, ref in enumerate("abcd"):
+        rm.admit(i, ref, 40)
+    # window holds one 40 B chunk: 2 rotated out for 3, 3 for 4... the
+    # newest window entry and the head-anchored prefix survive
+    assert rm.get(0) == "a"
+    assert rm.get(2) is None
+    assert rm.get(3) == "d"
+    assert rm.stats["spill_evictions"] >= 2
+
+
+def test_budget_caps_resident_set_always():
+    """A single chunk larger than the whole budget is refused — the
+    byte cap holds unconditionally."""
+    rm = ResidencyManager(100, stats={})
+    assert not ResidencyManager(0).admit(0, "x", 1)
+    rm.admit(0, "a", 90)
+    assert not rm.admit(1, "big", 150)
+    assert rm.used <= rm.budget
+
+
+def test_checkpoint_boundary_evicts_confirmed_window_only():
+    rm = ResidencyManager(100, stats={}, window_fraction=0.6)
+    rm.admit(0, "a", 40)       # prefix
+    rm.admit(1, "b", 40)
+    rm.admit(2, "c", 30)       # overflow: window carved (60 B)
+    rm.admit(3, "d", 30)       # both fit the window
+    assert rm.get(2) == "c" and rm.get(3) == "d"
+    freed = rm.boundary(3)     # chunks < 3 confirmed on disk
+    assert freed == 30
+    assert rm.get(2) is None, "confirmed window entry must be evicted"
+    assert rm.get(3) == "d", "unconfirmed window entry must survive"
+    assert rm.get(0) == "a", "boundary must never touch the prefix"
+    assert rm.stats["residency_boundary_evictions"] == 1
+
+
+def test_leased_chunk_refuses_eviction():
+    rm = ResidencyManager(100, stats={})
+    rm.admit(0, "a", 40)
+    rm.lease(0)
+    with pytest.raises(LeasedChunkError):
+        rm.evict(0)
+    assert rm.spillable_bytes() == 0, "leased bytes modeled reclaimable"
+    assert rm.spill(None) == 0, "spill scan must skip leased entries"
+    assert rm.get(0) == "a"
+    rm.release(0)
+    assert rm.evict(0) == 40
+    assert rm.get(0) is None
+
+
+def test_reload_accounting_on_reupload():
+    stats: dict = {}
+    rm = ResidencyManager(100, stats=stats)
+    rm.admit(0, "a", 40)
+    rm.evict(0)
+    rm.admit(0, "a2", 40)  # the disk tier re-upload
+    assert stats["spill_reload_bytes"] == 40
+    assert stats["spill_reloads"] == 1
+    assert stats["spill_resident_bytes"] == 40  # high water
+
+
+def test_complete_fast_path_only_without_evictions():
+    rm = ResidencyManager(1000, stats={})
+    for i in range(4):
+        rm.admit(i, i, 40)
+    rm.note_stream_end(4)
+    assert rm.complete
+    over = ResidencyManager(100, stats={})
+    for i in range(4):
+        over.admit(i, i, 40)
+    over.note_stream_end(4)
+    assert not over.complete
+
+
+def test_pressure_spill_drops_all_and_halves_budget():
+    stats: dict = {}
+    rm = ResidencyManager(100, stats=stats)
+    rm.admit(0, "a", 40)
+    rm.admit(1, "b", 40)
+    freed = rm.pressure_spill()
+    assert freed == 80 and rm.used == 0 and rm.budget == 50
+    assert not rm.complete
+    rm.pressure_spill()  # walks toward 0: 25 -> ... -> 0 eventually
+    assert rm.budget == 25
+
+
+def test_manager_from_env(monkeypatch):
+    monkeypatch.delenv("SHEEP_CACHE_BYTES", raising=False)
+    assert manager_from_env() is None
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", "0")
+    assert manager_from_env() is None
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", "4096")
+    rm = manager_from_env(stats={})
+    assert rm is not None and rm.budget == 4096
+
+
+# -------------------------------------------------- spill-before-shrink ladder
+
+def test_degraded_dispatch_spills_before_shrinking():
+    n, cs = 1 << 10, CHUNK
+    step = membudget.degraded_dispatch(n, cs, 4, 2, spillable_bytes=1)
+    assert step == ("spill", 4, 2), "knobs must come back unchanged"
+    step = membudget.degraded_dispatch(n, cs, 4, 2, h2d_ring=2,
+                                       spillable_bytes=1)
+    assert step == ("spill", 4, 2, 2)
+    # even at batch=1/inflight=1 a spill rung precedes the None fallback
+    assert membudget.degraded_dispatch(n, cs, 1, 1,
+                                       spillable_bytes=1) == ("spill", 1, 1)
+    # with nothing spillable the ladder halves as before
+    nxt = membudget.degraded_dispatch(n, cs, 4, 2, spillable_bytes=0)
+    assert nxt is not None and nxt[0] * nxt[1] < 8
+
+
+def test_retry_degrade_performs_the_spill():
+    stats: dict = {}
+    rm = ResidencyManager(1 << 20, stats=stats)
+    rm.admit(0, "a", 4096)
+    rm.admit(1, "b", 4096)
+    nxt = retry_mod.degrade_dispatch(1 << 10, CHUNK, 4, 2, False,
+                                     stats, 7, residency=rm)
+    assert nxt == (4, 2), "spill rung must leave the knobs unchanged"
+    assert rm.used == 0 and rm.budget == (1 << 20) // 2
+    assert stats["spill_degrades"] == 1
+    # drained manager: the next fault falls through to plain halving
+    nxt = retry_mod.degrade_dispatch(1 << 10, CHUNK, 4, 2, False,
+                                     stats, 7, residency=rm)
+    assert nxt is not None and nxt != (4, 2)
+
+
+def test_build_phase_bytes_resident_term():
+    n, cs = 1 << 10, CHUNK
+    base = membudget.build_phase_bytes(n, cs)
+    held = membudget.build_phase_bytes(n, cs, resident_bytes=12345)
+    assert held["resident_bytes"] == 12345
+    assert held["total_bytes"] == base["total_bytes"] + 12345
+
+
+# --------------------------------------------------- end-to-end bit-identity
+
+OOCORE_BACKENDS = [
+    pytest.param(b, marks=[pytest.mark.slow] if b == "tpu-bigv" else [])
+    for b in ("tpu", "tpu-sharded", "tpu-bigv") if b in list_backends()
+]
+# one lockstep batch is 8 chunks x 2048 B on the 8-device mesh: budgets
+# sized to hold ~2 admission units so every driver overflows mid-stream
+TINY_BUDGET = {"tpu": "6000", "tpu-sharded": "40000", "tpu-bigv": "40000"}
+
+
+@pytest.mark.parametrize("backend", OOCORE_BACKENDS)
+def test_tiny_budget_build_bit_equals_oracle(backend, monkeypatch):
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    monkeypatch.delenv("SHEEP_CACHE_BYTES", raising=False)
+    oracle = get_backend(backend, **kw).partition(es, K, comm_volume=True)
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", TINY_BUDGET[backend])
+    tiny = get_backend(backend, **kw).partition(es, K, comm_volume=True)
+    assert np.array_equal(tiny.assignment, oracle.assignment)
+    assert tiny.edge_cut == oracle.edge_cut
+    assert tiny.total_edges == oracle.total_edges
+    assert tiny.comm_volume == oracle.comm_volume
+    d = tiny.diagnostics or {}
+    assert d.get("spill_evictions", 0) > 0, \
+        "tiny budget never evicted: the out-of-core plane did not engage"
+    assert d.get("spill_reload_bytes", 0) > 0
+    assert d.get("spill_resident_bytes", 0) > 0
+    assert d.get("spill_resident_bytes") <= int(TINY_BUDGET[backend])
+    assert d.get("residency_hits", 0) > 0, \
+        "the sticky prefix never served a later pass"
+
+
+@pytest.mark.parametrize("backend", OOCORE_BACKENDS)
+def test_kill_resume_through_half_spilled_build(backend, tmp_path,
+                                               monkeypatch):
+    """The PR-8 contract through the eviction/reload plane: kill the
+    build mid-stream under a spilling budget, resume, bit-equal the
+    UNCONSTRAINED oracle."""
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    monkeypatch.delenv("SHEEP_CACHE_BYTES", raising=False)
+    oracle = get_backend(backend, **kw).partition(es, K, comm_volume=True)
+
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", TINY_BUDGET[backend])
+    ck = Checkpointer(str(tmp_path), every=1)
+    monkeypatch.setenv(ENV_VAR, "build:2")
+    with pytest.raises(InjectedFault):
+        get_backend(backend, **kw).partition(
+            es, K, comm_volume=True, checkpointer=ck)
+    monkeypatch.delenv(ENV_VAR)
+    assert ck.load() is not None, "no checkpoint before the fault"
+
+    res = get_backend(backend, **kw).partition(
+        es, K, comm_volume=True, checkpointer=ck, resume=True)
+    assert np.array_equal(res.assignment, oracle.assignment)
+    assert res.edge_cut == oracle.edge_cut
+    assert res.comm_volume == oracle.comm_volume
+
+
+def test_oom_spills_before_shrinking_end_to_end(monkeypatch):
+    """An injected RESOURCE fault on a build with resident chunks takes
+    the spill rung: counters on record, dispatch knobs unchanged, and
+    the result still bit-equals the oracle."""
+    es = graph()
+    kw = {"chunk_edges": CHUNK}
+    monkeypatch.delenv("SHEEP_CACHE_BYTES", raising=False)
+    oracle = get_backend("tpu", **kw).partition(es, K, comm_volume=True)
+    monkeypatch.setenv("SHEEP_CACHE_BYTES", "6000")
+    monkeypatch.setenv("SHEEP_RETRY_BASE_S", "0.01")
+    monkeypatch.setenv(ENV_VAR, "oom@build:2:1")
+    res = get_backend("tpu", **kw).partition(es, K, comm_volume=True)
+    d = res.diagnostics or {}
+    assert d.get("spill_degrades", 0) >= 1, \
+        "RESOURCE fault with resident chunks must take the spill rung"
+    assert d.get("degraded_dispatch_batch", 0) == 0, \
+        "spill-before-shrink: the dispatch knobs must stay untouched"
+    assert np.array_equal(res.assignment, oracle.assignment)
+
+
+# ----------------------------------------------------- served spilled mode
+
+def test_served_over_budget_job_admitted_spilled():
+    """A job the halving ladder cannot fit is ADMITTED at the
+    irreducible floor — knobs pinned to 1, spilled flag on the job, no
+    shared-cache lease — and bit-equals the solo build."""
+    import threading
+
+    from sheep_tpu.server.protocol import JobSpec
+    from sheep_tpu.server.scheduler import Scheduler
+
+    es = graph()
+    ref = get_backend("tpu", chunk_edges=1024).partition(es, K).assignment
+    n, cs = 1 << 10, 1024
+    floor = membudget.build_phase_bytes(
+        n, cs, dispatch_batch=1, inflight=1, h2d_ring=1)["total_bytes"]
+    sched = Scheduler(budget_bytes=int(floor * 1.2))
+    t = threading.Thread(target=sched.run, daemon=True)
+    t.start()
+    try:
+        spec = JobSpec.from_request(
+            {"input": "rmat:10:8:3", "k": [K], "chunk_edges": cs,
+             "dispatch_batch": 8, "inflight": 2}, tenant="t")
+        job = sched.submit(spec)
+        job = sched.wait(job.id, timeout_s=240)
+        assert job.state == "done", job.error
+        assert job.spilled
+        assert job.stats.get("admission_spilled") == 1
+        assert job.spec.dispatch_batch == 1 and job.spec.inflight == 1
+        assert np.array_equal(job.results[0].assignment, ref)
+    finally:
+        sched.shutdown()
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+
+def test_served_floor_over_budget_still_rejected():
+    """Rejection remains for jobs whose spilled-mode floor itself
+    exceeds the budget — spilled admission is not unbounded."""
+    import threading
+
+    from sheep_tpu.server.protocol import JobSpec
+    from sheep_tpu.server.scheduler import Scheduler
+
+    sched = Scheduler(budget_bytes=10000)
+    t = threading.Thread(target=sched.run, daemon=True)
+    t.start()
+    try:
+        spec = JobSpec.from_request(
+            {"input": "rmat:10:8:3", "k": [K], "chunk_edges": 1024},
+            tenant="t")
+        job = sched.submit(spec)
+        job = sched.wait(job.id, timeout_s=60)
+        assert job.state == "rejected"
+        assert "even spilled" in (job.error or "")
+    finally:
+        sched.shutdown()
+        t.join(timeout=30)

@@ -340,6 +340,70 @@ def _fold_delta(state, edges):
     assert "fold" not in rules_of(lint_source(elsewhere))
 
 
+SPILL_BAD = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def drain(view):
+    return np.asarray(view._indices)          # whole mmap region pulled
+
+def drain_slice(view):
+    return np.array(view.indices[:])          # same, via full slice
+
+def upload(stream, cs, n):
+    out = []
+    for i in range(8):
+        out.append(jax.device_put(stream.device_chunk(i, cs, n)))
+        out.append(jnp.asarray(pad_chunk(next(stream), cs, n)))
+    return out
+"""
+
+
+def test_spill_rule_fires_on_full_pull_and_loose_upload():
+    findings = [f for f in lint_source(SPILL_BAD) if f.rule == "spill"]
+    assert sum("mmap region" in f.message for f in findings) == 2
+    assert sum("outside the residency manager" in f.message
+               for f in findings) == 2
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_spill_rule_pragma_suppresses():
+    ok = SPILL_BAD.replace(
+        "# whole mmap region pulled", "# sheeplint: spill-ok"
+    ).replace(
+        "# same, via full slice", "# sheeplint: spill-ok"
+    ).replace(
+        "out.append(jax.device_put(stream.device_chunk(i, cs, n)))",
+        "out.append(jax.device_put(stream.device_chunk(i, cs, n)))  "
+        "# sheeplint: spill-ok"
+    ).replace(
+        "out.append(jnp.asarray(pad_chunk(next(stream), cs, n)))",
+        "out.append(jnp.asarray(pad_chunk(next(stream), cs, n)))  "
+        "# sheeplint: spill-ok")
+    assert "spill" not in rules_of(lint_source(ok))
+
+
+def test_spill_rule_sliced_pull_and_depth0_upload_clean():
+    # an element/range subscript is the mmap contract working as
+    # designed; a one-shot upload outside a loop is not the per-chunk
+    # bypass the rule hunts
+    clean = """
+import numpy as np
+import jax
+
+def rows(view, eid):
+    return np.asarray(view._indices[eid], dtype=np.int64)
+
+def span(view, a, b):
+    return np.asarray(view.indices[a:b])
+
+def place_one(stream, cs, n):
+    return jax.device_put(stream.device_chunk(0, cs, n))
+"""
+    assert "spill" not in rules_of(lint_source(clean))
+
+
 CLEAN = """
 import numpy as np
 import jax

@@ -110,11 +110,18 @@ HIGHER_BETTER = ("value", "vs_baseline", "r_colo_est")
 # through the multi-device lockstep fold + distributed rescore — the
 # per-epoch cost of a resident SHARDED partition; gated lower-better
 # with the update_request_s convention.
+# oocore_request_s (ISSUE 20) is the build wall under a residency
+# budget clamped to ~half the modeled working set — the price of
+# running out-of-core (evict + reload through the disk tier); a rise
+# means the spill/reload path is slowing, gated lower-better. Its
+# spill_* companions describe the constraint (how much was evicted /
+# re-uploaded / held resident), not a perf series — info-only below.
 LOWER_BETTER = ("host_syncs", "device_rounds", "host_blocked_ms",
                 "h2d_blocked_ms", "dispatch_retries", "warm_up_s",
                 "warm_request_s", "cached_request_s",
                 "update_request_s", "update_fold_s",
-                "update_score_s", "sharded_update_request_s")
+                "update_score_s", "sharded_update_request_s",
+                "oocore_request_s")
 # degraded_* and checkpoint_degraded are consequences of faults the
 # environment injected, not regressions of the code under test — they
 # ride as info so the degradation is VISIBLE in the perf trajectory
@@ -126,7 +133,9 @@ INFO_ONLY = ("rtt_ms", "h2d_mbs", "d2h_mbs", "dispatch_batch",
              "degraded_dispatch_batch", "degraded_inflight",
              "degraded_h2d_ring",
              "device_loss_recoveries", "checkpoint_degraded",
-             "cold_request_s", "compactions", "epoch_scale_x2")
+             "cold_request_s", "compactions", "epoch_scale_x2",
+             "spill_evictions", "spill_reload_bytes",
+             "spill_resident_bytes")
 
 
 def load_capture(path: str):
